@@ -1,0 +1,34 @@
+"""kraken-tpu: a TPU-native peer-to-peer content-distribution framework.
+
+A ground-up rebuild of the capabilities of orishu/kraken (a fork of Uber's
+kraken P2P Docker registry) in Python/asyncio + JAX, extended with a
+TPU-backed hashing/chunking plane (batched SHA-256 metainfo generation and
+piece verification, FastCDC content-defined chunking, MinHash near-duplicate
+indexing).
+
+Package layout (mirrors SURVEY.md's layer map, TPU-first design):
+
+- ``core``      -- vocabulary types: Digest, MetaInfo, PeerID, PeerInfo,
+                   BlobInfo, and the PieceHasher interface (L1).
+- ``ops``       -- TPU compute plane: batched SHA-256, FastCDC gear-hash
+                   candidates, MinHash sketches (JAX / Pallas).
+- ``parallel``  -- multi-chip sharding of the compute plane over a
+                   jax.sharding.Mesh (data-parallel piece axis over ICI).
+- ``store``     -- content-addressable file store with piece-status
+                   metadata and TTL/disk cleanup (L2).
+- ``backends``  -- pluggable storage-backend registry (testfs, file, http;
+                   namespace -> backend manager with bandwidth caps) (L2).
+- ``placement`` -- rendezvous hashring over health-filtered host lists (L2).
+- ``persistedretry`` -- durable async task queue (sqlite) for writeback and
+                   replication (L2).
+- ``p2p``       -- the torrent plane: wire protocol, conns, dispatch,
+                   scheduler (L3).
+- ``tracker``, ``origin``, ``agent``, ``proxy``, ``buildindex`` -- the five
+  long-running components (L4-L6).
+- ``utils``     -- httputil, dedup, bandwidth, backoff, configutil, log.
+
+Reference: uber/kraken repo layout (upstream paths; /root/reference was an
+empty mount at build time -- see SURVEY.md "provenance warning").
+"""
+
+__version__ = "0.1.0"

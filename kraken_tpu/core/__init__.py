@@ -1,0 +1,26 @@
+"""Core vocabulary types shared by every layer.
+
+Reference: uber/kraken ``core/`` package (Digest, MetaInfo, PeerID, PeerInfo,
+BlobInfo) -- upstream paths, unverified; see SURVEY.md SS2.1.
+"""
+
+from kraken_tpu.core.digest import Digest, Digester, DigestError
+from kraken_tpu.core.metainfo import MetaInfo, InfoHash, MetaInfoError
+from kraken_tpu.core.peer import PeerID, PeerIDFactory, PeerInfo, BlobInfo
+from kraken_tpu.core.hasher import PieceHasher, CPUPieceHasher, get_hasher
+
+__all__ = [
+    "Digest",
+    "Digester",
+    "DigestError",
+    "MetaInfo",
+    "InfoHash",
+    "MetaInfoError",
+    "PeerID",
+    "PeerIDFactory",
+    "PeerInfo",
+    "BlobInfo",
+    "PieceHasher",
+    "CPUPieceHasher",
+    "get_hasher",
+]

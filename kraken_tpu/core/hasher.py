@@ -1,0 +1,107 @@
+"""The ``PieceHasher`` interface -- the seam the TPU plane plugs into.
+
+Both hot loops of the system route through this interface (north star in
+BASELINE.json):
+
+- origin-side metainfo generation (``origin/metainfogen``): hash every piece
+  of every uploaded blob;
+- agent-side piece verification (``p2p/storage``): hash every received piece.
+
+Implementations register by name; component YAML selects one via
+``hasher: tpu`` / ``hasher: cpu`` exactly like the storage-backend registry
+(the same plugin pattern as uber/kraken ``lib/backend`` ``Register(name)``
+[UNVERIFIED upstream path]).
+
+The interface is deliberately batch-shaped -- ``hash_pieces`` takes a whole
+blob (or a batch of equal-length pieces) and returns an ``[N, 32]`` digest
+matrix -- because the TPU implementation amortizes dispatch over thousands
+of pieces. A per-piece call would hide the batch axis the hardware needs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict
+
+import numpy as np
+
+DIGEST_SIZE = 32
+
+
+class PieceHasher:
+    """Batched SHA-256 over the pieces of a blob.
+
+    Implementations must be safe to share across threads/tasks.
+    """
+
+    name = "abstract"
+
+    def hash_pieces(self, data: bytes | memoryview, piece_length: int) -> np.ndarray:
+        """Split ``data`` into ``piece_length`` pieces (last may be short)
+        and return the SHA-256 of each as a ``[num_pieces, 32] uint8``
+        array. A zero-length blob returns ``[0, 32]``."""
+        raise NotImplementedError
+
+    def hash_batch(self, pieces: list[bytes | memoryview]) -> np.ndarray:
+        """Hash a list of arbitrary-length pieces -> ``[len(pieces), 32]``.
+
+        Used by the agent verify path, where received pieces arrive out of
+        order and are batched briefly before verification.
+        """
+        raise NotImplementedError
+
+
+class CPUPieceHasher(PieceHasher):
+    """Reference implementation on hashlib. Also the golden oracle for the
+    TPU plane's tests (crypto hashes admit no tolerance)."""
+
+    name = "cpu"
+
+    def hash_pieces(self, data: bytes | memoryview, piece_length: int) -> np.ndarray:
+        if piece_length <= 0:
+            raise ValueError(f"piece_length must be positive: {piece_length}")
+        view = memoryview(data)
+        n = (len(view) + piece_length - 1) // piece_length
+        out = np.empty((n, DIGEST_SIZE), dtype=np.uint8)
+        for i in range(n):
+            piece = view[i * piece_length : (i + 1) * piece_length]
+            out[i] = np.frombuffer(hashlib.sha256(piece).digest(), dtype=np.uint8)
+        return out
+
+    def hash_batch(self, pieces: list[bytes | memoryview]) -> np.ndarray:
+        out = np.empty((len(pieces), DIGEST_SIZE), dtype=np.uint8)
+        for i, p in enumerate(pieces):
+            out[i] = np.frombuffer(hashlib.sha256(p).digest(), dtype=np.uint8)
+        return out
+
+
+_REGISTRY: Dict[str, Callable[[], PieceHasher]] = {}
+_INSTANCES: Dict[str, PieceHasher] = {}
+
+
+def register_hasher(name: str, factory: Callable[[], PieceHasher]) -> None:
+    _REGISTRY[name] = factory
+
+
+def get_hasher(name: str = "cpu") -> PieceHasher:
+    """Resolve a hasher by registry name (``cpu``, ``tpu``).
+
+    Instances are cached: TPU hasher construction compiles kernels, so the
+    origin and agent share one instance per process.
+    """
+    if name not in _INSTANCES:
+        if name == "tpu" and name not in _REGISTRY:
+            # Importing the ops plane registers the TPU hasher; deferred so
+            # that pure-CPU components never pay the JAX import.
+            import kraken_tpu.ops.sha256  # noqa: F401
+        try:
+            factory = _REGISTRY[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown hasher {name!r}; registered: {sorted(_REGISTRY)}"
+            ) from None
+        _INSTANCES[name] = factory()
+    return _INSTANCES[name]
+
+
+register_hasher("cpu", CPUPieceHasher)

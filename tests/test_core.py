@@ -1,0 +1,178 @@
+"""Unit tests for kraken_tpu.core (digest, metainfo, peer, hasher)."""
+
+import hashlib
+import io
+
+import numpy as np
+import pytest
+
+from kraken_tpu.core import (
+    BlobInfo,
+    CPUPieceHasher,
+    Digest,
+    DigestError,
+    Digester,
+    MetaInfo,
+    MetaInfoError,
+    PeerID,
+    PeerIDFactory,
+    PeerInfo,
+    get_hasher,
+)
+from kraken_tpu.core.fixtures import (
+    blob_and_metainfo_fixture,
+    blob_fixture,
+    metainfo_fixture,
+)
+from kraken_tpu.core.metainfo import num_pieces
+
+
+class TestDigest:
+    def test_from_bytes_matches_hashlib(self):
+        data = b"hello kraken"
+        d = Digest.from_bytes(data)
+        assert d.hex == hashlib.sha256(data).hexdigest()
+        assert str(d) == f"sha256:{d.hex}"
+        assert d.raw == hashlib.sha256(data).digest()
+
+    def test_parse_roundtrip(self):
+        d = Digest.from_bytes(b"x")
+        assert Digest.parse(str(d)) == d
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "sha256",  # no separator
+            "md5:" + "a" * 32,  # wrong algo
+            "sha256:" + "a" * 63,  # short hex
+            "sha256:" + "A" * 64,  # uppercase rejected (canonical form only)
+            "sha256:" + "g" * 64,  # non-hex
+        ],
+    )
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(DigestError):
+            Digest.parse(bad)
+
+    def test_from_reader_streams(self):
+        data = blob_fixture(10 * 1024 * 1024 + 13, seed=1)
+        assert Digest.from_reader(io.BytesIO(data)) == Digest.from_bytes(data)
+
+    def test_digester_incremental(self):
+        d = Digester()
+        d.update(b"hello ")
+        d.update(b"world")
+        assert d.digest() == Digest.from_bytes(b"hello world")
+
+    def test_digester_tee(self):
+        d = Digester()
+        chunks = [b"ab", b"cd", b"ef"]
+        out = list(d.tee(iter(chunks)))
+        assert out == chunks
+        assert d.digest() == Digest.from_bytes(b"abcdef")
+
+    def test_hashable_and_ordered(self):
+        a, b = Digest.from_bytes(b"a"), Digest.from_bytes(b"b")
+        assert len({a, b, Digest.from_bytes(b"a")}) == 2
+        assert (a < b) != (b < a)
+
+
+class TestMetaInfo:
+    def test_num_pieces(self):
+        assert num_pieces(0, 4) == 0
+        assert num_pieces(1, 4) == 1
+        assert num_pieces(4, 4) == 1
+        assert num_pieces(5, 4) == 2
+
+    def test_piece_layout_with_ragged_tail(self):
+        blob = blob_fixture(10_000, seed=2)
+        mi = metainfo_fixture(blob, piece_length=4096)
+        assert mi.num_pieces == 3
+        assert mi.piece_length_of(0) == 4096
+        assert mi.piece_length_of(2) == 10_000 - 2 * 4096
+        with pytest.raises(IndexError):
+            mi.piece_length_of(3)
+
+    def test_verify_piece(self):
+        blob, mi = blob_and_metainfo_fixture(size=10_000, piece_length=4096, seed=3)
+        for i in range(mi.num_pieces):
+            piece = blob[i * 4096 : (i + 1) * 4096]
+            assert mi.verify_piece(i, piece)
+            assert not mi.verify_piece(i, piece[:-1])  # wrong length
+            if piece:
+                corrupted = bytes([piece[0] ^ 1]) + piece[1:]
+                assert not mi.verify_piece(i, corrupted)
+
+    def test_serialize_roundtrip(self):
+        _, mi = blob_and_metainfo_fixture(seed=4)
+        mi2 = MetaInfo.deserialize(mi.serialize())
+        assert mi2 == mi
+        assert mi2.info_hash == mi.info_hash
+
+    def test_info_hash_depends_on_content(self):
+        blob = blob_fixture(8192, seed=5)
+        a = metainfo_fixture(blob, piece_length=4096)
+        b = metainfo_fixture(blob, piece_length=8192)
+        assert a.info_hash != b.info_hash
+
+    def test_deserialize_rejects_garbage(self):
+        with pytest.raises(MetaInfoError):
+            MetaInfo.deserialize(b"not json")
+        with pytest.raises(MetaInfoError):
+            MetaInfo.deserialize(b'{"version": 99}')
+
+    def test_hash_count_validated(self):
+        blob = blob_fixture(8192, seed=6)
+        with pytest.raises(MetaInfoError):
+            MetaInfo(Digest.from_bytes(blob), len(blob), 4096, b"\x00" * 32)
+
+    def test_zero_length_blob(self):
+        mi = metainfo_fixture(b"", piece_length=4096)
+        assert mi.num_pieces == 0
+        assert MetaInfo.deserialize(mi.serialize()) == mi
+
+
+class TestPeer:
+    def test_addr_hash_deterministic(self):
+        f = PeerIDFactory(PeerIDFactory.ADDR_HASH)
+        assert f.create("10.0.0.1", 5000) == f.create("10.0.0.1", 5000)
+        assert f.create("10.0.0.1", 5000) != f.create("10.0.0.1", 5001)
+
+    def test_random_unique(self):
+        f = PeerIDFactory(PeerIDFactory.RANDOM)
+        assert f.create("10.0.0.1", 5000) != f.create("10.0.0.1", 5000)
+
+    def test_peer_info_roundtrip(self):
+        p = PeerInfo(PeerID("ab" * 20), "10.0.0.2", 1234, origin=True, complete=True)
+        assert PeerInfo.from_dict(p.to_dict()) == p
+        assert p.addr == "10.0.0.2:1234"
+
+    def test_blob_info_roundtrip(self):
+        assert BlobInfo.from_dict(BlobInfo(123).to_dict()) == BlobInfo(123)
+
+
+class TestCPUPieceHasher:
+    def test_matches_hashlib_ragged(self):
+        h = CPUPieceHasher()
+        blob = blob_fixture(10_000, seed=7)
+        out = h.hash_pieces(blob, 4096)
+        assert out.shape == (3, 32)
+        for i in range(3):
+            piece = blob[i * 4096 : (i + 1) * 4096]
+            assert out[i].tobytes() == hashlib.sha256(piece).digest()
+
+    def test_empty_blob(self):
+        assert CPUPieceHasher().hash_pieces(b"", 4096).shape == (0, 32)
+
+    def test_hash_batch(self):
+        h = CPUPieceHasher()
+        pieces = [b"a", b"bb", b"", blob_fixture(5000, seed=8)]
+        out = h.hash_batch(pieces)
+        assert out.shape == (4, 32)
+        for i, p in enumerate(pieces):
+            assert out[i].tobytes() == hashlib.sha256(p).digest()
+
+    def test_registry(self):
+        assert isinstance(get_hasher("cpu"), CPUPieceHasher)
+        assert get_hasher("cpu") is get_hasher("cpu")
+        with pytest.raises(KeyError):
+            get_hasher("nope")
