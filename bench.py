@@ -1,0 +1,76 @@
+"""North-star benchmark: batched SHA-256 piece hashing throughput.
+
+Measures the TPU metainfo-gen hot loop (BASELINE.json config #3: batched
+SHA-256 over 4 MiB pieces; target >= 20 GB/s/chip on v5e) and the CPU
+hashlib baseline (config #1), then prints ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+``vs_baseline`` is the TPU/CPU speedup -- the reference hashes pieces
+sequentially on the CPU (uber/kraken lib/metainfogen [UNVERIFIED]), so the
+measured CPU rate stands in for the reference baseline (BASELINE.json
+``published`` is empty; see BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PIECE_LEN = 4 * 1024 * 1024
+# Total bytes hashed per timed pass. Big enough to amortize dispatch, small
+# enough to run quickly on CPU fallback when no TPU is attached.
+TOTAL = int(os.environ.get("BENCH_TOTAL_BYTES", 512 * 1024 * 1024))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+
+
+def time_hasher(hasher, data: np.ndarray) -> float:
+    """Best-of-N GB/s for hashing ``data`` in PIECE_LEN pieces."""
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = hasher.hash_pieces(data, PIECE_LEN)
+        assert out.shape == ((len(data) + PIECE_LEN - 1) // PIECE_LEN, 32)
+        best = min(best, time.perf_counter() - t0)
+    return len(data) / best / 1e9
+
+
+def main() -> None:
+    from kraken_tpu.core.hasher import get_hasher
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=TOTAL, dtype=np.uint8).tobytes()
+
+    cpu_gbps = None
+    if os.environ.get("BENCH_SKIP_CPU") != "1":
+        # CPU baseline on a smaller slice (hashlib ~2 GB/s; keep it quick).
+        cpu_slice = data[: min(TOTAL, 256 * 1024 * 1024)]
+        cpu = get_hasher("cpu")
+        t0 = time.perf_counter()
+        cpu.hash_pieces(cpu_slice, PIECE_LEN)
+        cpu_gbps = len(cpu_slice) / (time.perf_counter() - t0) / 1e9
+
+    tpu = get_hasher("tpu")
+    # Warm up/compile the exact sub-batch shape the timed passes use.
+    per_batch = max(1, tpu._sub_batch_bytes // PIECE_LEN)
+    tpu.hash_pieces(data[: per_batch * PIECE_LEN], PIECE_LEN)
+    tpu_gbps = time_hasher(tpu, data)
+
+    print(
+        json.dumps(
+            {
+                "metric": "batched_sha256_metainfo_gen",
+                "value": round(tpu_gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(tpu_gbps / cpu_gbps, 3) if cpu_gbps else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""TPU compute plane: batched SHA-256, FastCDC chunking, MinHash dedup.
+
+These are the ops behind the north-star metrics (BASELINE.json): the
+``PieceHasher`` hot loops, content-defined chunking, and the near-duplicate
+index. Pure JAX / Pallas; no service code lives here.
+"""
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (>= 1). The shape-bucketing primitive:
+    jit caches stay bounded because every dynamic extent is rounded up."""
+    return 1 << max(0, (x - 1).bit_length())

@@ -1,0 +1,233 @@
+"""FastCDC content-defined chunking with the rolling-hash pass on TPU.
+
+Absent from the reference (SURVEY.md SS2.6 table): this is north-star new
+capability (BASELINE.json config #4) -- chunk Docker layers on content-
+defined boundaries so identical file content shifted by tar offsets still
+dedupes across layers.
+
+Algorithm (the framework's normative spec; the pure-Python
+:func:`chunk_reference` below is the golden oracle for tests):
+
+- 32-bit gear rolling hash: ``h_i = (h_{i-1} << 1) + GEAR[b_i]  (mod 2^32)``.
+  Because of the shift, ``h_i`` depends only on the last 32 bytes -- which is
+  what makes the TPU pass possible: every position's hash is a *windowed*
+  function, so all positions evaluate in parallel as 32 shifted adds over
+  the gather ``GEAR[data]``.
+- FastCDC normalized chunking: below the average chunk size a *strict* mask
+  must hit (fewer cuts), above it a *loose* mask (more cuts); hard
+  ``min_size``/``max_size`` bounds. Masks spread bits per the FastCDC paper
+  style; here: contiguous high bits of the 32-bit hash.
+
+Two-phase split (SURVEY.md SS7 hard part #4): the TPU computes the rolling
+hash and both mask tests for *every* offset in one vector pass (the O(bytes)
+work); the host then walks the resulting sparse candidate list applying the
+sequential min/avg/max cut policy (O(cuts) work, ~bytes/avg_size items).
+The phases compose to exactly the sequential algorithm because the cut
+policy never looks at hashes, only candidate positions -- proven against
+``chunk_reference`` in tests/test_cdc.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kraken_tpu.ops import next_pow2
+
+_WINDOW = 32  # bytes of history in a 32-bit gear hash
+
+# Deterministic 256-entry gear table: framework constant, must never change
+# (chunk boundaries are a persistent on-disk contract once dedup metadata is
+# written). Generated from SHA-256 of the entry index.
+def _make_gear() -> np.ndarray:
+    import hashlib
+
+    out = np.empty(256, dtype=np.uint32)
+    for i in range(256):
+        out[i] = int.from_bytes(
+            hashlib.sha256(b"kraken-tpu-gear-%d" % i).digest()[:4], "big"
+        )
+    return out
+
+
+GEAR = _make_gear()
+
+
+@dataclasses.dataclass(frozen=True)
+class CDCParams:
+    """Chunking parameters. ``avg_size`` must be a power of two."""
+
+    min_size: int = 16 * 1024
+    avg_size: int = 64 * 1024
+    max_size: int = 256 * 1024
+    # Normalization level: strict mask has (log2(avg) + nc) bits, loose has
+    # (log2(avg) - nc). nc=2 per the FastCDC paper's recommendation.
+    norm: int = 2
+
+    def __post_init__(self):
+        if self.avg_size & (self.avg_size - 1):
+            raise ValueError(f"avg_size must be a power of two: {self.avg_size}")
+        if not self.min_size <= self.avg_size <= self.max_size:
+            raise ValueError("require min_size <= avg_size <= max_size")
+        if self.min_size < _WINDOW:
+            # Below this the vectorized pass (full 32-byte history at every
+            # offset) and the sequential reference (hash restarts per chunk)
+            # could disagree near chunk starts.
+            raise ValueError(f"min_size must be >= {_WINDOW}: {self.min_size}")
+
+    @property
+    def bits(self) -> int:
+        return self.avg_size.bit_length() - 1
+
+    @property
+    def mask_strict(self) -> int:
+        return _top_mask(self.bits + self.norm)
+
+    @property
+    def mask_loose(self) -> int:
+        return _top_mask(self.bits - self.norm)
+
+
+def _top_mask(nbits: int) -> int:
+    """A mask of ``nbits`` high bits of a uint32."""
+    nbits = max(0, min(32, nbits))
+    return ((1 << nbits) - 1) << (32 - nbits) & 0xFFFFFFFF
+
+
+# -- pure-Python reference (golden oracle; O(n) python -- tests only) -------
+
+
+def chunk_reference(data: bytes, params: CDCParams = CDCParams()) -> list[int]:
+    """Sequential FastCDC. Returns chunk end offsets (exclusive)."""
+    cuts = []
+    n = len(data)
+    start = 0
+    while start < n:
+        end = _next_cut_reference(data, start, n, params)
+        cuts.append(end)
+        start = end
+    return cuts
+
+
+def _next_cut_reference(data: bytes, start: int, n: int, p: CDCParams) -> int:
+    remaining = n - start
+    if remaining <= p.min_size:
+        return n
+    h = 0
+    limit = min(remaining, p.max_size)
+    norm_point = min(p.avg_size, limit)
+    # Hash accumulates from the chunk start (matching the vector pass, which
+    # has full history; the first min_size bytes are hashed but uncuttable).
+    for i in range(limit):
+        h = ((h << 1) + int(GEAR[data[start + i]])) & 0xFFFFFFFF
+        if i + 1 <= p.min_size:
+            continue
+        mask = p.mask_strict if i + 1 <= norm_point else p.mask_loose
+        if (h & mask) == 0:
+            return start + i + 1
+    return start + limit
+
+
+# -- TPU vector pass --------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
+def _gear_candidates(data_u8: jax.Array, mask_s: int, mask_l: int):
+    """Rolling gear hash at every offset + both mask tests.
+
+    data_u8: [L] uint8. Returns (strict, loose): [L] bool arrays where
+    ``strict[i]`` means the hash of the 32-byte window ending at ``i``
+    (inclusive) hits the strict mask.
+
+    The windowed form: h_i = sum_{j=0..31} GEAR[b_{i-j}] << j. Computed as
+    32 shifted adds over the gathered table -- pure VPU work, no
+    sequential dependence.
+    """
+    g = jnp.asarray(GEAR)[data_u8.astype(jnp.int32)]  # [L] uint32
+    h = g
+    for j in range(1, min(_WINDOW, data_u8.shape[0])):
+        # shift the gather right by j: h_i += GEAR[b_{i-j}] << j
+        rolled = jnp.concatenate([jnp.zeros(j, dtype=jnp.uint32), g[:-j]])
+        h = h + (rolled << np.uint32(j))
+    strict = (h & np.uint32(mask_s)) == 0
+    loose = (h & np.uint32(mask_l)) == 0
+    return strict, loose
+
+
+def _host_select_cuts(
+    strict_idx: np.ndarray, loose_idx: np.ndarray, n: int, p: CDCParams
+) -> list[int]:
+    """Sequential cut selection over sparse candidate positions.
+
+    ``strict_idx``/``loose_idx`` hold positions i where the mask hit; a cut
+    at position i ends a chunk at offset i+1. Equivalence with the
+    sequential reference holds because candidates are only taken at offsets
+    > min_size >= _WINDOW past the chunk start, where the 32-byte gear
+    window lies entirely inside the current chunk -- so the full-history
+    hash of the vector pass equals the restarted hash of the reference.
+    """
+    cuts: list[int] = []
+    start = 0
+    while start < n:
+        remaining = n - start
+        if remaining <= p.min_size:
+            cuts.append(n)
+            break
+        limit = min(remaining, p.max_size)
+        norm_point = min(p.avg_size, limit)
+        # strict zone: offsets (start+min_size, start+norm_point]
+        lo = np.searchsorted(strict_idx, start + p.min_size)
+        hi = np.searchsorted(strict_idx, start + norm_point - 1, side="right")
+        if lo < hi:
+            end = int(strict_idx[lo]) + 1
+        else:
+            # loose zone: offsets (start+norm_point, start+limit]
+            lo = np.searchsorted(loose_idx, start + norm_point)
+            hi = np.searchsorted(loose_idx, start + limit - 1, side="right")
+            end = int(loose_idx[lo]) + 1 if lo < hi else start + limit
+        cuts.append(end)
+        start = end
+    return cuts
+
+
+def chunk(data: bytes | memoryview, params: CDCParams = CDCParams()) -> list[int]:
+    """Content-defined chunk boundaries (end offsets, exclusive).
+
+    TPU vector pass for the hashes + host scan for the cut policy; exactly
+    equal to :func:`chunk_reference`.
+    """
+    view = memoryview(data)
+    n = len(view)
+    if n == 0:
+        return []
+    # Bucket the length to the next power of two (zero-padded) so the jit
+    # cache stays small across arbitrary blob sizes; padding positions are
+    # dropped below. Zero-pad bytes cannot create in-range candidates
+    # because only positions < n are kept.
+    arr = np.frombuffer(view, dtype=np.uint8)
+    padded = next_pow2(n)
+    if padded != n:
+        arr = np.concatenate([arr, np.zeros(padded - n, dtype=np.uint8)])
+    strict, loose = _gear_candidates(
+        jnp.asarray(arr), params.mask_strict, params.mask_loose
+    )
+    strict_idx = np.flatnonzero(np.asarray(strict)[:n])
+    loose_idx = np.flatnonzero(np.asarray(loose)[:n])
+    return _host_select_cuts(strict_idx, loose_idx, n, params)
+
+
+def chunk_spans(
+    data: bytes | memoryview, params: CDCParams = CDCParams()
+) -> list[tuple[int, int]]:
+    """(start, end) spans for each chunk."""
+    cuts = chunk(data, params)
+    spans = []
+    start = 0
+    for end in cuts:
+        spans.append((start, end))
+        start = end
+    return spans

@@ -1,0 +1,195 @@
+"""MinHash sketches + LSH banding: the cross-layer near-duplicate index.
+
+Absent from the reference (SURVEY.md SS2.6): north-star new capability
+(BASELINE.json config #5). Each Docker layer is represented by the *set* of
+its content-defined chunk fingerprints (from :mod:`kraken_tpu.ops.cdc` +
+the SHA-256 plane); near-duplicate layers are found by MinHash similarity
+search so the origin can dedup storage and preheat caches.
+
+Math: for a random hash h, P[min_h(A) == min_h(B)] = Jaccard(A, B). A
+K-coordinate sketch estimates Jaccard with stderr ~ 1/sqrt(K). The TPU part
+is the sketching -- K universal hashes h_k(x) = a_k * x + b_k (mod 2^32,
+a_k odd) evaluated over every fingerprint and min-reduced, batched over
+layers: one [B, M, K]-shaped vector op instead of a per-layer Python loop.
+Candidate retrieval uses classic LSH banding on the host (dict buckets --
+pointer-chasing, not TPU work); final scoring (estimated Jaccard between a
+query sketch and the full sketch matrix) is again one TPU op: a [N, K]
+equality-mean reduce.
+
+Fingerprints are uint32 (first 4 bytes of each chunk's SHA-256). At 1M
+chunks per corpus the birthday collision count (~100) is noise at MinHash's
+estimation accuracy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Hashable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kraken_tpu.ops import next_pow2 as _next_pow2
+
+
+def fingerprints_from_digests(digests: np.ndarray) -> np.ndarray:
+    """[N, 32] uint8 chunk digests -> [N] uint32 fingerprints (deduped)."""
+    if digests.size == 0:
+        return np.empty(0, dtype=np.uint32)
+    fp = np.ascontiguousarray(digests[:, :4]).view(">u4").reshape(-1)
+    return np.unique(fp.astype(np.uint32))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _sketch_kernel(fps: jax.Array, mask: jax.Array, a: jax.Array, b: jax.Array):
+    """fps [B, M] uint32, mask [B, M] bool, a/b [K] uint32 -> [B, K] uint32.
+
+    h_k(x) = a_k * x + b_k (mod 2^32); masked slots contribute the min
+    identity. The [B, M, K] intermediate never materializes in HBM -- XLA
+    fuses the multiply-add into the min reduction.
+    """
+    hashed = fps[:, :, None] * a[None, None, :] + b[None, None, :]  # [B,M,K]
+    hashed = jnp.where(mask[:, :, None], hashed, jnp.uint32(0xFFFFFFFF))
+    return jnp.min(hashed, axis=1)
+
+
+@jax.jit
+def _score_kernel(query: jax.Array, corpus: jax.Array):
+    """query [K] uint32 vs corpus [N, K] -> [N] float32 estimated Jaccard."""
+    return jnp.mean((corpus == query[None, :]).astype(jnp.float32), axis=1)
+
+
+def _score(query: np.ndarray, corpus: np.ndarray) -> np.ndarray:
+    """Shape-bucketed wrapper over :func:`_score_kernel` (pads N to a power
+    of two so candidate-count churn doesn't retrace)."""
+    n = corpus.shape[0]
+    nb = _next_pow2(max(1, n))
+    if nb != n:
+        corpus = np.concatenate(
+            [corpus, np.zeros((nb - n, corpus.shape[1]), dtype=corpus.dtype)]
+        )
+    return np.asarray(_score_kernel(jnp.asarray(query), jnp.asarray(corpus)))[:n]
+
+
+class MinHasher:
+    """K-coordinate MinHash sketcher with deterministic seeded hash params."""
+
+    def __init__(self, num_hashes: int = 128, seed: int = 0):
+        if num_hashes <= 0:
+            raise ValueError("num_hashes must be positive")
+        self.num_hashes = num_hashes
+        rng = np.random.default_rng(seed)
+        self._a = (rng.integers(0, 1 << 32, size=num_hashes, dtype=np.uint64) | 1).astype(
+            np.uint32
+        )
+        self._b = rng.integers(0, 1 << 32, size=num_hashes, dtype=np.uint64).astype(
+            np.uint32
+        )
+
+    def sketch(self, fingerprints: np.ndarray) -> np.ndarray:
+        """[M] uint32 -> [K] uint32 sketch. Empty set -> all-0xFFFFFFFF."""
+        return self.sketch_batch([fingerprints])[0]
+
+    def sketch_batch(self, sets: Sequence[np.ndarray]) -> np.ndarray:
+        """Sketch a batch of fingerprint sets -> [B, K] uint32.
+
+        Sets are padded to a shared power-of-two M (jit-cache bounded) with
+        masked slots.
+        """
+        if not sets:
+            return np.empty((0, self.num_hashes), dtype=np.uint32)
+        b = len(sets)
+        bb = _next_pow2(b)  # bucket both axes: bounded jit cache
+        m = _next_pow2(max(1, max(len(s) for s in sets)))
+        fps = np.zeros((bb, m), dtype=np.uint32)
+        mask = np.zeros((bb, m), dtype=bool)
+        for i, s in enumerate(sets):
+            fps[i, : len(s)] = s
+            mask[i, : len(s)] = True
+        out = _sketch_kernel(
+            jnp.asarray(fps), jnp.asarray(mask), jnp.asarray(self._a), jnp.asarray(self._b)
+        )
+        return np.asarray(out)[:b]
+
+
+def estimate_jaccard(sketch_a: np.ndarray, sketch_b: np.ndarray) -> float:
+    """Fraction of matching coordinates ~ Jaccard(A, B)."""
+    return float(np.mean(sketch_a == sketch_b))
+
+
+class LSHIndex:
+    """Banded LSH over MinHash sketches: O(1)-ish candidate retrieval.
+
+    ``num_bands`` bands of ``K / num_bands`` rows; two sets collide in a
+    band with probability J^rows, so the S-curve threshold sits near
+    (1/num_bands)^(1/rows). Defaults (128 hashes, 32 bands, 4 rows) put the
+    knee around J ~ 0.42.
+    """
+
+    def __init__(self, hasher: MinHasher, num_bands: int = 32):
+        if hasher.num_hashes % num_bands:
+            raise ValueError(
+                f"num_bands {num_bands} must divide num_hashes {hasher.num_hashes}"
+            )
+        self.hasher = hasher
+        self.num_bands = num_bands
+        self.rows = hasher.num_hashes // num_bands
+        self._buckets: list[dict[bytes, list[int]]] = [{} for _ in range(num_bands)]
+        self._keys: list[Hashable] = []
+        self._sketches: list[np.ndarray] = []
+        self._corpus: np.ndarray | None = None  # rebuilt lazily on query
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def add(self, key: Hashable, sketch: np.ndarray) -> None:
+        idx = len(self._keys)
+        self._keys.append(key)
+        self._sketches.append(np.asarray(sketch, dtype=np.uint32))
+        self._corpus = None
+        for band, bucket in enumerate(self._buckets):
+            sig = self._sketches[idx][band * self.rows : (band + 1) * self.rows].tobytes()
+            bucket.setdefault(sig, []).append(idx)
+
+    def candidates(self, sketch: np.ndarray) -> set[int]:
+        """Indices sharing at least one band signature with ``sketch``."""
+        sketch = np.asarray(sketch, dtype=np.uint32)
+        out: set[int] = set()
+        for band, bucket in enumerate(self._buckets):
+            sig = sketch[band * self.rows : (band + 1) * self.rows].tobytes()
+            out.update(bucket.get(sig, ()))
+        return out
+
+    def query(
+        self, sketch: np.ndarray, k: int = 10, min_jaccard: float = 0.0
+    ) -> list[tuple[Hashable, float]]:
+        """Top-k (key, estimated Jaccard) among LSH candidates."""
+        cand = sorted(self.candidates(sketch))
+        if not cand:
+            return []
+        if self._corpus is None:
+            self._corpus = np.stack(self._sketches)
+        scores = _score(np.asarray(sketch, dtype=np.uint32), self._corpus[cand])
+        order = np.argsort(-scores)[:k]
+        return [
+            (self._keys[cand[i]], float(scores[i]))
+            for i in order
+            if scores[i] >= min_jaccard
+        ]
+
+    def query_brute(
+        self, sketch: np.ndarray, k: int = 10
+    ) -> list[tuple[Hashable, float]]:
+        """Top-k against the *entire* corpus (no LSH) -- one [N, K] TPU op.
+
+        Exact over sketches; used when recall matters more than latency and
+        as the oracle for LSH recall tests.
+        """
+        if not self._keys:
+            return []
+        if self._corpus is None:
+            self._corpus = np.stack(self._sketches)
+        scores = _score(np.asarray(sketch, dtype=np.uint32), self._corpus)
+        order = np.argsort(-scores)[:k]
+        return [(self._keys[i], float(scores[i])) for i in order]

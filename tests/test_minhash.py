@@ -1,0 +1,111 @@
+"""MinHash/LSH tests: estimator accuracy vs brute-force Jaccard, LSH recall,
+determinism. SURVEY.md SS4 tier 5."""
+
+import numpy as np
+import pytest
+
+from kraken_tpu.ops.minhash import (
+    LSHIndex,
+    MinHasher,
+    estimate_jaccard,
+    fingerprints_from_digests,
+)
+
+
+def make_set(rng, size):
+    return np.unique(rng.integers(0, 1 << 32, size=size, dtype=np.uint64).astype(np.uint32))
+
+
+def true_jaccard(a, b):
+    sa, sb = set(a.tolist()), set(b.tolist())
+    if not sa and not sb:
+        return 1.0
+    return len(sa & sb) / len(sa | sb)
+
+
+def overlapping_pair(rng, n, overlap):
+    base = make_set(rng, n)
+    shared = base[: int(n * overlap)]
+    extra_a = make_set(rng, n - len(shared))
+    extra_b = make_set(rng, n - len(shared))
+    return np.union1d(shared, extra_a), np.union1d(shared, extra_b)
+
+
+def test_estimator_tracks_jaccard():
+    rng = np.random.default_rng(0)
+    mh = MinHasher(num_hashes=256, seed=1)
+    for overlap in (0.0, 0.3, 0.6, 0.9):
+        a, b = overlapping_pair(rng, 2000, overlap)
+        j = true_jaccard(a, b)
+        sk = mh.sketch_batch([a, b])
+        est = estimate_jaccard(sk[0], sk[1])
+        # stderr ~ sqrt(j(1-j)/256) <= 0.031; allow 4 sigma.
+        assert abs(est - j) < 0.13, (overlap, j, est)
+
+
+def test_identical_sets_score_one():
+    rng = np.random.default_rng(1)
+    mh = MinHasher()
+    a = make_set(rng, 500)
+    sk1, sk2 = mh.sketch(a), mh.sketch(a.copy())
+    assert estimate_jaccard(sk1, sk2) == 1.0
+
+
+def test_sketch_deterministic_across_instances():
+    rng = np.random.default_rng(2)
+    a = make_set(rng, 100)
+    assert np.array_equal(MinHasher(seed=7).sketch(a), MinHasher(seed=7).sketch(a))
+    assert not np.array_equal(MinHasher(seed=7).sketch(a), MinHasher(seed=8).sketch(a))
+
+
+def test_sketch_batch_padding_invariant():
+    """A set's sketch must not depend on what else is in the batch."""
+    rng = np.random.default_rng(3)
+    a, b = make_set(rng, 10), make_set(rng, 1000)
+    mh = MinHasher()
+    alone = mh.sketch(a)
+    batched = mh.sketch_batch([a, b])[0]
+    assert np.array_equal(alone, batched)
+
+
+def test_lsh_recall_vs_brute_force():
+    """LSH candidates must recover the high-similarity neighbors that brute
+    force finds (BASELINE.json config #5)."""
+    rng = np.random.default_rng(4)
+    mh = MinHasher(num_hashes=128, seed=0)
+    index = LSHIndex(mh, num_bands=32)
+
+    base = make_set(rng, 1500)
+    sets = {}
+    # 20 near-dups of base at ~0.75 overlap, 200 unrelated sets.
+    for i in range(20):
+        extra = make_set(rng, 300)
+        sets[f"near{i}"] = np.union1d(base[:1200], extra)
+    for i in range(200):
+        sets[f"rand{i}"] = make_set(rng, 1500)
+
+    names = list(sets)
+    sketches = mh.sketch_batch([sets[n] for n in names])
+    for n, sk in zip(names, sketches):
+        index.add(n, sk)
+
+    q = mh.sketch(base)
+    brute = {k for k, s in index.query_brute(q, k=20) if s > 0.4}
+    lsh = {k for k, _ in index.query(q, k=20, min_jaccard=0.4)}
+    assert brute, "brute force found no neighbors -- test setup broken"
+    recall = len(brute & lsh) / len(brute)
+    assert recall >= 0.9, (recall, brute - lsh)
+    # And the random sets stay out.
+    assert not any(k.startswith("rand") for k in lsh)
+
+
+def test_fingerprints_from_digests():
+    digests = np.arange(64, dtype=np.uint8).reshape(2, 32)
+    fp = fingerprints_from_digests(digests)
+    assert fp.dtype == np.uint32 and len(fp) == 2
+    assert fingerprints_from_digests(np.empty((0, 32), dtype=np.uint8)).size == 0
+
+
+def test_bands_must_divide():
+    with pytest.raises(ValueError):
+        LSHIndex(MinHasher(num_hashes=100), num_bands=32)
