@@ -1,0 +1,30 @@
+"""Pluggable storage backends (S3/GCS/HDFS-class remote stores).
+
+Mirrors uber/kraken ``lib/backend`` (``Client`` {Stat, Download, Upload,
+List}, self-registering factories, ``Manager`` resolving namespace regex ->
+client with per-backend bandwidth caps) -- upstream path, unverified;
+SURVEY.md SS2.3. The origin writes back committed blobs here and fills
+cache misses from here; build-index persists tags here.
+"""
+
+from kraken_tpu.backend.base import (
+    BackendClient,
+    BackendError,
+    BlobNotFoundError,
+    Manager,
+    register_backend,
+)
+
+__all__ = [
+    "BackendClient",
+    "BackendError",
+    "BlobNotFoundError",
+    "Manager",
+    "register_backend",
+]
+
+# Import for registration side effects.
+import kraken_tpu.backend.filebackend  # noqa: E402,F401
+import kraken_tpu.backend.httpbackend  # noqa: E402,F401
+import kraken_tpu.backend.testfs  # noqa: E402,F401
+import kraken_tpu.backend.shadowbackend  # noqa: E402,F401

@@ -1,0 +1,142 @@
+"""Backend interface, registry, and the namespace->client manager."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional
+
+from kraken_tpu.utils.bandwidth import TokenBucket
+
+
+class BackendError(Exception):
+    pass
+
+
+class BlobNotFoundError(BackendError):
+    """Named blob absent in the backend."""
+
+
+class BlobInfo:
+    __slots__ = ("size",)
+
+    def __init__(self, size: int):
+        self.size = size
+
+
+class BackendClient:
+    """Async client for one remote store.
+
+    Names are backend-relative paths (the pather in
+    :mod:`kraken_tpu.backend.namepath` maps digests/tags to them).
+    """
+
+    async def stat(self, namespace: str, name: str) -> BlobInfo:
+        raise NotImplementedError
+
+    async def download(self, namespace: str, name: str) -> bytes:
+        raise NotImplementedError
+
+    async def upload(self, namespace: str, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+_REGISTRY: Dict[str, Callable[[dict], BackendClient]] = {}
+
+
+def register_backend(name: str):
+    """Decorator: register a backend factory under ``name`` (the YAML
+    ``backend:`` key, same plugin pattern as the hasher registry)."""
+
+    def deco(factory: Callable[[dict], BackendClient]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def make_backend(name: str, config: dict | None = None) -> BackendClient:
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown backend {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(config or {})
+
+
+class _ThrottledClient(BackendClient):
+    """Wraps a client with ingress/egress token buckets (bytes/sec)."""
+
+    def __init__(self, inner: BackendClient, ingress_bps: float, egress_bps: float):
+        self._inner = inner
+        self._ingress = TokenBucket(ingress_bps)
+        self._egress = TokenBucket(egress_bps)
+
+    async def stat(self, namespace: str, name: str) -> BlobInfo:
+        return await self._inner.stat(namespace, name)
+
+    async def download(self, namespace: str, name: str) -> bytes:
+        data = await self._inner.download(namespace, name)
+        await self._ingress.acquire(len(data))
+        return data
+
+    async def upload(self, namespace: str, name: str, data: bytes) -> None:
+        await self._egress.acquire(len(data))
+        await self._inner.upload(namespace, name, data)
+
+    async def list(self, prefix: str) -> list[str]:
+        return await self._inner.list(prefix)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+class Manager:
+    """Resolves a namespace to its backend client.
+
+    Config shape (YAML-mirrored):
+
+        backends:
+          - namespace: "library/.*"
+            backend: testfs
+            config: {addr: "localhost:9000"}
+            bandwidth: {ingress_bps: 0, egress_bps: 0}
+
+    First matching entry wins, as in the reference.
+    """
+
+    def __init__(self, entries: list[dict] | None = None):
+        self._entries: list[tuple[re.Pattern, BackendClient]] = []
+        for e in entries or []:
+            client = make_backend(e["backend"], e.get("config"))
+            bw = e.get("bandwidth") or {}
+            if bw.get("ingress_bps") or bw.get("egress_bps"):
+                client = _ThrottledClient(
+                    client, bw.get("ingress_bps", 0), bw.get("egress_bps", 0)
+                )
+            self.register(e["namespace"], client)
+
+    def register(self, namespace_pattern: str, client: BackendClient) -> None:
+        self._entries.append((re.compile(namespace_pattern + r"\Z"), client))
+
+    def get_client(self, namespace: str) -> BackendClient:
+        for pattern, client in self._entries:
+            if pattern.match(namespace):
+                return client
+        raise KeyError(f"no backend configured for namespace {namespace!r}")
+
+    def try_get_client(self, namespace: str) -> Optional[BackendClient]:
+        try:
+            return self.get_client(namespace)
+        except KeyError:
+            return None
+
+    async def close(self) -> None:
+        for _p, c in self._entries:
+            await c.close()

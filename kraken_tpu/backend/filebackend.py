@@ -1,0 +1,60 @@
+"""Local-filesystem backend: the simplest durable store.
+
+No direct reference analog (the reference's closest is testfs); used for
+single-host deployments and as the default herd backend when no object
+store exists.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kraken_tpu.backend.base import (
+    BackendClient,
+    BlobInfo,
+    BlobNotFoundError,
+    register_backend,
+)
+from kraken_tpu.backend.namepath import get_pather
+
+
+@register_backend("file")
+class FileBackend(BackendClient):
+    def __init__(self, config: dict):
+        self.root = config["root"]
+        self._pather = get_pather(config.get("pather", "identity"))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, self._pather("", name))
+
+    async def stat(self, namespace: str, name: str) -> BlobInfo:
+        try:
+            return BlobInfo(os.path.getsize(self._path(name)))
+        except FileNotFoundError:
+            raise BlobNotFoundError(name) from None
+
+    async def download(self, namespace: str, name: str) -> bytes:
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise BlobNotFoundError(name) from None
+
+    async def upload(self, namespace: str, name: str, data: bytes) -> None:
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    async def list(self, prefix: str) -> list[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)

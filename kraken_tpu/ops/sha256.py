@@ -205,8 +205,15 @@ class JaxPieceHasher(PieceHasher):
 
     name = "tpu"
 
-    def __init__(self, sub_batch_bytes: int = 256 * 1024 * 1024):
+    def __init__(
+        self, sub_batch_bytes: int = 256 * 1024 * 1024, use_pallas: bool | None = None
+    ):
         self._sub_batch_bytes = sub_batch_bytes
+        if use_pallas is None:
+            # The Pallas kernel is the tuned path on real accelerators; the
+            # portable XLA scan is faster than interpret-mode on CPU.
+            use_pallas = jax.default_backend() != "cpu"
+        self._use_pallas = use_pallas
 
     # -- blob -> per-piece digests (origin metainfo-gen hot loop) ----------
 
@@ -238,9 +245,16 @@ class JaxPieceHasher(PieceHasher):
                     chunk = np.concatenate(
                         [chunk, np.zeros((gb - g, piece_length), dtype=np.uint8)]
                     )
-                outs.append(
-                    _sha256_uniform(jnp.asarray(chunk), pad, piece_length // 64)[:g]
-                )
+                if self._use_pallas:
+                    from kraken_tpu.ops.sha256_pallas import hash_pieces_device
+
+                    outs.append(
+                        hash_pieces_device(jnp.asarray(chunk), piece_length)[:g]
+                    )
+                else:
+                    outs.append(
+                        _sha256_uniform(jnp.asarray(chunk), pad, piece_length // 64)[:g]
+                    )
             tail = [view[i * piece_length : total] for i in range(n_full, n)]
         else:
             # Odd piece length: everything through the ragged path.

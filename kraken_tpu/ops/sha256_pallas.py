@@ -1,0 +1,178 @@
+"""Pallas TPU kernel for batched SHA-256 -- the tuned metainfo-gen path.
+
+Why a kernel (SURVEY.md SS7 hard part #1): the portable XLA scan in
+:mod:`kraken_tpu.ops.sha256` pays a loop-iteration overhead per 64-byte
+block (the carry bounces through HBM and every iteration is a separate
+fused-kernel launch), which caps throughput far below the VPU's integer
+rate. Here the whole block chain runs inside one ``pallas_call``:
+
+- grid = (piece_tiles, blocks). Pallas revisits the same output block for
+  every ``b`` step of a tile, so the running [8, N] hash state lives in
+  VMEM for the whole chain -- written back to HBM once per tile.
+- the input is pre-packed (one XLA transpose) to [T, B, 16, N] uint32 so
+  each grid step's DMA is one contiguous [16, N] slab (64 KiB at N=1024);
+  Pallas double-buffers these loads against compute automatically.
+- the 48 schedule extensions + 64 rounds are fully unrolled straight-line
+  vector ops on [N]-wide uint32 lanes (N=1024 = a full 8x128 VPU tile per
+  op). Unlike XLA:CPU, Mosaic compiles the ~1300-op body without
+  pathological simplification passes.
+
+All parallelism is cross-piece: SHA-256's chain serializes blocks within a
+piece, so pieces are the batch axis and the block axis is the grid's inner
+sequential dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kraken_tpu.ops.sha256 import _H0, _K, _pack_be_u32, _pad_block_for
+
+# Pieces per grid tile, laid out as an explicit (sublane, lane) = (8, 128)
+# VPU tile so every round op maps to whole vector registers. VMEM per grid
+# step: in block KB*16*N*4 = 512 KiB (x2 double buffer) + state 32 KiB.
+_SUB = 8
+_LANES = 128
+N_TILE = _SUB * _LANES
+# Blocks folded per grid step: amortizes per-step pipeline overhead (the
+# block chain is ~16k steps/tile for 4 MiB pieces if KB=1).
+_KB = 8
+
+
+def _rotr(x, n):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _make_sha256_kernel(nb_real: int):
+    """Build the grid-step kernel for a chain of ``nb_real`` blocks.
+
+    Each step folds ``_KB`` consecutive blocks of every piece in tile ``t``
+    into the running state. blk_ref: [1, _KB, 16, 8, 128]; out_ref:
+    [1, 8, 8, 128] (revisited across the block-group axis -- carries the
+    state in VMEM).
+
+    The message schedule runs as a 16-word ring interleaved into the
+    rounds (w[i+16] = w[i] + s0(w[i+1]) + w[i+9] + s1(w[i+14]) computed in
+    place right after round i consumes w[i]), keeping ~24 vector registers
+    live instead of 72 -- a fully materialized 64-entry schedule spills.
+    """
+
+    def kernel(blk_ref, out_ref):
+        b = pl.program_id(1)
+
+        @pl.when(b == 0)
+        def _init():
+            for i in range(8):
+                out_ref[0, i, :, :] = jnp.full((_SUB, _LANES), _H0[i], jnp.uint32)
+
+        state = [out_ref[0, i, :, :] for i in range(8)]
+        for kb in range(_KB):
+            w = [blk_ref[0, kb, j, :, :] for j in range(16)]
+            a, bb, c, d, e, f, g, h = state
+            for i in range(64):
+                wi = w[i % 16]
+                s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+                ch = (e & f) ^ (~e & g)
+                t1 = h + s1 + ch + np.uint32(_K[i]) + wi
+                s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+                maj = (a & bb) ^ (a & c) ^ (bb & c)
+                a, bb, c, d, e, f, g, h = t1 + s0 + maj, a, bb, c, d + t1, e, f, g
+                if i < 48:
+                    w15 = w[(i + 1) % 16]
+                    w2 = w[(i + 14) % 16]
+                    e0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+                    e1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+                    w[i % 16] = wi + e0 + w[(i + 9) % 16] + e1
+            if (nb_real % _KB) and kb >= nb_real % _KB:
+                # Zero-padding blocks past the real chain must not fold in.
+                # kb position is only padding in the LAST group; elsewhere
+                # it's always real (static bound check keeps it free).
+                valid = (b + 1) * _KB <= nb_real
+                new = [jnp.where(valid, s + v, s)
+                       for s, v in zip(state, (a, bb, c, d, e, f, g, h))]
+            else:
+                new = [s + v for s, v in zip(state, (a, bb, c, d, e, f, g, h))]
+            state = new
+
+        for i in range(8):
+            out_ref[0, i, :, :] = state[i]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("unpadded_blocks",))
+def sha256_tiles(data_u8: jax.Array, pad_block: jax.Array, unpadded_blocks: int):
+    """Hash T*N_TILE equal-length pieces on the Pallas path.
+
+    data_u8: [M, P] uint8 with M % N_TILE == 0 and P = unpadded_blocks * 64;
+    pad_block: [16] uint32 shared SHA padding block. Returns [M, 8] uint32.
+    """
+    m = data_u8.shape[0]
+    t = m // N_TILE
+    nb = unpadded_blocks + 1  # + shared padding block
+
+    # Pack bytes to big-endian words and lay out [T, B, 16, 8, 128] so the
+    # kernel's per-step DMA is contiguous and each word is a full VPU tile.
+    words = _pack_be_u32(data_u8.reshape(m, unpadded_blocks, 64))  # [M, B0, 16]
+    words = words.reshape(t, N_TILE, unpadded_blocks, 16).transpose(0, 2, 3, 1)
+    words = words.reshape(t, unpadded_blocks, 16, _SUB, _LANES)
+    pad = jnp.broadcast_to(
+        pad_block[None, None, :, None, None], (t, 1, 16, _SUB, _LANES)
+    )
+    words = jnp.concatenate([words, pad], axis=1)  # [T, B, 16, 8, 128]
+
+    # Pad the block axis to whole _KB groups (kernel skips the zero blocks).
+    ngroups = (nb + _KB - 1) // _KB
+    if ngroups * _KB != nb:
+        words = jnp.concatenate(
+            [
+                words,
+                jnp.zeros((t, ngroups * _KB - nb, 16, _SUB, _LANES), jnp.uint32),
+            ],
+            axis=1,
+        )
+
+    out = pl.pallas_call(
+        _make_sha256_kernel(nb),
+        # Interpret mode on CPU: the kernel logic stays testable on the
+        # virtual-device suite; real TPUs compile via Mosaic.
+        interpret=jax.default_backend() == "cpu",
+        grid=(t, ngroups),
+        in_specs=[
+            pl.BlockSpec(
+                (1, _KB, 16, _SUB, _LANES), lambda ti, bi: (ti, bi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, _SUB, _LANES), lambda ti, bi: (ti, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, 8, _SUB, _LANES), jnp.uint32),
+    )(words)
+    return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(m, 8)
+
+
+def hash_pieces_device(data_u8: jax.Array, piece_length: int) -> jax.Array:
+    """Device-resident uniform-piece hashing via the kernel.
+
+    data_u8: [M, piece_length] uint8 (any M -- padded up to N_TILE
+    internally); returns [M, 8] uint32 digest words. piece_length must be a
+    multiple of 64.
+    """
+    if piece_length % 64:
+        raise ValueError("pallas path requires piece_length % 64 == 0")
+    m = data_u8.shape[0]
+    pad_rows = (-m) % N_TILE
+    if pad_rows:
+        data_u8 = jnp.concatenate(
+            [data_u8, jnp.zeros((pad_rows, piece_length), dtype=jnp.uint8)]
+        )
+    pad = jnp.asarray(_pad_block_for(piece_length))
+    return sha256_tiles(data_u8, pad, piece_length // 64)[:m]

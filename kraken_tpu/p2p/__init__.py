@@ -1,0 +1,10 @@
+"""The P2P plane: wire protocol, conns, dispatch, scheduler, torrent storage.
+
+Mirrors uber/kraken ``lib/torrent/*`` (SURVEY.md SS2.2): the swarm that
+fans a blob out through a dynamically-formed peer mesh with piece-level
+pipelining. The public surface is one blocking call --
+``Scheduler.download(namespace, digest)`` -- plus seeding-by-existence for
+origins. Rebuilt on asyncio: the reference's single-goroutine event loop
+invariant (all torrent state owned by one thread of control) maps directly
+onto a single asyncio event loop.
+"""

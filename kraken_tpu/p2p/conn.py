@@ -1,0 +1,206 @@
+"""One peer connection: handshake + framed message pump with bandwidth caps.
+
+Mirrors uber/kraken ``lib/torrent/scheduler/conn`` (handshaker exchanging
+peer id / info hash / namespace / bitfield; reader+writer goroutines with
+per-conn channels; bandwidth accounting) -- upstream path, unverified;
+SURVEY.md SS2.2. Reader/writer goroutines become asyncio tasks; channels
+become bounded asyncio queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Optional
+
+from kraken_tpu.core.metainfo import InfoHash
+from kraken_tpu.core.peer import PeerID
+from kraken_tpu.p2p.wire import Message, MsgType, WireError, recv_message, send_message
+from kraken_tpu.utils.bandwidth import BandwidthLimiter
+
+_SEND_QUEUE = 256
+_RECV_QUEUE = 256
+
+
+class ConnClosedError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class HandshakeResult:
+    peer_id: PeerID
+    info_hash: InfoHash
+    name: str  # blob digest hex
+    namespace: str
+    bitfield: bytes
+    num_pieces: int
+
+
+class Conn:
+    """A live, handshaken connection. Use :meth:`start` to spin the pumps.
+
+    Outbound messages go through :meth:`send` (bounded queue, backpressure);
+    inbound arrive on :meth:`recv`. Either side closing or a wire error
+    closes the conn; ``closed`` future resolves for cleanup hooks.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        peer_id: PeerID,
+        info_hash: InfoHash,
+        bandwidth: BandwidthLimiter | None = None,
+    ):
+        self._reader = reader
+        self._writer = writer
+        self.peer_id = peer_id
+        self.info_hash = info_hash
+        self._bw = bandwidth
+        self._send_q: asyncio.Queue[Optional[Message]] = asyncio.Queue(_SEND_QUEUE)
+        self._recv_q: asyncio.Queue[Optional[Message]] = asyncio.Queue(_RECV_QUEUE)
+        self._tasks: list[asyncio.Task] = []
+        self.closed: asyncio.Future[None] = asyncio.get_event_loop().create_future()
+        # piece-traffic counters (network events / metrics)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_loop()),
+            asyncio.create_task(self._recv_loop()),
+        ]
+
+    async def send(self, msg: Message) -> None:
+        """Enqueue with backpressure; a conn closing mid-wait unblocks the
+        caller with :class:`ConnClosedError` instead of stranding it on a
+        full queue."""
+        if self.closed.done():
+            raise ConnClosedError(str(self.peer_id))
+        put = asyncio.ensure_future(self._send_q.put(msg))
+        done, _pending = await asyncio.wait(
+            {put, self.closed}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if put not in done:
+            put.cancel()
+            raise ConnClosedError(str(self.peer_id))
+        await put  # surface put errors, if any
+
+    async def recv(self) -> Message:
+        get = asyncio.ensure_future(self._recv_q.get())
+        done, _pending = await asyncio.wait(
+            {get, self.closed}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if get not in done:
+            get.cancel()
+            raise ConnClosedError(str(self.peer_id))
+        msg = await get
+        if msg is None:
+            raise ConnClosedError(str(self.peer_id))
+        return msg
+
+    async def _send_loop(self) -> None:
+        try:
+            while True:
+                msg = await self._send_q.get()
+                if msg is None:
+                    return
+                if self._bw and msg.type == MsgType.PIECE_PAYLOAD:
+                    await self._bw.send(len(msg.payload))
+                await send_message(self._writer, msg)
+                self.bytes_sent += len(msg.payload)
+        except (ConnectionError, WireError, asyncio.CancelledError):
+            pass
+        finally:
+            self.close()
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                msg = await recv_message(self._reader)
+                if self._bw and msg.type == MsgType.PIECE_PAYLOAD:
+                    await self._bw.recv(len(msg.payload))
+                self.bytes_received += len(msg.payload)
+                await self._recv_q.put(msg)
+        except (ConnectionError, WireError, asyncio.CancelledError):
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if not self.closed.done():
+            # The resolved future unblocks every send()/recv() waiter (they
+            # race against it); no sentinel bookkeeping needed.
+            self.closed.set_result(None)
+            self._writer.close()
+            for t in self._tasks:
+                t.cancel()
+
+    async def wait_closed(self) -> None:
+        await asyncio.shield(self.closed)
+
+
+async def handshake_outbound(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    own_peer_id: PeerID,
+    info_hash: InfoHash,
+    name: str,
+    namespace: str,
+    own_bitfield: bytes,
+    num_pieces: int,
+    timeout: float = 10.0,
+) -> HandshakeResult:
+    """Dial-side handshake: send ours, await theirs."""
+    await send_message(
+        writer,
+        Message.handshake(
+            str(own_peer_id), info_hash.hex, name, namespace, own_bitfield,
+            num_pieces,
+        ),
+    )
+    return await _read_handshake(reader, timeout)
+
+
+async def handshake_inbound(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    own_peer_id: PeerID,
+    own_bitfield_for: "callable",
+    timeout: float = 10.0,
+) -> HandshakeResult:
+    """Accept-side handshake: read theirs first (it names the torrent),
+    then reply with our bitfield for that torrent.
+
+    ``own_bitfield_for(handshake) -> (bits, num_pieces)`` lets the
+    scheduler look up (or create) local torrent state; raising aborts the
+    conn.
+    """
+    theirs = await _read_handshake(reader, timeout)
+    bits, num_pieces = own_bitfield_for(theirs)
+    await send_message(
+        writer,
+        Message.handshake(
+            str(own_peer_id), theirs.info_hash.hex, theirs.name,
+            theirs.namespace, bits, num_pieces,
+        ),
+    )
+    return theirs
+
+
+async def _read_handshake(reader: asyncio.StreamReader, timeout: float) -> HandshakeResult:
+    msg = await asyncio.wait_for(recv_message(reader), timeout)
+    if msg.type != MsgType.HANDSHAKE:
+        raise WireError(f"expected HANDSHAKE, got {msg.type.name}")
+    h = msg.header
+    try:
+        return HandshakeResult(
+            peer_id=PeerID(h["peer_id"]),
+            info_hash=InfoHash(h["info_hash"]),
+            name=h["name"],
+            namespace=h["namespace"],
+            bitfield=msg.payload,
+            num_pieces=h["num_pieces"],
+        )
+    except (KeyError, ValueError) as e:
+        raise WireError(f"malformed handshake: {e}") from e

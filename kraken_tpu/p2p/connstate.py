@@ -1,0 +1,107 @@
+"""Connection bookkeeping: pending/active limits and the peer blacklist.
+
+Mirrors uber/kraken ``lib/torrent/scheduler/connstate`` (global and
+per-torrent ``MaxOpenConnectionsPerTorrent`` limits; blacklist with
+expiry/backoff quarantining bad peers) -- upstream path, unverified;
+SURVEY.md SS2.2/SS5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from kraken_tpu.core.metainfo import InfoHash
+from kraken_tpu.core.peer import PeerID
+from kraken_tpu.utils.backoff import Backoff
+
+
+@dataclasses.dataclass
+class ConnStateConfig:
+    max_open_conns_per_torrent: int = 10
+    max_global_conns: int = 1000
+    blacklist_expiry_seconds: float = 30.0
+    blacklist_backoff: Backoff = dataclasses.field(
+        default_factory=lambda: Backoff(
+            base_seconds=30.0, factor=2.0, max_seconds=600.0, jitter=0.1
+        )
+    )
+
+
+class Blacklist:
+    """Peers that misbehaved (bad pieces, handshake errors, conn churn);
+    entries expire with exponential backoff on repeat offenses."""
+
+    def __init__(self, config: ConnStateConfig):
+        self._config = config
+        # (peer, info_hash) -> (until_ts, offense_count)
+        self._entries: dict[tuple[PeerID, InfoHash], tuple[float, int]] = {}
+
+    def add(self, peer: PeerID, h: InfoHash, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        _until, count = self._entries.get((peer, h), (0.0, 0))
+        delay = self._config.blacklist_backoff.delay(count)
+        self._entries[(peer, h)] = (now + delay, count + 1)
+
+    def blocked(self, peer: PeerID, h: InfoHash, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        entry = self._entries.get((peer, h))
+        return entry is not None and now < entry[0]
+
+
+class ConnState:
+    """Tracks pending (dialing/handshaking) and active conns per torrent."""
+
+    def __init__(self, config: ConnStateConfig | None = None):
+        self.config = config or ConnStateConfig()
+        self.blacklist = Blacklist(self.config)
+        self._pending: dict[InfoHash, set[PeerID]] = {}
+        self._active: dict[InfoHash, set[PeerID]] = {}
+
+    def _count_global(self) -> int:
+        return sum(len(s) for s in self._pending.values()) + sum(
+            len(s) for s in self._active.values()
+        )
+
+    def active_peers(self, h: InfoHash) -> set[PeerID]:
+        return set(self._active.get(h, ()))
+
+    def num_active(self, h: InfoHash) -> int:
+        return len(self._active.get(h, ()))
+
+    def can_dial(self, peer: PeerID, h: InfoHash) -> bool:
+        if self.blacklist.blocked(peer, h):
+            return False
+        if peer in self._pending.get(h, ()) or peer in self._active.get(h, ()):
+            return False
+        per_torrent = len(self._pending.get(h, ())) + len(self._active.get(h, ()))
+        if per_torrent >= self.config.max_open_conns_per_torrent:
+            return False
+        return self._count_global() < self.config.max_global_conns
+
+    def add_pending(self, peer: PeerID, h: InfoHash) -> bool:
+        if not self.can_dial(peer, h):
+            return False
+        self._pending.setdefault(h, set()).add(peer)
+        return True
+
+    def promote(self, peer: PeerID, h: InfoHash) -> bool:
+        """Pending -> active on handshake success. Incoming conns (never
+        pending) promote directly if capacity allows."""
+        self._pending.get(h, set()).discard(peer)
+        if peer in self._active.get(h, ()):
+            return False
+        active = self._active.setdefault(h, set())
+        per_torrent = len(active) + len(self._pending.get(h, ()))
+        if per_torrent >= self.config.max_open_conns_per_torrent:
+            return False
+        active.add(peer)
+        return True
+
+    def remove(self, peer: PeerID, h: InfoHash) -> None:
+        self._pending.get(h, set()).discard(peer)
+        self._active.get(h, set()).discard(peer)
+
+    def clear_torrent(self, h: InfoHash) -> None:
+        self._pending.pop(h, None)
+        self._active.pop(h, None)

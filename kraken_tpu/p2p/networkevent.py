@@ -1,0 +1,62 @@
+"""Structured swarm tracing: JSONL network events for offline analysis.
+
+Mirrors uber/kraken ``lib/torrent/networkevent`` (every swarm event --
+conn open/close, piece request/receive/send, blacklist -- emitted as
+structured JSON to a dedicated sink for swarm reconstruction) -- upstream
+path, unverified; SURVEY.md SS5.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional
+
+
+class Name:
+    ADD_TORRENT = "add_torrent"
+    ADD_ACTIVE_CONN = "add_active_conn"
+    DROP_ACTIVE_CONN = "drop_active_conn"
+    BLACKLIST_CONN = "blacklist_conn"
+    REQUEST_PIECE = "request_piece"
+    RECEIVE_PIECE = "receive_piece"
+    TORRENT_COMPLETE = "torrent_complete"
+    ANNOUNCE = "announce"
+
+
+class Producer:
+    """Writes one JSON object per line to ``sink`` (a file-like) or, with
+    ``sink=None``, keeps an in-memory ring for tests."""
+
+    def __init__(self, peer_id: str, sink: Optional[IO[str]] = None, keep: int = 10000):
+        self._peer_id = peer_id
+        self._sink = sink
+        self._events: list[dict] = []
+        self._keep = keep
+
+    def emit(self, name: str, info_hash: str = "", **fields) -> None:
+        event = {
+            "name": name,
+            "ts": time.time(),
+            "self": self._peer_id,
+            "info_hash": info_hash,
+            **fields,
+        }
+        if self._sink is not None:
+            self._sink.write(json.dumps(event, separators=(",", ":")) + "\n")
+        else:
+            self._events.append(event)
+            if len(self._events) > self._keep:
+                del self._events[: -self._keep]
+
+    @property
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+
+class NoopProducer(Producer):
+    def __init__(self):
+        super().__init__("")
+
+    def emit(self, name: str, info_hash: str = "", **fields) -> None:
+        pass

@@ -1,0 +1,107 @@
+"""Piece selection: pending-request manager with timeouts and policies.
+
+Mirrors uber/kraken ``lib/torrent/scheduler/dispatch/piecerequest``
+(pending-request manager with timeout & retry; default and rarest-first
+policies) -- upstream path, unverified; SURVEY.md SS2.2.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Iterable
+
+from kraken_tpu.core.peer import PeerID
+
+
+class RequestManager:
+    """Tracks which pieces are requested from which peers, with expiry.
+
+    ``policy`` is ``"rarest_first"`` (default, as the reference's
+    production policy) or ``"random"``. In endgame (every missing piece
+    already requested) duplicate requests are allowed so one slow peer
+    can't stall completion.
+    """
+
+    def __init__(
+        self,
+        policy: str = "rarest_first",
+        timeout_seconds: float = 8.0,
+        pipeline_limit: int = 4,
+    ):
+        if policy not in ("rarest_first", "random"):
+            raise ValueError(f"unknown piece policy: {policy!r}")
+        self.policy = policy
+        self.timeout = timeout_seconds
+        self.pipeline_limit = pipeline_limit
+        # piece -> {peer -> sent_ts}
+        self._requests: dict[int, dict[PeerID, float]] = {}
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _expire(self, now: float) -> None:
+        for piece, peers in list(self._requests.items()):
+            for peer, ts in list(peers.items()):
+                if now - ts > self.timeout:
+                    del peers[peer]
+            if not peers:
+                del self._requests[piece]
+
+    def mark_sent(self, piece: int, peer: PeerID, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._requests.setdefault(piece, {})[peer] = now
+
+    def clear_piece(self, piece: int) -> None:
+        self._requests.pop(piece, None)
+
+    def clear_peer(self, peer: PeerID) -> None:
+        for piece, peers in list(self._requests.items()):
+            peers.pop(peer, None)
+            if not peers:
+                del self._requests[piece]
+
+    def pending_for(self, peer: PeerID, now: float | None = None) -> list[int]:
+        now = time.monotonic() if now is None else now
+        self._expire(now)
+        return [p for p, peers in self._requests.items() if peer in peers]
+
+    # -- selection ---------------------------------------------------------
+
+    def select(
+        self,
+        peer: PeerID,
+        peer_has: set[int],
+        missing: Iterable[int],
+        availability: dict[int, int],
+        now: float | None = None,
+    ) -> list[int]:
+        """Pieces to request from ``peer`` now, respecting the pipeline
+        limit. ``availability[piece]`` = number of connected peers holding
+        it (drives rarest-first)."""
+        now = time.monotonic() if now is None else now
+        self._expire(now)
+
+        budget = self.pipeline_limit - len(self.pending_for(peer, now))
+        if budget <= 0:
+            return []
+
+        missing = list(missing)
+        fresh = [
+            p for p in missing if p in peer_has and p not in self._requests
+        ]
+        if not fresh:
+            # Endgame: everything missing is in flight somewhere; duplicate
+            # requests to this peer for pieces it holds but isn't serving.
+            fresh = [
+                p
+                for p in missing
+                if p in peer_has and peer not in self._requests.get(p, {})
+            ]
+        if self.policy == "rarest_first":
+            fresh.sort(key=lambda p: (availability.get(p, 0), random.random()))
+        else:
+            random.shuffle(fresh)
+        chosen = fresh[:budget]
+        for p in chosen:
+            self.mark_sent(p, peer, now)
+        return chosen

@@ -1,0 +1,315 @@
+"""The torrent scheduler: public ``download()`` + swarm orchestration.
+
+Mirrors uber/kraken ``lib/torrent/scheduler`` (single event loop owning all
+torrent state; blocking ``Download(namespace, digest)``; announce ticks;
+conn management; seeding-by-existence for origins) -- upstream path,
+unverified; SURVEY.md SS2.2/SS3.1. The reference's single-goroutine
+invariant maps to the asyncio loop; its event structs map to plain awaits.
+
+Collaborators are injected as small interfaces so in-process swarm tests
+(SURVEY.md SS4 tier 3) can fake the tracker:
+
+- ``metainfo_client.get(namespace, digest) -> MetaInfo``
+- ``announce_client.announce(digest, info_hash, namespace, complete)
+  -> (list[PeerInfo], interval_seconds)``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import Optional, Protocol
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import InfoHash, MetaInfo
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.p2p.conn import (
+    Conn,
+    HandshakeResult,
+    handshake_inbound,
+    handshake_outbound,
+)
+from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
+from kraken_tpu.p2p.dispatch import Dispatcher
+from kraken_tpu.p2p.networkevent import NoopProducer, Producer
+from kraken_tpu.p2p.storage import Torrent
+from kraken_tpu.p2p.wire import WireError
+from kraken_tpu.utils.bandwidth import BandwidthLimiter
+from kraken_tpu.utils.dedup import RequestCoalescer
+
+
+class MetaInfoClient(Protocol):
+    async def get(self, namespace: str, d: Digest) -> MetaInfo: ...
+
+
+class AnnounceClient(Protocol):
+    async def announce(
+        self, d: Digest, h: InfoHash, namespace: str, complete: bool
+    ) -> tuple[list[PeerInfo], float]: ...
+
+
+class TorrentArchive(Protocol):
+    def create_torrent(self, metainfo: MetaInfo) -> Torrent: ...
+
+
+class SchedulerConfig:
+    def __init__(
+        self,
+        announce_interval_seconds: float = 3.0,
+        dial_timeout_seconds: float = 5.0,
+        retry_tick_seconds: float = 2.0,
+        conn_state: ConnStateConfig | None = None,
+        seed_on_complete: bool = True,
+    ):
+        self.announce_interval = announce_interval_seconds
+        self.dial_timeout = dial_timeout_seconds
+        self.retry_tick = retry_tick_seconds
+        self.conn_state = conn_state or ConnStateConfig()
+        self.seed_on_complete = seed_on_complete
+
+
+class _TorrentControl:
+    def __init__(self, torrent: Torrent, namespace: str, dispatcher: Dispatcher):
+        self.torrent = torrent
+        self.namespace = namespace
+        self.dispatcher = dispatcher
+        self.tasks: set[asyncio.Task] = set()
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Track a task for cleanup; finished tasks self-prune (a seeding
+        control dials on every announce tick -- an append-only list would
+        grow forever)."""
+        task = asyncio.create_task(coro)
+        self.tasks.add(task)
+        task.add_done_callback(self.tasks.discard)
+        return task
+
+    def cancel_tasks(self) -> None:
+        for t in list(self.tasks):
+            t.cancel()
+
+
+class Scheduler:
+    """One per process. Owns the listening socket and all torrent state."""
+
+    def __init__(
+        self,
+        peer_id: PeerID,
+        ip: str,
+        port: int,
+        archive: TorrentArchive,
+        metainfo_client: MetaInfoClient,
+        announce_client: AnnounceClient,
+        config: SchedulerConfig | None = None,
+        bandwidth: BandwidthLimiter | None = None,
+        events: Producer | None = None,
+        is_origin: bool = False,
+        metainfo_resolver=None,
+    ):
+        self.peer_id = peer_id
+        self.ip = ip
+        self.port = port
+        self.archive = archive
+        self.metainfo_client = metainfo_client
+        self.announce_client = announce_client
+        self.config = config or SchedulerConfig()
+        self.bandwidth = bandwidth
+        self.events = events or NoopProducer()
+        self.is_origin = is_origin
+        # Origin side: resolve a blob digest hex -> MetaInfo for inbound
+        # handshakes on blobs we seed but have no live control for.
+        self._metainfo_resolver = metainfo_resolver
+        self.conn_state = ConnState(self.config.conn_state)
+        self._controls: dict[InfoHash, _TorrentControl] = {}
+        self._coalescer: RequestCoalescer = RequestCoalescer()
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, host=self.ip, port=self.port
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for ctl in list(self._controls.values()):
+            ctl.cancel_tasks()
+            ctl.dispatcher.close()
+        self._controls.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- public API --------------------------------------------------------
+
+    async def download(self, namespace: str, d: Digest) -> None:
+        """Download blob ``d`` via the swarm; returns when it is complete
+        in local storage. Concurrent calls for one blob coalesce."""
+        await self._coalescer.get(d.hex, lambda: self._download(namespace, d))
+
+    async def _download(self, namespace: str, d: Digest) -> None:
+        metainfo = await self.metainfo_client.get(namespace, d)
+        ctl = self._get_or_create_control(metainfo, namespace)
+        await asyncio.shield(ctl.dispatcher.done)
+
+    def seed(self, metainfo: MetaInfo, namespace: str) -> None:
+        """Start seeding a complete local blob (origin startup / post-
+        download agents keep seeding automatically)."""
+        self._get_or_create_control(metainfo, namespace)
+
+    # -- torrent control ---------------------------------------------------
+
+    def _get_or_create_control(
+        self, metainfo: MetaInfo, namespace: str
+    ) -> _TorrentControl:
+        h = metainfo.info_hash
+        ctl = self._controls.get(h)
+        if ctl is not None:
+            return ctl
+        torrent = self.archive.create_torrent(metainfo)
+        dispatcher = Dispatcher(
+            torrent,
+            on_peer_failure=lambda pid, reason: self._peer_failed(pid, h, reason),
+        )
+        ctl = _TorrentControl(torrent, namespace, dispatcher)
+        self._controls[h] = ctl
+        ctl.spawn(self._announce_loop(ctl))
+        ctl.spawn(self._retry_loop(ctl))
+        self.events.emit(
+            "add_torrent", h.hex, blob=metainfo.name, complete=torrent.complete()
+        )
+        return ctl
+
+    def _peer_failed(self, peer_id: PeerID, h: InfoHash, reason: str) -> None:
+        self.conn_state.blacklist.add(peer_id, h)
+        self.conn_state.remove(peer_id, h)
+        self.events.emit("blacklist_conn", h.hex, peer=peer_id.hex, reason=reason)
+
+    # -- announce / dial ---------------------------------------------------
+
+    async def _announce_loop(self, ctl: _TorrentControl) -> None:
+        h = ctl.torrent.info_hash
+        interval = self.config.announce_interval
+        while True:
+            try:
+                peers, interval_r = await self.announce_client.announce(
+                    ctl.torrent.digest, h, ctl.namespace, ctl.torrent.complete()
+                )
+                interval = interval_r or self.config.announce_interval
+                self.events.emit("announce", h.hex, returned=len(peers))
+                for peer in peers:
+                    self._maybe_dial(ctl, peer)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # tracker hiccup: retry next tick
+            await asyncio.sleep(interval)
+
+    def _maybe_dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
+        if peer.peer_id == self.peer_id:
+            return
+        # Complete torrents only serve; they never dial (origins and
+        # seeding agents wait for inbound conns).
+        if ctl.torrent.complete():
+            return
+        h = ctl.torrent.info_hash
+        if not self.conn_state.add_pending(peer.peer_id, h):
+            return
+        ctl.spawn(self._dial(ctl, peer))
+
+    async def _dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
+        h = ctl.torrent.info_hash
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(peer.ip, peer.port),
+                self.config.dial_timeout,
+            )
+            theirs = await handshake_outbound(
+                reader,
+                writer,
+                self.peer_id,
+                h,
+                ctl.torrent.metainfo.name,
+                ctl.namespace,
+                ctl.torrent.bitfield(),
+                ctl.torrent.num_pieces,
+                timeout=self.config.dial_timeout,
+            )
+        except (OSError, WireError, asyncio.TimeoutError):
+            self.conn_state.remove(peer.peer_id, h)
+            self.conn_state.blacklist.add(peer.peer_id, h)
+            return
+        # The handshaked identity wins over the (possibly stale) announced
+        # one: release the announced pending slot before promoting, or a
+        # restarted peer with a new id would leak pending slots forever.
+        self.conn_state.remove(peer.peer_id, h)
+        if not self.conn_state.promote(theirs.peer_id, h):
+            writer.close()
+            return
+        self._adopt(ctl, reader, writer, theirs)
+
+    # -- inbound conns -----------------------------------------------------
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            theirs = await handshake_inbound(
+                reader, writer, self.peer_id, self._bitfield_for
+            )
+        except (OSError, WireError, KeyError, asyncio.TimeoutError):
+            writer.close()
+            return
+        h = theirs.info_hash
+        ctl = self._controls.get(h)
+        if ctl is None or not self.conn_state.promote(theirs.peer_id, h):
+            writer.close()
+            return
+        self._adopt(ctl, reader, writer, theirs)
+
+    def _bitfield_for(self, hs: HandshakeResult) -> tuple[bytes, int]:
+        """Inbound handshake: find or create local state for the torrent.
+
+        Origins lazily create seeding controls for any stored blob (the
+        resolver loads its metainfo); agents only serve torrents they have
+        live controls for. Raising KeyError rejects the conn.
+        """
+        ctl = self._controls.get(hs.info_hash)
+        if ctl is None:
+            if self._metainfo_resolver is None:
+                raise KeyError(hs.info_hash.hex)
+            metainfo = self._metainfo_resolver(hs.name, hs.namespace)
+            if metainfo is None or metainfo.info_hash != hs.info_hash:
+                raise KeyError(hs.info_hash.hex)
+            ctl = self._get_or_create_control(metainfo, hs.namespace)
+        return ctl.torrent.bitfield(), ctl.torrent.num_pieces
+
+    def _adopt(
+        self,
+        ctl: _TorrentControl,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        theirs: HandshakeResult,
+    ) -> None:
+        h = ctl.torrent.info_hash
+        conn = Conn(reader, writer, theirs.peer_id, h, bandwidth=self.bandwidth)
+        conn.start()
+        conn.closed.add_done_callback(
+            lambda _f: self.conn_state.remove(theirs.peer_id, h)
+        )
+        ctl.dispatcher.add_conn(conn, theirs.bitfield, theirs.num_pieces)
+        self.events.emit("add_active_conn", h.hex, peer=theirs.peer_id.hex)
+
+    # -- retry timer -------------------------------------------------------
+
+    async def _retry_loop(self, ctl: _TorrentControl) -> None:
+        while True:
+            await asyncio.sleep(self.config.retry_tick)
+            with contextlib.suppress(Exception):
+                await ctl.dispatcher.tick()

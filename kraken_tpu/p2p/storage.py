@@ -1,0 +1,235 @@
+"""Torrent storage: piece-addressed views over the CAStore.
+
+Mirrors uber/kraken ``lib/torrent/storage`` (``Torrent`` interface with
+``WritePiece``/``GetPieceReader``/``MissingPieces``...; agent archive that
+allocates the cache file and persists the piece bitfield for crash-resume;
+origin archive seeding completed CAStore blobs) -- upstream paths,
+unverified; SURVEY.md SS2.2.
+
+**Piece verification on write lives here** -- the agent-side hot loop the
+north star routes through ``PieceHasher``: received pieces are verified by
+the :class:`BatchedVerifier`, which coalesces concurrent arrivals into one
+batched TPU dispatch (per BASELINE.json's agent-verify config).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import PieceHasher, get_hasher
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.store import CAStore, PieceStatusMetadata
+
+
+class PieceError(Exception):
+    pass
+
+
+class BatchedVerifier:
+    """Verifies received pieces against their expected digests, batching
+    concurrent arrivals into one ``PieceHasher.hash_batch`` dispatch.
+
+    Under swarm load many pieces land within a few ms; each ``verify``
+    parks on a future while a single flusher task drains the queue --
+    one TPU dispatch per drain instead of one per piece. An idle swarm
+    pays only ``max_delay`` extra latency (default 2 ms).
+    """
+
+    def __init__(
+        self,
+        hasher: PieceHasher | None = None,
+        max_batch: int = 1024,
+        max_delay_seconds: float = 0.002,
+    ):
+        self._hasher = hasher or get_hasher("cpu")
+        self._max_batch = max_batch
+        self._max_delay = max_delay_seconds
+        self._queue: list[tuple[bytes, bytes, asyncio.Future]] = []
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def verify(self, data: bytes, expected: bytes) -> bool:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future[bool] = loop.create_future()
+        self._queue.append((data, expected, fut))
+        if self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.create_task(self._flush_soon())
+        if len(self._queue) >= self._max_batch:
+            self._flush_now()
+        return await fut
+
+    async def _flush_soon(self) -> None:
+        await asyncio.sleep(self._max_delay)
+        self._flush_now()
+
+    def _flush_now(self) -> None:
+        batch, self._queue = self._queue, []
+        if not batch:
+            return
+        try:
+            digests = self._hasher.hash_batch([d for d, _e, _f in batch])
+        except Exception as e:
+            # A hasher failure must fail the waiters, not strand them.
+            for _d, _e2, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (d, expected, fut), got in zip(batch, digests):
+            if not fut.done():
+                fut.set_result(bytes(got) == expected)
+
+
+class Torrent:
+    """Piece-addressed access to one blob in the store.
+
+    Complete torrents (origin seeding) read straight from the committed
+    blob. Incomplete torrents own a pre-allocated cache file plus the
+    persisted piece bitfield; the final ``write_piece`` completes them.
+    """
+
+    def __init__(
+        self,
+        store: CAStore,
+        metainfo: MetaInfo,
+        verifier: BatchedVerifier,
+        complete: bool = False,
+    ):
+        self.store = store
+        self.metainfo = metainfo
+        self._verifier = verifier
+        if complete:
+            self._path = store.cache_path(metainfo.digest)
+            self._status = None  # complete: no bitfield needed
+        else:
+            # Incomplete data lives at the partial path until the last
+            # piece lands; only then is it renamed into the cache, so
+            # ``in_cache`` can never observe a half-written blob.
+            self._path = store.partial_path(metainfo.digest)
+            md = store.get_metadata(metainfo.digest, PieceStatusMetadata)
+            self._status = md or PieceStatusMetadata(metainfo.num_pieces)
+        # Serializes bitfield updates + completion check.
+        self._lock = asyncio.Lock()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def digest(self) -> Digest:
+        return self.metainfo.digest
+
+    @property
+    def info_hash(self):
+        return self.metainfo.info_hash
+
+    @property
+    def num_pieces(self) -> int:
+        return self.metainfo.num_pieces
+
+    def complete(self) -> bool:
+        return self._status is None or self._status.complete()
+
+    def has_piece(self, i: int) -> bool:
+        return self._status is None or self._status.has(i)
+
+    def missing_pieces(self) -> list[int]:
+        return [] if self._status is None else self._status.missing()
+
+    def num_pieces_complete(self) -> int:
+        return self.num_pieces if self._status is None else self._status.count()
+
+    def bitfield(self) -> bytes:
+        if self._status is None:
+            full = PieceStatusMetadata(self.num_pieces)
+            for i in range(self.num_pieces):
+                full.set(i)
+            return bytes(full.bits)
+        return bytes(self._status.bits)
+
+    # -- pieces ------------------------------------------------------------
+
+    def read_piece(self, i: int) -> bytes:
+        if not self.has_piece(i):
+            raise PieceError(f"piece {i} not present")
+        off = i * self.metainfo.piece_length
+        ln = self.metainfo.piece_length_of(i)
+        with open(self._path, "rb") as f:
+            f.seek(off)
+            data = f.read(ln)
+        if len(data) != ln:
+            raise PieceError(f"short read on piece {i}")
+        return data
+
+    async def write_piece(self, i: int, data: bytes) -> bool:
+        """Verify + persist piece ``i``. Returns True when this write
+        completed the torrent. Raises :class:`PieceError` on corrupt data
+        (callers blacklist the sender). File IO runs off-loop so a disk
+        stall can't freeze the scheduler."""
+        if self._status is None:
+            raise PieceError("torrent already complete")
+        if len(data) != self.metainfo.piece_length_of(i):
+            raise PieceError(
+                f"piece {i}: wrong length {len(data)} != "
+                f"{self.metainfo.piece_length_of(i)}"
+            )
+        if not await self._verifier.verify(data, self.metainfo.piece_hash(i)):
+            raise PieceError(f"piece {i}: digest mismatch")
+        async with self._lock:
+            if self._status.has(i):
+                return False  # duplicate arrival
+            await asyncio.to_thread(self._write_at, i, data)
+            self._status.set(i)
+            self.store.set_metadata(self.metainfo.digest, self._status)
+            if self._status.complete():
+                self.store.commit_partial_file(self.metainfo.digest)
+                self.store.delete_metadata(self.metainfo.digest, PieceStatusMetadata)
+                self._status = None
+                self._path = self.store.cache_path(self.metainfo.digest)
+                return True
+            return False
+
+    def _write_at(self, i: int, data: bytes) -> None:
+        with open(self._path, "r+b") as f:
+            f.seek(i * self.metainfo.piece_length)
+            f.write(data)
+
+    async def read_piece_async(self, i: int) -> bytes:
+        """Off-loop :meth:`read_piece` for pump-context reads."""
+        return await asyncio.to_thread(self.read_piece, i)
+
+
+class AgentTorrentArchive:
+    """Download-side archive: creates resumable torrents from metainfo.
+
+    Mirrors ``lib/torrent/storage/agentstorage`` (metainfo via tracker,
+    cache-file allocation, bitfield persistence) -- the metainfo fetch
+    lives in the caller (scheduler) to keep this layer IO-free.
+    """
+
+    def __init__(self, store: CAStore, verifier: BatchedVerifier):
+        self.store = store
+        self.verifier = verifier
+
+    def create_torrent(self, metainfo: MetaInfo) -> Torrent:
+        d = metainfo.digest
+        if self.store.in_cache(d):
+            # in_cache == committed (partials live at .part), so this is
+            # always safe to seed.
+            return Torrent(self.store, metainfo, self.verifier, complete=True)
+        self.store.allocate_partial_file(d, metainfo.length)
+        if self.store.get_metadata(d, PieceStatusMetadata) is None:
+            self.store.set_metadata(d, PieceStatusMetadata(metainfo.num_pieces))
+        return Torrent(self.store, metainfo, self.verifier, complete=False)
+
+
+class OriginTorrentArchive:
+    """Seed-side archive: torrents over committed CAStore blobs."""
+
+    def __init__(self, store: CAStore, verifier: BatchedVerifier):
+        self.store = store
+        self.verifier = verifier
+
+    def create_torrent(self, metainfo: MetaInfo) -> Torrent:
+        if not self.store.in_cache(metainfo.digest):
+            raise KeyError(str(metainfo.digest))
+        return Torrent(self.store, metainfo, self.verifier, complete=True)

@@ -1,0 +1,14 @@
+"""Blob placement: rendezvous hashing over health-filtered origin lists.
+
+Mirrors uber/kraken ``lib/hrw`` + ``lib/hashring`` + ``lib/hostlist`` +
+``lib/healthcheck`` (SURVEY.md SS2.3): ``Ring.locations(digest)`` returns the
+replica origins responsible for a blob, recomputed as membership/health
+changes; every client of the origin cluster routes through it.
+"""
+
+from kraken_tpu.placement.hrw import rendezvous_hash
+from kraken_tpu.placement.hashring import Ring
+from kraken_tpu.placement.hostlist import HostList
+from kraken_tpu.placement.healthcheck import PassiveFilter
+
+__all__ = ["rendezvous_hash", "Ring", "HostList", "PassiveFilter"]

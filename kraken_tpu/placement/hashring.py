@@ -1,0 +1,69 @@
+"""The origin hash ring: consistent blob -> replica-set placement.
+
+Mirrors uber/kraken ``lib/hashring`` (``Ring.Locations(digest) -> hosts``
+with ``MaxReplica``, membership refreshed from hostlist filtered by health,
+change notification driving repair) -- upstream path, unverified; SURVEY.md
+SS2.3/SS5.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.placement.hostlist import HostList
+from kraken_tpu.placement.hrw import rendezvous_hash
+
+
+class Ring:
+    """Rendezvous ring over the healthy origins.
+
+    ``health_filter`` is any callable(hosts) -> healthy subset (a
+    PassiveFilter.filter, ActiveMonitor.filter, or None). ``refresh()``
+    re-resolves membership and fires ``on_change`` listeners when it
+    differs -- the origin repair path subscribes to re-replicate affected
+    blobs.
+    """
+
+    def __init__(
+        self,
+        hosts: HostList,
+        max_replica: int = 3,
+        health_filter: Callable[[Iterable[str]], list[str]] | None = None,
+    ):
+        self._hosts = hosts
+        self.max_replica = max_replica
+        self._health_filter = health_filter
+        self._members: list[str] = []
+        self._listeners: list[Callable[[list[str]], None]] = []
+        self.refresh()
+
+    @property
+    def members(self) -> list[str]:
+        return list(self._members)
+
+    def on_change(self, fn: Callable[[list[str]], None]) -> None:
+        self._listeners.append(fn)
+
+    def refresh(self) -> bool:
+        """Re-resolve + re-filter membership; returns True if it changed."""
+        hosts = self._hosts.resolve()
+        if self._health_filter is not None:
+            hosts = self._health_filter(hosts)
+        hosts = sorted(hosts)
+        if hosts == self._members:
+            return False
+        self._members = hosts
+        for fn in self._listeners:
+            fn(list(hosts))
+        return True
+
+    def locations(self, d: Digest) -> list[str]:
+        """The replica origins responsible for ``d`` (= min(max_replica,
+        cluster size) hosts, deterministic for fixed membership)."""
+        if not self._members:
+            raise RuntimeError("hash ring has no members")
+        return rendezvous_hash(d.hex, self._members, k=self.max_replica)
+
+    def owns(self, host: str, d: Digest) -> bool:
+        return host in self.locations(d)

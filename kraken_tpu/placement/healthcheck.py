@@ -1,0 +1,88 @@
+"""Health-filtered host sets: active monitors and passive filters.
+
+Mirrors uber/kraken ``lib/healthcheck`` (``Monitor``: periodic health
+endpoint probing with pass/fail thresholds; ``PassiveFilter``:
+mark-bad-on-request-error with cooldown) -- upstream path, unverified;
+SURVEY.md SS2.3/SS5. Feeds the hashring: dead origins leave the ring, and
+their blobs re-place onto the survivors.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Awaitable, Callable, Iterable
+
+
+class PassiveFilter:
+    """Callers report request failures; hosts with >= ``fail_threshold``
+    recent failures are filtered out until ``cooldown_seconds`` pass."""
+
+    def __init__(self, fail_threshold: int = 3, cooldown_seconds: float = 30.0):
+        self.fail_threshold = fail_threshold
+        self.cooldown = cooldown_seconds
+        self._fails: dict[str, list[float]] = {}
+
+    def failed(self, host: str, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._fails.setdefault(host, []).append(now)
+
+    def succeeded(self, host: str) -> None:
+        self._fails.pop(host, None)
+
+    def healthy(self, host: str, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        fails = self._fails.get(host)
+        if not fails:
+            return True
+        recent = [t for t in fails if now - t < self.cooldown]
+        self._fails[host] = recent
+        return len(recent) < self.fail_threshold
+
+    def filter(self, hosts: Iterable[str], now: float | None = None) -> list[str]:
+        out = [h for h in hosts if self.healthy(h, now)]
+        # All-unhealthy degrades to all-in (serving badly beats serving
+        # nothing, as in the reference).
+        return out or list(hosts)
+
+
+class ActiveMonitor:
+    """Periodic probe of every host; tracks consecutive pass/fail counts.
+
+    ``probe`` is an async callable (host) -> bool. Drive :meth:`check_all`
+    from a service timer task; ``healthy_hosts`` reflects the latest state.
+    """
+
+    def __init__(
+        self,
+        probe: Callable[[str], Awaitable[bool]],
+        pass_threshold: int = 1,
+        fail_threshold: int = 3,
+    ):
+        self._probe = probe
+        self.pass_threshold = pass_threshold
+        self.fail_threshold = fail_threshold
+        # host -> (healthy verdict, consecutive contrary results)
+        self._state: dict[str, tuple[bool, int]] = {}
+
+    async def check_all(self, hosts: Iterable[str]) -> None:
+        for h in hosts:
+            try:
+                ok = await self._probe(h)
+            except Exception:
+                ok = False
+            healthy, contrary = self._state.get(h, (True, 0))
+            if ok == healthy:
+                contrary = 0
+            else:
+                contrary += 1
+                threshold = self.pass_threshold if ok else self.fail_threshold
+                if contrary >= threshold:
+                    healthy, contrary = ok, 0
+            self._state[h] = (healthy, contrary)
+
+    def healthy(self, host: str) -> bool:
+        return self._state.get(host, (True, 0))[0]
+
+    def filter(self, hosts: Iterable[str]) -> list[str]:
+        out = [h for h in hosts if self.healthy(h)]
+        return out or list(hosts)

@@ -1,0 +1,30 @@
+"""Static or resolver-backed cluster host lists.
+
+Mirrors uber/kraken ``lib/hostlist`` (static lists or DNS names resolved to
+host sets) -- upstream path, unverified; SURVEY.md SS2.3. DNS is modeled as
+a pluggable resolver callable so tests and the herd can inject membership
+changes without real DNS.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+
+class HostList:
+    """A named set of ``host:port`` addresses."""
+
+    def __init__(
+        self,
+        static: Iterable[str] | None = None,
+        resolver: Callable[[], list[str]] | None = None,
+    ):
+        if (static is None) == (resolver is None):
+            raise ValueError("exactly one of static/resolver required")
+        self._static = sorted(static) if static is not None else None
+        self._resolver = resolver
+
+    def resolve(self) -> list[str]:
+        if self._static is not None:
+            return list(self._static)
+        return sorted(self._resolver())

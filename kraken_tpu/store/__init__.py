@@ -1,0 +1,24 @@
+"""Local storage plane: content-addressable store, metadata, cleanup.
+
+Mirrors the responsibilities of uber/kraken ``lib/store`` (CAStore, typed
+per-file metadata, TTI/disk cleanup) -- upstream paths, unverified; see
+SURVEY.md SS2.3.
+"""
+
+from kraken_tpu.store.castore import CAStore, FileExistsInCacheError, UploadNotFoundError
+from kraken_tpu.store.metadata import (
+    Metadata,
+    PieceStatusMetadata,
+    TTIMetadata,
+    register_metadata,
+)
+
+__all__ = [
+    "CAStore",
+    "FileExistsInCacheError",
+    "UploadNotFoundError",
+    "Metadata",
+    "PieceStatusMetadata",
+    "TTIMetadata",
+    "register_metadata",
+]

@@ -1,0 +1,278 @@
+"""Content-addressable file store with an upload -> cache state transition.
+
+Behavior mirrored from uber/kraken ``lib/store`` (``CAStore``: upload dir,
+atomic rename into a sharded cache dir, per-file metadata, cleanup)
+-- upstream path, unverified; SURVEY.md SS2.3.
+
+Layout:
+
+    <root>/upload/<uuid>                 in-flight uploads (random names)
+    <root>/cache/<hex[:2]>/<hex[2:4]>/<hex>   committed blobs, sharded
+    <data_path>._md_<name>               typed metadata sidecars
+
+Invariants:
+
+- a path under ``cache/`` is immutable once present (CAS semantics); commit
+  is an atomic ``os.replace`` so readers never observe partial blobs;
+- every mutation of metadata goes through atomic tmp+rename as well;
+- digests are verified on commit unless the caller already streamed through
+  a :class:`~kraken_tpu.core.digest.Digester`.
+
+Thread-safety: a single process-wide lock guards directory-level races
+(concurrent commit of the same digest); data-plane reads/writes are lock-free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import threading
+import uuid as uuidlib
+from typing import BinaryIO, Iterator, Optional, Type, TypeVar
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.store.metadata import Metadata
+
+M = TypeVar("M", bound=Metadata)
+
+_CHUNK = 4 * 1024 * 1024
+
+
+class StoreError(Exception):
+    pass
+
+
+class UploadNotFoundError(StoreError):
+    pass
+
+
+class FileExistsInCacheError(StoreError):
+    """Commit target already cached -- callers treat as success (CAS)."""
+
+
+class DigestMismatchError(StoreError):
+    pass
+
+
+class CAStore:
+    """Content-addressable store rooted at a directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.upload_dir = os.path.join(root, "upload")
+        self.cache_dir = os.path.join(root, "cache")
+        os.makedirs(self.upload_dir, exist_ok=True)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- paths -------------------------------------------------------------
+
+    def cache_path(self, d: Digest) -> str:
+        return os.path.join(self.cache_dir, d.hex[:2], d.hex[2:4], d.hex)
+
+    def _upload_path(self, uid: str) -> str:
+        return os.path.join(self.upload_dir, uid)
+
+    # -- upload flow (origin chunked upload; proxy push) -------------------
+
+    def create_upload(self) -> str:
+        """Start an upload; returns its id."""
+        uid = uuidlib.uuid4().hex
+        with open(self._upload_path(uid), "wb"):
+            pass
+        return uid
+
+    def upload_exists(self, uid: str) -> bool:
+        return os.path.exists(self._upload_path(uid))
+
+    def write_upload_chunk(self, uid: str, offset: int, data: bytes) -> None:
+        path = self._upload_path(uid)
+        if not os.path.exists(path):
+            raise UploadNotFoundError(uid)
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            f.write(data)
+
+    def upload_size(self, uid: str) -> int:
+        path = self._upload_path(uid)
+        if not os.path.exists(path):
+            raise UploadNotFoundError(uid)
+        return os.path.getsize(path)
+
+    def commit_upload(self, uid: str, d: Digest, verify: bool = True) -> None:
+        """Atomically move an upload into the cache under its digest.
+
+        With ``verify`` the content is re-hashed and must match ``d``.
+        Committing a digest that is already cached discards the upload and
+        raises :class:`FileExistsInCacheError` (callers usually swallow it).
+        """
+        src = self._upload_path(uid)
+        if not os.path.exists(src):
+            raise UploadNotFoundError(uid)
+        if verify:
+            with open(src, "rb") as f:
+                actual = Digest.from_reader(f)
+            if actual != d:
+                os.unlink(src)
+                raise DigestMismatchError(f"expected {d}, got {actual}")
+        dst = self.cache_path(d)
+        with self._lock:
+            if os.path.exists(dst):
+                os.unlink(src)
+                raise FileExistsInCacheError(str(d))
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            os.replace(src, dst)
+
+    def abort_upload(self, uid: str) -> None:
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(self._upload_path(uid))
+
+    # -- direct cache writes (blobrefresh; torrent allocation) -------------
+
+    def create_cache_file(self, d: Digest, chunks: Iterator[bytes], verify: bool = True) -> None:
+        """Stream ``chunks`` into the cache under ``d`` (no-op if cached)."""
+        if self.in_cache(d):
+            return
+        uid = self.create_upload()
+        path = self._upload_path(uid)
+        with open(path, "wb") as f:
+            for c in chunks:
+                f.write(c)
+        try:
+            self.commit_upload(uid, d, verify=verify)
+        except FileExistsInCacheError:
+            pass
+
+    def partial_path(self, d: Digest) -> str:
+        """Where an in-progress piece-wise download lives. Only a completed,
+        verified blob ever occupies ``cache_path`` -- ``in_cache`` therefore
+        means *committed*, and cleanup never sees partials."""
+        return self.cache_path(d) + ".part"
+
+    def allocate_partial_file(self, d: Digest, length: int) -> str:
+        """Pre-allocate the partial file for piece-wise download (resumable:
+        piece bitfield metadata persists beside it). Returns the path."""
+        dst = self.partial_path(d)
+        with self._lock:
+            if not os.path.exists(dst):
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                tmp = dst + ".alloc"
+                with open(tmp, "wb") as f:
+                    f.truncate(length)
+                os.replace(tmp, dst)
+        return dst
+
+    def commit_partial_file(self, d: Digest) -> None:
+        """Atomically promote a completed partial into the cache."""
+        with self._lock:
+            if not os.path.exists(self.cache_path(d)):
+                os.makedirs(os.path.dirname(self.cache_path(d)), exist_ok=True)
+                os.replace(self.partial_path(d), self.cache_path(d))
+            else:
+                with contextlib.suppress(FileNotFoundError):
+                    os.unlink(self.partial_path(d))
+
+    def has_partial(self, d: Digest) -> bool:
+        return os.path.exists(self.partial_path(d))
+
+    def delete_partial_file(self, d: Digest) -> None:
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(self.partial_path(d))
+
+    # -- reads -------------------------------------------------------------
+
+    def in_cache(self, d: Digest) -> bool:
+        return os.path.exists(self.cache_path(d))
+
+    def cache_size(self, d: Digest) -> int:
+        try:
+            return os.path.getsize(self.cache_path(d))
+        except FileNotFoundError:
+            raise KeyError(str(d)) from None
+
+    def open_cache_file(self, d: Digest) -> BinaryIO:
+        try:
+            return open(self.cache_path(d), "rb")
+        except FileNotFoundError:
+            raise KeyError(str(d)) from None
+
+    def read_cache_file(self, d: Digest) -> bytes:
+        with self.open_cache_file(d) as f:
+            return f.read()
+
+    def stream_cache_file(self, d: Digest) -> Iterator[bytes]:
+        with self.open_cache_file(d) as f:
+            while True:
+                chunk = f.read(_CHUNK)
+                if not chunk:
+                    return
+                yield chunk
+
+    def list_cache_digests(self) -> list[Digest]:
+        out = []
+        for dirpath, _dirnames, filenames in os.walk(self.cache_dir):
+            for name in filenames:
+                if len(name) == 64 and "._md_" not in name:
+                    out.append(Digest.from_hex(name))
+        return sorted(out)
+
+    def delete_cache_file(self, d: Digest) -> None:
+        path = self.cache_path(d)
+        with self._lock:
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(path)
+            for md in self._metadata_paths(path):
+                with contextlib.suppress(FileNotFoundError):
+                    os.unlink(md)
+
+    # -- metadata ----------------------------------------------------------
+
+    def _md_path(self, data_path: str, name: str) -> str:
+        return f"{data_path}._md_{name}"
+
+    def _metadata_paths(self, data_path: str) -> list[str]:
+        d = os.path.dirname(data_path)
+        base = os.path.basename(data_path)
+        if not os.path.isdir(d):
+            return []
+        return [
+            os.path.join(d, n)
+            for n in os.listdir(d)
+            if n.startswith(base + "._md_")
+        ]
+
+    def set_metadata(self, d: Digest, md: Metadata) -> None:
+        path = self._md_path(self.cache_path(d), md.name)
+        tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(md.serialize())
+        os.replace(tmp, path)
+
+    def get_metadata(self, d: Digest, cls: Type[M]) -> Optional[M]:
+        path = self._md_path(self.cache_path(d), cls.name)
+        try:
+            with open(path, "rb") as f:
+                return cls.deserialize(f.read())  # type: ignore[return-value]
+        except FileNotFoundError:
+            return None
+
+    def delete_metadata(self, d: Digest, cls: Type[Metadata]) -> None:
+        with contextlib.suppress(FileNotFoundError):
+            os.unlink(self._md_path(self.cache_path(d), cls.name))
+
+    # -- maintenance -------------------------------------------------------
+
+    def disk_usage_bytes(self) -> int:
+        total = 0
+        for dirpath, _dirnames, filenames in os.walk(self.cache_dir):
+            for name in filenames:
+                with contextlib.suppress(FileNotFoundError):
+                    total += os.path.getsize(os.path.join(dirpath, name))
+        return total
+
+    def wipe(self) -> None:
+        """Test helper: remove everything."""
+        shutil.rmtree(self.root, ignore_errors=True)
+        os.makedirs(self.upload_dir, exist_ok=True)
+        os.makedirs(self.cache_dir, exist_ok=True)

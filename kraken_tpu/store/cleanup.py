@@ -1,0 +1,91 @@
+"""Background cache eviction: idle-TTL plus disk-utilization watermarks.
+
+Mirrors uber/kraken ``lib/store/cleanup.go`` (``cleanupManager``: per-dir
+TTI/TTL and disk-pressure eviction) -- upstream path, unverified; SURVEY.md
+SS2.3. Services call :meth:`CleanupManager.run_once` from a periodic asyncio
+task; the logic itself is synchronous and testable without a loop.
+
+Policy, in order:
+1. evict blobs idle past ``tti_seconds`` (last access from TTIMetadata,
+   falling back to file mtime);
+2. if the store still exceeds ``high_watermark_bytes``, evict
+   least-recently-accessed blobs until under ``low_watermark_bytes``.
+``persist``-marked blobs (pending writeback) are never evicted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.store.castore import CAStore
+from kraken_tpu.store.metadata import PersistMetadata, TTIMetadata
+
+
+@dataclasses.dataclass
+class CleanupConfig:
+    tti_seconds: float = 6 * 3600
+    high_watermark_bytes: int = 0  # 0 = no size pressure eviction
+    low_watermark_bytes: int = 0
+    interval_seconds: float = 300.0
+
+
+class CleanupManager:
+    def __init__(self, store: CAStore, config: CleanupConfig | None = None):
+        self.store = store
+        self.config = config or CleanupConfig()
+
+    def touch(self, d: Digest) -> None:
+        """Record an access (callers: every blob read path)."""
+        self.store.set_metadata(d, TTIMetadata())
+
+    def _last_access(self, d: Digest) -> float:
+        md = self.store.get_metadata(d, TTIMetadata)
+        if md is not None:
+            return md.last_access
+        try:
+            return os.path.getmtime(self.store.cache_path(d))
+        except FileNotFoundError:
+            return 0.0
+
+    def _evictable(self, d: Digest) -> bool:
+        md = self.store.get_metadata(d, PersistMetadata)
+        return md is None or not md.persist
+
+    def run_once(self, now: float | None = None) -> list[Digest]:
+        """One eviction sweep; returns evicted digests."""
+        now = time.time() if now is None else now
+        cfg = self.config
+        evicted: list[Digest] = []
+
+        entries = [
+            (d, self._last_access(d))
+            for d in self.store.list_cache_digests()
+            if self._evictable(d)
+        ]
+
+        # 1. idle eviction
+        if cfg.tti_seconds > 0:
+            for d, last in list(entries):
+                if now - last > cfg.tti_seconds:
+                    self.store.delete_cache_file(d)
+                    evicted.append(d)
+                    entries.remove((d, last))
+
+        # 2. disk-pressure eviction, LRU order
+        if cfg.high_watermark_bytes > 0:
+            usage = self.store.disk_usage_bytes()
+            if usage > cfg.high_watermark_bytes:
+                for d, _last in sorted(entries, key=lambda e: e[1]):
+                    if usage <= cfg.low_watermark_bytes:
+                        break
+                    try:
+                        size = self.store.cache_size(d)
+                    except KeyError:
+                        continue
+                    self.store.delete_cache_file(d)
+                    evicted.append(d)
+                    usage -= size
+        return evicted

@@ -1,0 +1,114 @@
+"""Typed per-file metadata persisted beside cache files.
+
+The reference persists torrent piece-status bitfields and TTI flags as
+metadata files next to the data (uber/kraken ``lib/store/metadata``,
+factory-registered types -- upstream path, unverified; SURVEY.md SS2.3).
+The agent's crash-resume depends on it: a restarted download reads the
+piece bitfield and only fetches missing pieces (SURVEY.md SS5
+checkpoint/resume).
+
+Each type serializes to bytes and lives at ``<data_path>._md_<name>``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Type
+
+_REGISTRY: Dict[str, Type["Metadata"]] = {}
+
+
+def register_metadata(cls: Type["Metadata"]) -> Type["Metadata"]:
+    """Class decorator: register a metadata type by its ``name``."""
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def metadata_type(name: str) -> Type["Metadata"]:
+    return _REGISTRY[name]
+
+
+class Metadata:
+    """One typed metadata record attached to a stored file."""
+
+    name = "abstract"
+
+    def serialize(self) -> bytes:
+        raise NotImplementedError
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "Metadata":
+        raise NotImplementedError
+
+
+@register_metadata
+class PieceStatusMetadata(Metadata):
+    """Bitfield of completed pieces for a partially-downloaded blob."""
+
+    name = "piece_status"
+
+    def __init__(self, num_pieces: int, bits: bytearray | None = None):
+        self.num_pieces = num_pieces
+        nbytes = (num_pieces + 7) // 8
+        self.bits = bytearray(nbytes) if bits is None else bytearray(bits)
+        if len(self.bits) != nbytes:
+            raise ValueError(
+                f"bitfield length {len(self.bits)} != expected {nbytes}"
+            )
+
+    def has(self, i: int) -> bool:
+        return bool(self.bits[i // 8] >> (i % 8) & 1)
+
+    def set(self, i: int) -> None:
+        self.bits[i // 8] |= 1 << (i % 8)
+
+    def complete(self) -> bool:
+        return all(self.has(i) for i in range(self.num_pieces))
+
+    def count(self) -> int:
+        return sum(self.has(i) for i in range(self.num_pieces))
+
+    def missing(self) -> list[int]:
+        return [i for i in range(self.num_pieces) if not self.has(i)]
+
+    def serialize(self) -> bytes:
+        return self.num_pieces.to_bytes(4, "big") + bytes(self.bits)
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "PieceStatusMetadata":
+        n = int.from_bytes(raw[:4], "big")
+        return cls(n, bytearray(raw[4:]))
+
+
+@register_metadata
+class TTIMetadata(Metadata):
+    """Last-access timestamp driving idle (TTI) eviction."""
+
+    name = "tti"
+
+    def __init__(self, last_access: float | None = None):
+        self.last_access = time.time() if last_access is None else last_access
+
+    def serialize(self) -> bytes:
+        return repr(self.last_access).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TTIMetadata":
+        return cls(float(raw.decode()))
+
+
+@register_metadata
+class PersistMetadata(Metadata):
+    """Marks a cache file as exempt from eviction (e.g. pending writeback)."""
+
+    name = "persist"
+
+    def __init__(self, persist: bool = True):
+        self.persist = persist
+
+    def serialize(self) -> bytes:
+        return b"1" if self.persist else b"0"
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "PersistMetadata":
+        return cls(raw == b"1")

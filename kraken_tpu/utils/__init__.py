@@ -1,0 +1,1 @@
+"""Shared utilities (reference: uber/kraken ``utils/*`` -- SURVEY.md SS2.5)."""

@@ -1,0 +1,22 @@
+"""Exponential backoff with jitter (reference: uber/kraken ``utils/backoff``
+-- upstream path, unverified; SURVEY.md SS2.5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+
+@dataclasses.dataclass(frozen=True)
+class Backoff:
+    base_seconds: float = 0.25
+    factor: float = 2.0
+    max_seconds: float = 30.0
+    jitter: float = 0.2  # +/- fraction
+
+    def delay(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (0-based)."""
+        d = min(self.max_seconds, self.base_seconds * self.factor**attempt)
+        if self.jitter:
+            d *= 1 + random.uniform(-self.jitter, self.jitter)
+        return max(0.0, d)

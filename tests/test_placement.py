@@ -1,0 +1,145 @@
+"""Placement tests: rendezvous stability, ring re-placement on membership
+change, health filtering. SURVEY.md SS2.3/SS5."""
+
+import asyncio
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.placement import HostList, PassiveFilter, Ring, rendezvous_hash
+from kraken_tpu.placement.healthcheck import ActiveMonitor
+
+
+def digests(n):
+    return [Digest.from_bytes(str(i).encode()) for i in range(n)]
+
+
+# -- hrw --------------------------------------------------------------------
+
+def test_hrw_deterministic_and_complete():
+    nodes = [f"h{i}:80" for i in range(10)]
+    top = rendezvous_hash("key", nodes, k=3)
+    assert top == rendezvous_hash("key", nodes, k=3)
+    assert len(set(top)) == 3 and all(t in nodes for t in top)
+
+
+def test_hrw_minimal_disruption():
+    """Removing one node must only move keys that lived on it."""
+    nodes = [f"h{i}:80" for i in range(10)]
+    keys = [f"k{i}" for i in range(200)]
+    before = {k: rendezvous_hash(k, nodes, k=1)[0] for k in keys}
+    survivors = [n for n in nodes if n != "h3:80"]
+    for k in keys:
+        after = rendezvous_hash(k, survivors, k=1)[0]
+        if before[k] != "h3:80":
+            assert after == before[k]
+
+
+def test_hrw_balance():
+    nodes = [f"h{i}:80" for i in range(5)]
+    counts = {n: 0 for n in nodes}
+    for i in range(2000):
+        counts[rendezvous_hash(f"key{i}", nodes, k=1)[0]] += 1
+    # Each node gets 400 +- 50% -- loose, just catches gross skew.
+    for n, c in counts.items():
+        assert 200 < c < 600, counts
+
+
+# -- ring -------------------------------------------------------------------
+
+def test_ring_locations_replicas():
+    ring = Ring(HostList(static=[f"o{i}:80" for i in range(5)]), max_replica=3)
+    for d in digests(20):
+        locs = ring.locations(d)
+        assert len(locs) == 3 and len(set(locs)) == 3
+
+
+def test_ring_small_cluster():
+    ring = Ring(HostList(static=["solo:80"]), max_replica=3)
+    assert ring.locations(digests(1)[0]) == ["solo:80"]
+
+
+def test_ring_membership_change_notifies_and_replaces():
+    members = [f"o{i}:80" for i in range(4)]
+    ring = Ring(HostList(resolver=lambda: members), max_replica=2)
+    events = []
+    ring.on_change(events.append)
+
+    d_moved = [d for d in digests(50) if "o0:80" in ring.locations(d)]
+    assert d_moved, "setup: no digest placed on o0"
+    before = {d.hex: ring.locations(d) for d in digests(50)}
+
+    members = members[1:]  # o0 dies
+    assert ring.refresh() is True
+    assert events and "o0:80" not in events[0]
+    for d in digests(50):
+        locs = ring.locations(d)
+        assert "o0:80" not in locs
+        if "o0:80" not in before[d.hex]:
+            assert locs == before[d.hex]  # unaffected blobs stay put
+
+    assert ring.refresh() is False  # no further change
+
+
+def test_ring_health_filter_integration():
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=1000)
+    ring = Ring(
+        HostList(static=["a:1", "b:1", "c:1"]),
+        max_replica=2,
+        health_filter=pf.filter,
+    )
+    assert set(ring.members) == {"a:1", "b:1", "c:1"}
+    pf.failed("b:1")
+    ring.refresh()
+    assert "b:1" not in ring.members
+    pf.succeeded("b:1")
+    ring.refresh()
+    assert "b:1" in ring.members
+
+
+def test_ring_empty_raises():
+    ring = Ring(HostList(resolver=lambda: []), max_replica=1)
+    with pytest.raises(RuntimeError):
+        ring.locations(digests(1)[0])
+
+
+# -- health -----------------------------------------------------------------
+
+def test_passive_filter_threshold_and_cooldown():
+    pf = PassiveFilter(fail_threshold=2, cooldown_seconds=10)
+    assert pf.healthy("h", now=0)
+    pf.failed("h", now=0)
+    assert pf.healthy("h", now=1)  # 1 fail < threshold
+    pf.failed("h", now=1)
+    assert not pf.healthy("h", now=2)
+    assert pf.healthy("h", now=12)  # cooldown expired
+
+
+def test_passive_filter_never_empties():
+    pf = PassiveFilter(fail_threshold=1)
+    pf.failed("a", now=0)
+    pf.failed("b", now=0)
+    assert pf.filter(["a", "b"], now=0) == ["a", "b"]
+
+
+def test_active_monitor_thresholds():
+    health = {"h": True}
+
+    async def probe(host):
+        return health[host]
+
+    mon = ActiveMonitor(probe, pass_threshold=1, fail_threshold=2)
+
+    async def main():
+        await mon.check_all(["h"])
+        assert mon.healthy("h")
+        health["h"] = False
+        await mon.check_all(["h"])
+        assert mon.healthy("h")  # 1 fail < threshold 2
+        await mon.check_all(["h"])
+        assert not mon.healthy("h")  # 2 consecutive fails
+        health["h"] = True
+        await mon.check_all(["h"])
+        assert mon.healthy("h")  # pass_threshold 1
+
+    asyncio.run(main())

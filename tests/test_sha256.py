@@ -113,3 +113,25 @@ def test_hash_batch_mixed_sizes_bounded_memory():
     got = h.hash_batch(pieces)
     for row, p in zip(got, pieces):
         assert bytes(row) == hashlib.sha256(p).digest()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("RUN_PALLAS_INTERPRET"),
+    reason="interpret-mode kernel execution takes minutes on CPU; the "
+    "kernel is golden-tested on real TPU (set RUN_PALLAS_INTERPRET=1)",
+)
+def test_pallas_kernel_interpret_mode():
+    """The Pallas kernel (interpret mode on CPU) matches hashlib, including
+    block-group padding (chains not a multiple of the kernel's _KB)."""
+    import jax.numpy as jnp
+
+    from kraken_tpu.ops.sha256_pallas import hash_pieces_device
+
+    for pl_len, n in ((64, 3), (576, 5), (1024, 2)):
+        data = np.frombuffer(os.urandom(n * pl_len), dtype=np.uint8).reshape(n, pl_len)
+        out = hash_pieces_device(jnp.asarray(data), pl_len)
+        from kraken_tpu.ops.sha256 import _digest_bytes
+
+        got = _digest_bytes(out)
+        for i in range(n):
+            assert bytes(got[i]) == hashlib.sha256(data[i].tobytes()).digest()

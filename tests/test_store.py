@@ -1,0 +1,188 @@
+"""CAStore / metadata / cleanup tests. SURVEY.md SS4 tier 1."""
+
+import os
+import threading
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.store import CAStore, FileExistsInCacheError, PieceStatusMetadata
+from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
+from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+from kraken_tpu.store.metadata import PersistMetadata, TTIMetadata
+
+
+@pytest.fixture
+def store(tmp_path):
+    return CAStore(str(tmp_path / "store"))
+
+
+def put(store, data: bytes) -> Digest:
+    d = Digest.from_bytes(data)
+    uid = store.create_upload()
+    store.write_upload_chunk(uid, 0, data)
+    store.commit_upload(uid, d)
+    return d
+
+
+def test_upload_commit_read(store):
+    data = os.urandom(10000)
+    d = put(store, data)
+    assert store.in_cache(d)
+    assert store.read_cache_file(d) == data
+    assert store.cache_size(d) == len(data)
+    assert b"".join(store.stream_cache_file(d)) == data
+
+
+def test_chunked_out_of_order_upload(store):
+    data = os.urandom(9000)
+    d = Digest.from_bytes(data)
+    uid = store.create_upload()
+    store.write_upload_chunk(uid, 5000, data[5000:])
+    store.write_upload_chunk(uid, 0, data[:5000])
+    store.commit_upload(uid, d)
+    assert store.read_cache_file(d) == data
+
+
+def test_commit_verifies_digest(store):
+    uid = store.create_upload()
+    store.write_upload_chunk(uid, 0, b"hello")
+    wrong = Digest.from_bytes(b"other")
+    with pytest.raises(DigestMismatchError):
+        store.commit_upload(uid, wrong)
+    assert not store.upload_exists(uid)  # poisoned upload removed
+
+
+def test_duplicate_commit_raises_exists(store):
+    data = b"same content"
+    d = put(store, data)
+    uid = store.create_upload()
+    store.write_upload_chunk(uid, 0, data)
+    with pytest.raises(FileExistsInCacheError):
+        store.commit_upload(uid, d)
+    assert store.read_cache_file(d) == data
+
+
+def test_unknown_upload(store):
+    with pytest.raises(UploadNotFoundError):
+        store.write_upload_chunk("nope", 0, b"x")
+    with pytest.raises(UploadNotFoundError):
+        store.commit_upload("nope", Digest.from_bytes(b"x"))
+
+
+def test_missing_cache_file(store):
+    with pytest.raises(KeyError):
+        store.read_cache_file(Digest.from_bytes(b"missing"))
+
+
+def test_create_cache_file_stream(store):
+    data = os.urandom(100_000)
+    d = Digest.from_bytes(data)
+    store.create_cache_file(d, iter([data[:40_000], data[40_000:]]))
+    assert store.read_cache_file(d) == data
+    # idempotent
+    store.create_cache_file(d, iter([data]))
+
+
+def test_allocate_and_metadata_roundtrip(store):
+    d = Digest.from_bytes(b"torrent target")
+    path = store.allocate_partial_file(d, 1 << 16)
+    assert os.path.getsize(path) == 1 << 16
+    assert store.has_partial(d) and not store.in_cache(d)
+
+    md = PieceStatusMetadata(10)
+    md.set(3)
+    md.set(9)
+    store.set_metadata(d, md)
+    got = store.get_metadata(d, PieceStatusMetadata)
+    assert got.has(3) and got.has(9) and not got.has(0)
+    assert got.missing() == [0, 1, 2, 4, 5, 6, 7, 8]
+    assert not got.complete()
+    for i in range(10):
+        got.set(i)
+    assert got.complete() and got.count() == 10
+
+
+def test_metadata_absent_returns_none(store):
+    d = put(store, b"blob")
+    assert store.get_metadata(d, PieceStatusMetadata) is None
+
+
+def test_delete_removes_data_and_metadata(store):
+    d = put(store, b"to delete")
+    store.set_metadata(d, TTIMetadata(123.0))
+    store.delete_cache_file(d)
+    assert not store.in_cache(d)
+    assert store.get_metadata(d, TTIMetadata) is None
+
+
+def test_list_and_disk_usage(store):
+    digests = {put(store, os.urandom(1000)) for _ in range(5)}
+    assert set(store.list_cache_digests()) == digests
+    assert store.disk_usage_bytes() >= 5000
+
+
+def test_concurrent_same_digest_commit(store):
+    """CAS: racing commits of identical content -> one winner, no error
+    escapes, content intact."""
+    data = os.urandom(5000)
+    d = Digest.from_bytes(data)
+    errs = []
+
+    def worker():
+        uid = store.create_upload()
+        store.write_upload_chunk(uid, 0, data)
+        try:
+            store.commit_upload(uid, d)
+        except FileExistsInCacheError:
+            pass
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert store.read_cache_file(d) == data
+
+
+# -- cleanup ----------------------------------------------------------------
+
+
+def test_cleanup_tti_eviction(store):
+    mgr = CleanupManager(store, CleanupConfig(tti_seconds=100))
+    d_old = put(store, b"old blob")
+    d_new = put(store, b"new blob")
+    store.set_metadata(d_old, TTIMetadata(1000.0))
+    store.set_metadata(d_new, TTIMetadata(2000.0))
+    evicted = mgr.run_once(now=1500.0)
+    assert evicted == [d_old]
+    assert not store.in_cache(d_old) and store.in_cache(d_new)
+
+
+def test_cleanup_watermark_lru(store):
+    mgr = CleanupManager(
+        store,
+        CleanupConfig(tti_seconds=0, high_watermark_bytes=2500, low_watermark_bytes=1500),
+    )
+    ds = [put(store, os.urandom(1000)) for _ in range(3)]
+    for i, d in enumerate(ds):
+        store.set_metadata(d, TTIMetadata(float(i)))
+    evicted = mgr.run_once(now=10.0)
+    # Evicts oldest-accessed until <= low watermark: drops ds[0], ds[1].
+    assert evicted == [ds[0], ds[1]]
+    assert store.in_cache(ds[2])
+
+
+def test_cleanup_respects_persist(store):
+    mgr = CleanupManager(store, CleanupConfig(tti_seconds=10))
+    d = put(store, b"writeback pending")
+    store.set_metadata(d, TTIMetadata(0.0))
+    store.set_metadata(d, PersistMetadata(True))
+    assert mgr.run_once(now=1e9) == []
+    assert store.in_cache(d)
+    # Unmark -> evictable.
+    store.set_metadata(d, PersistMetadata(False))
+    assert mgr.run_once(now=1e9) == [d]

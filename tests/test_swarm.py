@@ -1,0 +1,241 @@
+"""In-process multi-peer swarm tests: real schedulers, real TCP conns, real
+piece exchange on localhost; fake announce/metainfo layer.
+
+This is the reference's key testing trick (SURVEY.md SS4 tier 3): full swarm
+behavior -- seeder->leecher, N-way fan-out, piece verification, blacklist --
+with no containers.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
+from kraken_tpu.p2p.storage import AgentTorrentArchive, BatchedVerifier, OriginTorrentArchive
+from kraken_tpu.store import CAStore
+
+NS = "test-ns"
+
+
+def make_metainfo(blob: bytes, piece_length: int = 4096) -> MetaInfo:
+    hashes = get_hasher("cpu").hash_pieces(blob, piece_length)
+    return MetaInfo(Digest.from_bytes(blob), len(blob), piece_length, hashes.tobytes())
+
+
+class FakeTracker:
+    """In-memory announce + metainfo service shared by all peers in test."""
+
+    def __init__(self, interval: float = 0.2):
+        self.metainfos: dict[str, MetaInfo] = {}
+        self.peers: dict[str, dict[str, PeerInfo]] = {}  # info_hash -> peers
+        self.interval = interval
+
+    def client_for(self, scheduler_ref: dict):
+        tracker = self
+
+        class _Client:
+            async def get(self, namespace: str, d: Digest) -> MetaInfo:
+                return tracker.metainfos[d.hex]
+
+            async def announce(self, d, h, namespace, complete):
+                sched = scheduler_ref["s"]
+                me = PeerInfo(
+                    peer_id=sched.peer_id, ip=sched.ip, port=sched.port,
+                    complete=complete,
+                )
+                swarm = tracker.peers.setdefault(h.hex, {})
+                swarm[me.peer_id.hex] = me
+                others = [p for pid, p in swarm.items() if pid != me.peer_id.hex]
+                return others, tracker.interval
+
+        return _Client()
+
+
+def make_peer(tmp_path, name: str, tracker: FakeTracker, seed_blob: bytes | None = None):
+    """Build a scheduler with its own store. If ``seed_blob``, preload and
+    seed it (origin-style)."""
+    store = CAStore(str(tmp_path / name))
+    verifier = BatchedVerifier()
+    ref: dict = {}
+    if seed_blob is not None:
+        d = Digest.from_bytes(seed_blob)
+        store.create_cache_file(d, iter([seed_blob]))
+        archive = OriginTorrentArchive(store, verifier)
+    else:
+        archive = AgentTorrentArchive(store, verifier)
+    client = tracker.client_for(ref)
+    sched = Scheduler(
+        peer_id=PeerID(os.urandom(20).hex()),
+        ip="127.0.0.1",
+        port=0,
+        archive=archive,
+        metainfo_client=client,
+        announce_client=client,
+        config=SchedulerConfig(
+            announce_interval_seconds=0.1,
+            retry_tick_seconds=0.2,
+            dial_timeout_seconds=2.0,
+        ),
+    )
+    ref["s"] = sched
+    return sched, store
+
+
+async def start_all(*scheds):
+    for s in scheds:
+        await s.start()
+
+
+async def stop_all(*scheds):
+    for s in scheds:
+        await s.stop()
+
+
+def test_seeder_to_leecher(tmp_path):
+    async def main():
+        blob = os.urandom(100_000)
+        mi = make_metainfo(blob)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        seeder, _ = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(tmp_path, "leecher", tracker)
+        await start_all(seeder, leecher)
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 15)
+            assert lstore.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(seeder, leecher)
+
+    asyncio.run(main())
+
+
+def test_multi_leecher_fanout(tmp_path):
+    """One seeder, several leechers downloading concurrently; all must
+    converge byte-identically (pieces flow leecher<->leecher too)."""
+
+    async def main():
+        blob = os.urandom(300_000)
+        mi = make_metainfo(blob, piece_length=8192)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        seeder, _ = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+        leechers = [make_peer(tmp_path, f"l{i}", tracker) for i in range(4)]
+        await start_all(seeder, *(s for s, _ in leechers))
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(
+                asyncio.gather(*(s.download(NS, mi.digest) for s, _ in leechers)),
+                30,
+            )
+            for _s, store in leechers:
+                assert store.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(seeder, *(s for s, _ in leechers))
+
+    asyncio.run(main())
+
+
+def test_download_coalesces(tmp_path):
+    async def main():
+        blob = os.urandom(50_000)
+        mi = make_metainfo(blob)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+        seeder, _ = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(tmp_path, "leecher", tracker)
+        await start_all(seeder, leecher)
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(
+                asyncio.gather(*(leecher.download(NS, mi.digest) for _ in range(5))),
+                15,
+            )
+            assert lstore.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(seeder, leecher)
+
+    asyncio.run(main())
+
+
+def test_resume_from_partial(tmp_path):
+    """A leecher with a persisted partial bitfield only fetches missing
+    pieces and completes (crash-resume, SURVEY.md SS5)."""
+
+    async def main():
+        blob = os.urandom(64 * 1024)
+        mi = make_metainfo(blob, piece_length=4096)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        seeder, _ = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(tmp_path, "leecher", tracker)
+
+        # Pre-populate half the pieces as a crashed download would leave.
+        from kraken_tpu.store import PieceStatusMetadata
+
+        lstore.allocate_partial_file(mi.digest, mi.length)
+        status = PieceStatusMetadata(mi.num_pieces)
+        path = lstore.partial_path(mi.digest)
+        with open(path, "r+b") as f:
+            for i in range(0, mi.num_pieces, 2):
+                f.seek(i * mi.piece_length)
+                f.write(blob[i * mi.piece_length : (i + 1) * mi.piece_length])
+                status.set(i)
+        lstore.set_metadata(mi.digest, status)
+
+        await start_all(seeder, leecher)
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 15)
+            assert lstore.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(seeder, leecher)
+
+    asyncio.run(main())
+
+
+def test_corrupt_seeder_blacklisted(tmp_path):
+    """A peer serving corrupt pieces gets dropped + blacklisted; the
+    download then succeeds from an honest seeder."""
+
+    async def main():
+        blob = os.urandom(60_000)
+        mi = make_metainfo(blob, piece_length=4096)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        # Evil seeder: same metainfo, different (wrong) content.
+        evil_blob = os.urandom(len(blob))
+        evil, estore = make_peer(tmp_path, "evil", tracker, seed_blob=evil_blob)
+        # Register evil's torrent under the REAL metainfo: build a lying
+        # archive view by committing evil blob under the real digest.
+        estore.wipe()
+        estore.create_cache_file(mi.digest, iter([evil_blob]), verify=False)
+
+        honest, _ = make_peer(tmp_path, "honest", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(tmp_path, "leecher", tracker)
+
+        await start_all(evil, honest, leecher)
+        try:
+            evil.seed(mi, NS)
+            await asyncio.sleep(0.15)  # let evil announce first
+            honest.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 20)
+            assert lstore.read_cache_file(mi.digest) == blob
+            # evil must be blacklisted for this torrent
+            assert any(
+                leecher.conn_state.blacklist.blocked(evil.peer_id, mi.info_hash)
+                for _ in [0]
+            )
+        finally:
+            await stop_all(evil, honest, leecher)
+
+    asyncio.run(main())

@@ -1,0 +1,153 @@
+"""Utils tests: token bucket, request coalescing, TTL cache, backoff,
+HTTP client retry behavior. SURVEY.md SS2.5."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+
+from kraken_tpu.utils.backoff import Backoff
+from kraken_tpu.utils.bandwidth import TokenBucket
+from kraken_tpu.utils.dedup import RequestCoalescer, TTLCache
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError, is_not_found
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- bandwidth --------------------------------------------------------------
+
+def test_token_bucket_unlimited():
+    tb = TokenBucket(0)
+    assert tb.try_acquire(1e12)
+    run(tb.acquire(1e12))  # returns immediately
+
+
+def test_token_bucket_burst_then_throttle():
+    async def main():
+        tb = TokenBucket(rate=10_000, capacity=1_000)
+        t0 = time.monotonic()
+        await tb.acquire(1_000)   # burst
+        await tb.acquire(500)     # needs refill: ~0.05s
+        assert time.monotonic() - t0 > 0.03
+
+    run(main())
+
+
+def test_token_bucket_oversized_request_passes():
+    async def main():
+        tb = TokenBucket(rate=1e6, capacity=100)
+        await tb.acquire(1000)  # > capacity: allowed once bucket is full
+
+    run(main())
+
+
+def test_try_acquire():
+    tb = TokenBucket(rate=100, capacity=100)
+    assert tb.try_acquire(100)
+    assert not tb.try_acquire(100)
+
+
+# -- dedup ------------------------------------------------------------------
+
+def test_coalescer_single_flight():
+    async def main():
+        calls = 0
+
+        async def fetch():
+            nonlocal calls
+            calls += 1
+            await asyncio.sleep(0.05)
+            return "blob"
+
+        co = RequestCoalescer()
+        results = await asyncio.gather(*(co.get("k", fetch) for _ in range(10)))
+        assert results == ["blob"] * 10
+        assert calls == 1
+        # After completion, a new call re-invokes.
+        await co.get("k", fetch)
+        assert calls == 2
+
+    run(main())
+
+
+def test_coalescer_propagates_errors():
+    async def main():
+        async def boom():
+            await asyncio.sleep(0.01)
+            raise ValueError("x")
+
+        co = RequestCoalescer()
+        results = await asyncio.gather(
+            *(co.get("k", boom) for _ in range(3)), return_exceptions=True
+        )
+        assert all(isinstance(r, ValueError) for r in results)
+
+    run(main())
+
+
+def test_ttl_cache():
+    c = TTLCache(ttl_seconds=0.05)
+    c.put("k", 1)
+    assert c.get("k") == 1
+    time.sleep(0.08)
+    assert c.get("k") is None
+    c.put("k", 2)
+    c.invalidate("k")
+    assert c.get("k") is None
+
+
+# -- backoff ----------------------------------------------------------------
+
+def test_backoff_growth_and_cap():
+    b = Backoff(base_seconds=1, factor=2, max_seconds=5, jitter=0)
+    assert [b.delay(i) for i in range(4)] == [1, 2, 4, 5]
+
+
+def test_backoff_jitter_bounds():
+    b = Backoff(base_seconds=1, factor=1, max_seconds=1, jitter=0.5)
+    for _ in range(50):
+        assert 0.5 <= b.delay(0) <= 1.5
+
+
+# -- httputil ---------------------------------------------------------------
+
+def test_http_client_retries_5xx_and_types_errors():
+    async def main():
+        hits = {"flaky": 0, "missing": 0}
+
+        async def flaky(req):
+            hits["flaky"] += 1
+            if hits["flaky"] < 3:
+                return web.Response(status=503)
+            return web.Response(text="ok")
+
+        async def missing(req):
+            hits["missing"] += 1
+            return web.Response(status=404)
+
+        app = web.Application()
+        app.router.add_get("/flaky", flaky)
+        app.router.add_get("/missing", missing)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+
+        client = HTTPClient(retries=3, backoff=Backoff(base_seconds=0.01, jitter=0))
+        try:
+            assert await client.get(f"{base}/flaky") == b"ok"
+            assert hits["flaky"] == 3
+            with pytest.raises(HTTPError) as ei:
+                await client.get(f"{base}/missing")
+            assert is_not_found(ei.value)
+            assert hits["missing"] == 1  # 4xx not retried
+        finally:
+            await client.close()
+            await runner.cleanup()
+
+    run(main())
