@@ -1,0 +1,6 @@
+"""Agent: the per-host download daemon.
+
+Mirrors uber/kraken ``agent/`` (agentserver HTTP API triggering P2P
+downloads; localhost docker-registry endpoint) -- upstream paths,
+unverified; SURVEY.md SS2.4/SS3.1.
+"""

@@ -1,0 +1,84 @@
+"""Agent HTTP API.
+
+Mirrors uber/kraken ``agent/agentserver`` (GET blob triggers the P2P
+download and streams the result; delete; health/readiness) -- upstream
+path, unverified; SURVEY.md SS2.4/SS3.1.
+
+Endpoints:
+
+    GET    /namespace/{ns}/blobs/{d}     -> downloads via swarm, streams blob
+    GET    /namespace/{ns}/blobs/{d}/stat
+    DELETE /blobs/{d}
+    GET    /health
+    GET    /readiness                    -> 200 once the scheduler listens
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from kraken_tpu.core.digest import Digest, DigestError
+from kraken_tpu.p2p.scheduler import Scheduler
+from kraken_tpu.store import CAStore
+
+
+class AgentServer:
+    def __init__(self, store: CAStore, scheduler: Scheduler,
+                 download_timeout_seconds: float = 300.0):
+        self.store = store
+        self.scheduler = scheduler
+        self.download_timeout = download_timeout_seconds
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
+        r.add_get("/namespace/{ns}/blobs/{d}", self._download)
+        r.add_delete("/blobs/{d}", self._delete)
+        r.add_get("/health", self._health)
+        r.add_get("/readiness", self._readiness)
+        return app
+
+    def _digest(self, req: web.Request) -> Digest:
+        try:
+            return Digest.from_hex(req.match_info["d"])
+        except DigestError:
+            raise web.HTTPBadRequest(text="malformed digest")
+
+    async def _download(self, req: web.Request) -> web.Response:
+        ns = req.match_info["ns"]
+        d = self._digest(req)
+        if not self.store.in_cache(d):
+            try:
+                await asyncio.wait_for(
+                    self.scheduler.download(ns, d), self.download_timeout
+                )
+            except asyncio.TimeoutError:
+                raise web.HTTPGatewayTimeout(text="download timed out")
+            except Exception as e:
+                raise web.HTTPInternalServerError(text=f"download failed: {e}")
+        data = await asyncio.to_thread(self.store.read_cache_file, d)
+        return web.Response(body=data)
+
+    async def _stat(self, req: web.Request) -> web.Response:
+        d = self._digest(req)
+        try:
+            size = self.store.cache_size(d)
+        except KeyError:
+            raise web.HTTPNotFound(text="blob not found")
+        return web.json_response({"size": size})
+
+    async def _delete(self, req: web.Request) -> web.Response:
+        d = self._digest(req)
+        await asyncio.to_thread(self.store.delete_cache_file, d)
+        return web.Response(status=204)
+
+    async def _health(self, req: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _readiness(self, req: web.Request) -> web.Response:
+        if self.scheduler._server is None:
+            raise web.HTTPServiceUnavailable(text="scheduler not started")
+        return web.Response(text="ready")

@@ -1,0 +1,361 @@
+"""Component assembly: wire stores, schedulers, and HTTP servers into
+runnable origin / tracker / agent nodes.
+
+Mirrors the reference's per-binary ``cmd`` wiring (uber/kraken agent/cmd,
+origin/cmd, tracker/cmd -- upstream paths, unverified; SURVEY.md SS2.4/SS3.3)
+as in-process node objects: the CLI runs one per process; the herd tests
+run several per process.
+
+Config keys follow the component YAML shape (SURVEY.md SS5 config):
+``hasher: tpu|cpu`` selects the piece-hash plane, exactly as the north
+star specifies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from kraken_tpu.backend import Manager as BackendManager
+from kraken_tpu.agent.server import AgentServer
+from kraken_tpu.core.digest import Digest, DigestError
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.peer import PeerIDFactory
+from kraken_tpu.origin.blobrefresh import Refresher
+from kraken_tpu.origin.client import ClusterClient
+from kraken_tpu.origin.metainfogen import Generator, PieceLengthConfig
+from kraken_tpu.origin.server import OriginServer
+from kraken_tpu.origin.writeback import WritebackExecutor
+from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
+from kraken_tpu.p2p.storage import (
+    AgentTorrentArchive,
+    BatchedVerifier,
+    OriginTorrentArchive,
+)
+from kraken_tpu.store import CAStore
+from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+from kraken_tpu.tracker.client import TrackerClient
+from kraken_tpu.tracker.peerstore import InMemoryPeerStore
+from kraken_tpu.tracker.server import TrackerServer
+
+
+async def _serve(app: web.Application, host: str, port: int):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual = site._server.sockets[0].getsockname()[1]
+    return runner, actual
+
+
+class TrackerNode:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 origin_cluster: ClusterClient | None = None,
+                 announce_interval_seconds: float = 3.0,
+                 peer_ttl_seconds: float = 30.0):
+        self.host = host
+        self.port = port
+        self.server = TrackerServer(
+            peer_store=InMemoryPeerStore(ttl_seconds=peer_ttl_seconds),
+            origin_cluster=origin_cluster,
+            announce_interval_seconds=announce_interval_seconds,
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._runner, self.port = await _serve(
+            self.server.make_app(), self.host, self.port
+        )
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+
+class OriginNode:
+    """Origin: CAStore + TPU metainfo-gen + blobserver + P2P seeding."""
+
+    def __init__(
+        self,
+        store_root: str,
+        tracker_addr: str = "",
+        host: str = "127.0.0.1",
+        http_port: int = 0,
+        p2p_port: int = 0,
+        hasher: str = "cpu",
+        backends: BackendManager | None = None,
+        ring: Ring | None = None,
+        self_addr: str = "",
+        retry_db: str = "",
+        piece_lengths: PieceLengthConfig | None = None,
+        cleanup: CleanupConfig | None = None,
+    ):
+        self.host = host
+        self.http_port = http_port
+        self.p2p_port = p2p_port
+        self.tracker_addr = tracker_addr
+        self.store = CAStore(store_root)
+        self.generator = Generator(
+            self.store, hasher=get_hasher(hasher), piece_lengths=piece_lengths
+        )
+        self.backends = backends
+        self.refresher = (
+            Refresher(self.store, backends, self.generator) if backends else None
+        )
+        self.retry = (
+            RetryManager(TaskStore(retry_db or f"{store_root}/retry.db"))
+        )
+        self.writeback = (
+            WritebackExecutor(self.store, backends, self.retry) if backends else None
+        )
+        self.ring = ring
+        self.self_addr = self_addr
+        self.cleanup = CleanupManager(self.store, cleanup) if cleanup else None
+        self.scheduler: Optional[Scheduler] = None
+        self.server: Optional[OriginServer] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._tracker_client: Optional[TrackerClient] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.http_port}"
+
+    def _resolve_metainfo(self, name: str, namespace: str):
+        try:
+            return self.generator.get_cached(Digest.from_hex(name))
+        except DigestError:
+            return None
+
+    async def start(self) -> None:
+        # Fixed p2p port -> stable addr_hash identity across restarts (the
+        # reference's default); ephemeral port -> random identity.
+        factory = PeerIDFactory(
+            PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
+        )
+        peer_id = factory.create(self.host, self.p2p_port)
+        # The p2p scheduler seeds cached blobs; origins announce as origin
+        # peers so trackers hand them out last.
+        self._tracker_client = TrackerClient(
+            self.tracker_addr, peer_id, self.host, 0, is_origin=True
+        )
+        self.scheduler = Scheduler(
+            peer_id=peer_id,
+            ip=self.host,
+            port=self.p2p_port,
+            archive=OriginTorrentArchive(self.store, BatchedVerifier()),
+            metainfo_client=self._tracker_client,
+            announce_client=self._tracker_client,
+            is_origin=True,
+            metainfo_resolver=self._resolve_metainfo,
+        )
+        await self.scheduler.start()
+        self._tracker_client.port = self.scheduler.port
+        self.server = OriginServer(
+            store=self.store,
+            generator=self.generator,
+            refresher=self.refresher,
+            writeback=self.writeback,
+            retry=self.retry,
+            ring=self.ring,
+            self_addr=self.self_addr,
+            scheduler=self.scheduler,
+        )
+        self._runner, self.http_port = await _serve(
+            self.server.make_app(), self.host, self.http_port
+        )
+        if not self.self_addr:
+            self.self_addr = self.addr
+            self.server.self_addr = self.addr
+        self.retry.start()
+        # Seed everything already on disk (origin startup behavior).
+        for d in self.store.list_cache_digests():
+            metainfo = self.generator.get_cached(d)
+            if metainfo is not None:
+                self.scheduler.seed(metainfo, "startup")
+
+    async def stop(self) -> None:
+        self.retry.stop()
+        if self.scheduler:
+            await self.scheduler.stop()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._tracker_client:
+            await self._tracker_client.close()
+
+
+class BuildIndexNode:
+    """Build-index: tag server + durable replication."""
+
+    def __init__(
+        self,
+        store_root: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        backends: BackendManager | None = None,
+        remotes: list[str] | None = None,
+        origin_cluster: ClusterClient | None = None,
+    ):
+        from kraken_tpu.buildindex.server import TagServer
+        from kraken_tpu.buildindex.tagstore import TagStore
+
+        self.host = host
+        self.port = port
+        self.retry = RetryManager(TaskStore(f"{store_root}/retry.db"))
+        self.store = TagStore(
+            f"{store_root}/tags", backends=backends, retry=self.retry
+        )
+        self.server = TagServer(
+            self.store,
+            retry=self.retry,
+            remotes=remotes,
+            origin_cluster=origin_cluster,
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._runner, self.port = await _serve(
+            self.server.make_app(), self.host, self.port
+        )
+        self.retry.start()
+
+    async def stop(self) -> None:
+        self.retry.stop()
+        if self._runner:
+            await self._runner.cleanup()
+
+
+class ProxyNode:
+    """Proxy: the docker-push registry frontend (write mode)."""
+
+    def __init__(
+        self,
+        origin_cluster: ClusterClient,
+        build_index_addr: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        from kraken_tpu.buildindex.server import TagClient
+        from kraken_tpu.dockerregistry.registry import RegistryServer
+        from kraken_tpu.dockerregistry.transfer import ProxyTransferer
+
+        self.host = host
+        self.port = port
+        self._tag_client = TagClient(build_index_addr)
+        self.server = RegistryServer(
+            ProxyTransferer(origin_cluster, self._tag_client), read_only=False
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._runner, self.port = await _serve(
+            self.server.make_app(), self.host, self.port
+        )
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+        await self._tag_client.close()
+
+
+class AgentNode:
+    """Agent: download daemon + agentserver (+ optional docker-registry
+    read endpoint when a build-index address is configured)."""
+
+    def __init__(
+        self,
+        store_root: str,
+        tracker_addr: str,
+        host: str = "127.0.0.1",
+        http_port: int = 0,
+        p2p_port: int = 0,
+        registry_port: int = 0,
+        build_index_addr: str = "",
+        hasher: str = "cpu",
+        cleanup: CleanupConfig | None = None,
+        scheduler_config: SchedulerConfig | None = None,
+    ):
+        self.host = host
+        self.http_port = http_port
+        self.p2p_port = p2p_port
+        self.registry_port = registry_port
+        self.build_index_addr = build_index_addr
+        self.tracker_addr = tracker_addr
+        self.store = CAStore(store_root)
+        self.verifier = BatchedVerifier(hasher=get_hasher(hasher))
+        self.cleanup = CleanupManager(self.store, cleanup) if cleanup else None
+        self.scheduler_config = scheduler_config
+        self.scheduler: Optional[Scheduler] = None
+        self.server: Optional[AgentServer] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._registry_runner: Optional[web.AppRunner] = None
+        self._tracker_client: Optional[TrackerClient] = None
+        self._tag_client = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.http_port}"
+
+    async def start(self) -> None:
+        factory = PeerIDFactory(
+            PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
+        )
+        peer_id = factory.create(self.host, self.p2p_port)
+        self._tracker_client = TrackerClient(
+            self.tracker_addr, peer_id, self.host, 0
+        )
+        self.scheduler = Scheduler(
+            peer_id=peer_id,
+            ip=self.host,
+            port=self.p2p_port,
+            archive=AgentTorrentArchive(self.store, self.verifier),
+            metainfo_client=self._tracker_client,
+            announce_client=self._tracker_client,
+            config=self.scheduler_config,
+        )
+        await self.scheduler.start()
+        self._tracker_client.port = self.scheduler.port
+        self.server = AgentServer(self.store, self.scheduler)
+        self._runner, self.http_port = await _serve(
+            self.server.make_app(), self.host, self.http_port
+        )
+        if self.build_index_addr:
+            from kraken_tpu.buildindex.server import TagClient
+            from kraken_tpu.dockerregistry.registry import RegistryServer
+            from kraken_tpu.dockerregistry.transfer import ReadOnlyTransferer
+
+            self._tag_client = TagClient(self.build_index_addr)
+            registry = RegistryServer(
+                ReadOnlyTransferer(self.store, self.scheduler, self._tag_client),
+                read_only=True,
+            )
+            self._registry_runner, self.registry_port = await _serve(
+                registry.make_app(), self.host, self.registry_port
+            )
+
+    async def stop(self) -> None:
+        if self.scheduler:
+            await self.scheduler.stop()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._registry_runner:
+            await self._registry_runner.cleanup()
+        if self._tracker_client:
+            await self._tracker_client.close()
+        if self._tag_client:
+            await self._tag_client.close()

@@ -1,0 +1,7 @@
+"""Build-index: tag -> manifest-digest mapping + cross-cluster replication.
+
+Mirrors uber/kraken ``build-index/`` (tagserver HTTP API, tagstore with
+disk cache + backend writeback, durable tag replication to remote
+clusters, tag-type dependency resolution) -- upstream paths, unverified;
+SURVEY.md SS2.4.
+"""

@@ -1,0 +1,135 @@
+"""Component entry points: one long-running process per component.
+
+Mirrors the reference's per-binary ``cmd`` mains (uber/kraken agent/cmd,
+origin/cmd, tracker/cmd -- upstream paths, unverified; SURVEY.md SS2.4).
+
+    python -m kraken_tpu.cli tracker --port 7602
+    python -m kraken_tpu.cli origin  --config origin.yaml
+    python -m kraken_tpu.cli agent   --config agent.yaml --tracker host:7602
+
+Config YAML keys mirror the constructor arguments of the assembly nodes
+(kraken_tpu/assembly.py); flags override config values.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.backend import Manager as BackendManager
+from kraken_tpu.configutil import load_config
+from kraken_tpu.origin.client import ClusterClient
+from kraken_tpu.placement import HostList, Ring
+
+
+async def _run_until_signal(node, describe: dict) -> None:
+    await node.start()
+    describe["addr"] = node.addr
+    # One machine-readable line so herd harnesses can scrape the bound ports.
+    print("READY " + json.dumps(describe), flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await node.stop()
+
+
+def _common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--config", default=None, help="YAML config path")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None, help="HTTP port")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="kraken-tpu")
+    sub = parser.add_subparsers(dest="component", required=True)
+
+    p_tracker = sub.add_parser("tracker")
+    _common(p_tracker)
+    p_tracker.add_argument("--origins", default=None,
+                           help="comma-separated origin http addrs")
+
+    p_origin = sub.add_parser("origin")
+    _common(p_origin)
+    p_origin.add_argument("--store", default=None)
+    p_origin.add_argument("--tracker", default=None)
+    p_origin.add_argument("--p2p-port", type=int, default=None)
+    p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
+    p_origin.add_argument("--cluster", default=None,
+                          help="comma-separated origin http addrs (incl. self)")
+
+    p_agent = sub.add_parser("agent")
+    _common(p_agent)
+    p_agent.add_argument("--store", default=None)
+    p_agent.add_argument("--tracker", default=None)
+    p_agent.add_argument("--p2p-port", type=int, default=None)
+    p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
+
+    args = parser.parse_args(argv)
+    cfg = load_config(args.config) if args.config else {}
+
+    def pick(flag, key, default=None):
+        return flag if flag is not None else cfg.get(key, default)
+
+    host = pick(args.host, "host", "127.0.0.1")
+    port = pick(args.port, "port", 0)
+
+    if args.component == "tracker":
+        origins = pick(args.origins, "origins", "")
+        origin_addrs = [a for a in (origins or "").split(",") if a]
+        cluster = None
+        if origin_addrs:
+            cluster = ClusterClient(
+                Ring(HostList(static=origin_addrs),
+                     max_replica=cfg.get("max_replica", 3))
+            )
+        node = TrackerNode(
+            host=host, port=port, origin_cluster=cluster,
+            announce_interval_seconds=cfg.get("announce_interval_seconds", 3.0),
+            peer_ttl_seconds=cfg.get("peer_ttl_seconds", 30.0),
+        )
+        asyncio.run(_run_until_signal(node, {"component": "tracker"}))
+
+    elif args.component == "origin":
+        backends_cfg = cfg.get("backends")
+        backends = BackendManager(backends_cfg) if backends_cfg else None
+        cluster_addrs = [
+            a for a in (pick(args.cluster, "cluster", "") or "").split(",") if a
+        ]
+        ring = (
+            Ring(HostList(static=cluster_addrs),
+                 max_replica=cfg.get("max_replica", 3))
+            if cluster_addrs
+            else None
+        )
+        node = OriginNode(
+            store_root=pick(args.store, "store", "./origin-store"),
+            tracker_addr=pick(args.tracker, "tracker", ""),
+            host=host,
+            http_port=port,
+            p2p_port=pick(args.p2p_port, "p2p_port", 0),
+            hasher=pick(args.hasher, "hasher", "cpu"),
+            backends=backends,
+            ring=ring,
+        )
+        asyncio.run(_run_until_signal(node, {"component": "origin"}))
+
+    elif args.component == "agent":
+        node = AgentNode(
+            store_root=pick(args.store, "store", "./agent-store"),
+            tracker_addr=pick(args.tracker, "tracker", ""),
+            host=host,
+            http_port=port,
+            p2p_port=pick(args.p2p_port, "p2p_port", 0),
+            hasher=pick(args.hasher, "hasher", "cpu"),
+        )
+        asyncio.run(_run_until_signal(node, {"component": "agent"}))
+
+
+if __name__ == "__main__":
+    main()

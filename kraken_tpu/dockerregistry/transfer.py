@@ -1,0 +1,89 @@
+"""ImageTransferer: the seam between registry semantics and blob movement.
+
+Mirrors uber/kraken ``lib/dockerregistry/transfer`` (``ReadOnlyTransferer``
+for agents: blobs via scheduler.Download, tags via build-index;
+``ProxyTransferer`` for the proxy: blobs via origin cluster client, tag
+put + replicate) -- upstream path, unverified; SURVEY.md SS2.4.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Protocol
+
+from kraken_tpu.buildindex.server import TagClient
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import ClusterClient
+from kraken_tpu.p2p.scheduler import Scheduler
+from kraken_tpu.store import CAStore
+
+
+class ImageTransferer(Protocol):
+    async def download(self, namespace: str, d: Digest) -> bytes: ...
+    async def upload(self, namespace: str, d: Digest, data: bytes) -> None: ...
+    async def get_tag(self, tag: str) -> Optional[Digest]: ...
+    async def put_tag(self, tag: str, d: Digest) -> None: ...
+    async def list_repo_tags(self, repo: str) -> list[str]: ...
+    async def list_all_tags(self) -> list[str]: ...
+
+
+class ReadOnlyTransferer:
+    """Agent-side: pulls ride the swarm; pushes are rejected."""
+
+    def __init__(self, store: CAStore, scheduler: Scheduler, tags: TagClient):
+        self.store = store
+        self.scheduler = scheduler
+        self.tags = tags
+
+    async def download(self, namespace: str, d: Digest) -> bytes:
+        if not self.store.in_cache(d):
+            await self.scheduler.download(namespace, d)
+        return await asyncio.to_thread(self.store.read_cache_file, d)
+
+    async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
+        raise PermissionError("agent registry is read-only; push via the proxy")
+
+    async def get_tag(self, tag: str) -> Optional[Digest]:
+        try:
+            return await self.tags.get(tag)
+        except Exception:
+            return None
+
+    async def put_tag(self, tag: str, d: Digest) -> None:
+        raise PermissionError("agent registry is read-only; push via the proxy")
+
+    async def list_repo_tags(self, repo: str) -> list[str]:
+        return await self.tags.list_repo(repo)
+
+    async def list_all_tags(self) -> list[str]:
+        return await self.tags.list_all()
+
+
+class ProxyTransferer:
+    """Proxy-side: pushes fan blobs to the origin replica set and tags to
+    the build-index (with cross-cluster replication)."""
+
+    def __init__(self, origins: ClusterClient, tags: TagClient):
+        self.origins = origins
+        self.tags = tags
+
+    async def download(self, namespace: str, d: Digest) -> bytes:
+        return await self.origins.download(namespace, d)
+
+    async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
+        await self.origins.upload(namespace, d, data)
+
+    async def get_tag(self, tag: str) -> Optional[Digest]:
+        try:
+            return await self.tags.get(tag)
+        except Exception:
+            return None
+
+    async def put_tag(self, tag: str, d: Digest) -> None:
+        await self.tags.put(tag, d, replicate=True)
+
+    async def list_repo_tags(self, repo: str) -> list[str]:
+        return await self.tags.list_repo(repo)
+
+    async def list_all_tags(self) -> list[str]:
+        return await self.tags.list_all()

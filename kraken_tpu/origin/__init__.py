@@ -1,0 +1,5 @@
+"""Origin: dedicated seeders + content-addressable blob storage.
+
+Mirrors uber/kraken ``origin/`` (blobserver HTTP API, metainfo generation,
+blobrefresh, writeback) -- upstream paths, unverified; SURVEY.md SS2.3/SS2.4.
+"""

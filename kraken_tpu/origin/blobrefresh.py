@@ -1,0 +1,53 @@
+"""Blob refresh: fill origin cache misses from the remote backend.
+
+Mirrors uber/kraken ``lib/blobrefresh`` (``Refresher``: on miss, pull
+backend -> CAStore, then regenerate metainfo) -- upstream path, unverified;
+SURVEY.md SS2.3/SS3.5. Requests coalesce so a miss storm pulls once.
+"""
+
+from __future__ import annotations
+
+from kraken_tpu.backend import BlobNotFoundError, Manager
+from kraken_tpu.backend.namepath import get_pather
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.metainfogen import Generator
+from kraken_tpu.store import CAStore
+from kraken_tpu.utils.dedup import RequestCoalescer
+
+
+class Refresher:
+    def __init__(
+        self,
+        store: CAStore,
+        backends: Manager,
+        generator: Generator,
+        pather: str = "sharded_docker_blob",
+    ):
+        self.store = store
+        self.backends = backends
+        self.generator = generator
+        self._pather = get_pather(pather)
+        self._coalescer: RequestCoalescer = RequestCoalescer()
+
+    async def refresh(self, namespace: str, d: Digest) -> None:
+        """Ensure blob ``d`` is cached locally (pulling from the backend if
+        needed) with metainfo generated. Raises
+        :class:`~kraken_tpu.backend.BlobNotFoundError` when the backend
+        doesn't have it either."""
+        if self.store.in_cache(d):
+            await self.generator.generate(d)
+            return
+        await self._coalescer.get(d.hex, lambda: self._pull(namespace, d))
+
+    async def _pull(self, namespace: str, d: Digest) -> None:
+        client = self.backends.try_get_client(namespace)
+        if client is None:
+            raise BlobNotFoundError(f"no backend for namespace {namespace!r}")
+        data = await client.download(namespace, self._pather("", d.hex))
+        actual = Digest.from_bytes(data)
+        if actual != d:
+            raise BlobNotFoundError(
+                f"backend returned corrupt blob: expected {d}, got {actual}"
+            )
+        self.store.create_cache_file(d, iter([data]), verify=False)
+        await self.generator.generate(d)

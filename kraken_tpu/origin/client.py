@@ -1,0 +1,157 @@
+"""Origin blob clients: single-node client + hashring-aware cluster client.
+
+Mirrors uber/kraken ``origin/blobclient`` (``Client``, ``ClusterClient``
+resolving ``hashring.Locations(d)`` and retrying across replicas; used by
+proxy, tracker, build-index, and other origins) -- upstream path,
+unverified; SURVEY.md SS2.4.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.core.peer import BlobInfo
+from kraken_tpu.placement.hashring import Ring
+from urllib.parse import quote
+
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+
+
+class BlobClient:
+    """HTTP client for one origin."""
+
+    def __init__(self, addr: str, http: HTTPClient | None = None):
+        self.addr = addr
+        self._http = http or HTTPClient()
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.addr}{path}"
+
+    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+        try:
+            body = await self._http.get(
+                self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/stat"),
+                retry_5xx=False,
+            )
+        except HTTPError as e:
+            if e.status == 404:
+                return None
+            raise
+        import json
+
+        return BlobInfo.from_dict(json.loads(body))
+
+    async def download(self, namespace: str, d: Digest) -> bytes:
+        return await self._http.get(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}")
+        )
+
+    async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
+        raw = await self._http.get(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo")
+        )
+        return MetaInfo.deserialize(raw)
+
+    async def upload(self, namespace: str, d: Digest, data: bytes,
+                     chunk_size: int = 16 * 1024 * 1024) -> None:
+        """Chunked upload: start -> PATCH chunks -> commit."""
+        body = await self._http.post(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads")
+        )
+        uid = body.decode()
+        for off in range(0, len(data), chunk_size) or [0]:
+            await self._http.patch(
+                self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads/{uid}"),
+                data=data[off : off + chunk_size],
+                headers={"X-Upload-Offset": str(off)},
+            )
+        await self._http.put(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads/{uid}/commit"),
+            ok_statuses=(200, 201, 204, 409),  # 409 = already cached: success
+        )
+
+    async def delete(self, namespace: str, d: Digest) -> None:
+        await self._http.delete(self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"))
+
+    async def health(self) -> bool:
+        try:
+            await self._http.get(self._url("/health"), retry_5xx=False)
+            return True
+        except Exception:
+            return False
+
+    async def close(self) -> None:
+        await self._http.close()
+
+
+class ClusterClient:
+    """Routes blob ops to the replica set owning each digest.
+
+    Reads try replicas in order and fall through on failure; writes go to
+    every replica (as the reference's proxy upload does) so any one can
+    serve and replicate onward.
+    """
+
+    def __init__(
+        self,
+        ring: Ring,
+        client_factory: Callable[[str], BlobClient] | None = None,
+    ):
+        self.ring = ring
+        self._factory = client_factory or BlobClient
+        self._clients: dict[str, BlobClient] = {}
+
+    def _client(self, addr: str) -> BlobClient:
+        if addr not in self._clients:
+            self._clients[addr] = self._factory(addr)
+        return self._clients[addr]
+
+    def clients_for(self, d: Digest) -> list[BlobClient]:
+        return [self._client(a) for a in self.ring.locations(d)]
+
+    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+        last: Exception | None = None
+        for c in self.clients_for(d):
+            try:
+                return await c.stat(namespace, d)
+            except Exception as e:
+                last = e
+        if last:
+            raise last
+        return None
+
+    async def download(self, namespace: str, d: Digest) -> bytes:
+        last: Exception | None = None
+        for c in self.clients_for(d):
+            try:
+                return await c.download(namespace, d)
+            except Exception as e:
+                last = e
+        raise last or KeyError(str(d))
+
+    async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
+        last: Exception | None = None
+        for c in self.clients_for(d):
+            try:
+                return await c.get_metainfo(namespace, d)
+            except Exception as e:
+                last = e
+        raise last or KeyError(str(d))
+
+    async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
+        """Upload to every replica; success if at least one accepted (the
+        origins replicate among themselves on the repair path)."""
+        errs = []
+        for c in self.clients_for(d):
+            try:
+                await c.upload(namespace, d, data)
+            except Exception as e:
+                errs.append(e)
+        if len(errs) == len(self.clients_for(d)):
+            raise errs[0]
+
+    async def close(self) -> None:
+        for c in self._clients.values():
+            await c.close()

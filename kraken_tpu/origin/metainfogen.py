@@ -1,0 +1,95 @@
+"""Metainfo generation: the origin-side piece-hash hot loop, on TPU.
+
+Mirrors uber/kraken ``lib/metainfogen`` (``Generator.Generate(digest)``:
+choose piece length from blob size via a config table, checksum every
+piece, write MetaInfo to the store) -- upstream path, unverified; SURVEY.md
+SS2.3. **Primary TPU offload target** (BASELINE.json): the per-piece hashing
+goes through the batched ``PieceHasher`` -- one TPU dispatch per blob
+instead of a sequential CPU loop.
+
+The generated MetaInfo persists as a metadata sidecar of the blob, so
+restarts never re-hash.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import PieceHasher, get_hasher
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.store import CAStore, Metadata, register_metadata
+
+
+@register_metadata
+class TorrentMetaMetadata(Metadata):
+    """The blob's serialized MetaInfo, stored beside it."""
+
+    name = "torrentmeta"
+
+    def __init__(self, metainfo: MetaInfo):
+        self.metainfo = metainfo
+
+    def serialize(self) -> bytes:
+        return self.metainfo.serialize()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "TorrentMetaMetadata":
+        return cls(MetaInfo.deserialize(raw))
+
+
+@dataclasses.dataclass(frozen=True)
+class PieceLengthConfig:
+    """Blob size -> piece length table (powers of two), as the reference
+    configures. Defaults: small blobs get 4 MiB pieces; larger blobs scale
+    up so the piece count stays bounded."""
+
+    # (min blob size, piece length), evaluated top-down; last match wins.
+    table: tuple[tuple[int, int], ...] = (
+        (0, 4 * 1024 * 1024),
+        (2 * 1024**3, 8 * 1024 * 1024),
+        (8 * 1024**3, 16 * 1024 * 1024),
+    )
+
+    def piece_length(self, blob_size: int) -> int:
+        chosen = self.table[0][1]
+        for min_size, piece_len in self.table:
+            if blob_size >= min_size:
+                chosen = piece_len
+        return chosen
+
+
+class Generator:
+    """Generates (and caches) MetaInfo for blobs in a CAStore."""
+
+    def __init__(
+        self,
+        store: CAStore,
+        hasher: PieceHasher | None = None,
+        piece_lengths: PieceLengthConfig | None = None,
+    ):
+        self.store = store
+        self.hasher = hasher or get_hasher("cpu")
+        self.piece_lengths = piece_lengths or PieceLengthConfig()
+
+    def get_cached(self, d: Digest) -> MetaInfo | None:
+        md = self.store.get_metadata(d, TorrentMetaMetadata)
+        return md.metainfo if md else None
+
+    def generate_sync(self, d: Digest) -> MetaInfo:
+        """Hash every piece of blob ``d`` (one batched dispatch) and persist
+        the MetaInfo. Idempotent. Raises KeyError if the blob is absent."""
+        cached = self.get_cached(d)
+        if cached is not None:
+            return cached
+        data = self.store.read_cache_file(d)  # KeyError if absent
+        piece_length = self.piece_lengths.piece_length(len(data))
+        hashes = self.hasher.hash_pieces(data, piece_length)
+        metainfo = MetaInfo(d, len(data), piece_length, hashes.tobytes())
+        self.store.set_metadata(d, TorrentMetaMetadata(metainfo))
+        return metainfo
+
+    async def generate(self, d: Digest) -> MetaInfo:
+        """Off-loop :meth:`generate_sync` (reads + hashes a whole blob)."""
+        return await asyncio.to_thread(self.generate_sync, d)

@@ -1,0 +1,201 @@
+"""Origin blobserver: the origin's HTTP API + component assembly.
+
+Mirrors uber/kraken ``origin/blobserver`` (chunked upload start/patch/
+commit, GET blob, GET metainfo, stat, forced eviction, replication to ring
+peers) -- upstream path, unverified; SURVEY.md SS2.4/SS3.2/SS3.5.
+
+Endpoints:
+
+    POST   /namespace/{ns}/blobs/{d}/uploads                -> upload id
+    PATCH  /namespace/{ns}/blobs/{d}/uploads/{uid}          (X-Upload-Offset)
+    PUT    /namespace/{ns}/blobs/{d}/uploads/{uid}/commit
+    GET    /namespace/{ns}/blobs/{d}                        -> blob bytes
+    GET    /namespace/{ns}/blobs/{d}/stat                   -> {"size": n}
+    GET    /namespace/{ns}/blobs/{d}/metainfo               -> metainfo doc
+    DELETE /namespace/{ns}/blobs/{d}
+    GET    /health
+
+On commit: metainfo generates (TPU batch hash), a writeback task enqueues,
+and the blob replicates to its other ring owners (durable retry task).
+The origin seeds every cached blob over the P2P plane via its scheduler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from kraken_tpu.core.digest import Digest, DigestError
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.backend import BlobNotFoundError
+from kraken_tpu.origin.blobrefresh import Refresher
+from kraken_tpu.origin.client import BlobClient
+from kraken_tpu.origin.metainfogen import Generator
+from kraken_tpu.origin.writeback import WritebackExecutor
+from kraken_tpu.persistedretry import Manager as RetryManager, Task
+from kraken_tpu.placement.hashring import Ring
+from kraken_tpu.store import CAStore, FileExistsInCacheError
+from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
+
+REPLICATE_KIND = "replicate"
+
+
+class OriginServer:
+    """HTTP facade over the origin's storage plane."""
+
+    def __init__(
+        self,
+        store: CAStore,
+        generator: Generator,
+        refresher: Refresher | None = None,
+        writeback: WritebackExecutor | None = None,
+        retry: RetryManager | None = None,
+        ring: Ring | None = None,
+        self_addr: str = "",
+        scheduler=None,  # p2p Scheduler seeding our blobs (optional)
+    ):
+        self.store = store
+        self.generator = generator
+        self.refresher = refresher
+        self.writeback = writeback
+        self.retry = retry
+        self.ring = ring
+        self.self_addr = self_addr
+        self.scheduler = scheduler
+        if retry is not None:
+            retry.register(REPLICATE_KIND, self._execute_replication)
+
+    # -- app ---------------------------------------------------------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        r = app.router
+        r.add_post("/namespace/{ns}/blobs/{d}/uploads", self._start_upload)
+        r.add_patch("/namespace/{ns}/blobs/{d}/uploads/{uid}", self._patch_upload)
+        r.add_put("/namespace/{ns}/blobs/{d}/uploads/{uid}/commit", self._commit)
+        r.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
+        r.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
+        r.add_get("/namespace/{ns}/blobs/{d}", self._download)
+        r.add_delete("/namespace/{ns}/blobs/{d}", self._delete)
+        r.add_get("/health", self._health)
+        return app
+
+    def _digest(self, req: web.Request) -> Digest:
+        try:
+            return Digest.from_hex(req.match_info["d"])
+        except DigestError:
+            raise web.HTTPBadRequest(text="malformed digest")
+
+    # -- upload flow -------------------------------------------------------
+
+    async def _start_upload(self, req: web.Request) -> web.Response:
+        uid = self.store.create_upload()
+        return web.Response(text=uid)
+
+    async def _patch_upload(self, req: web.Request) -> web.Response:
+        uid = req.match_info["uid"]
+        offset = int(req.headers.get("X-Upload-Offset", "0"))
+        data = await req.read()
+        try:
+            await asyncio.to_thread(self.store.write_upload_chunk, uid, offset, data)
+        except UploadNotFoundError:
+            raise web.HTTPNotFound(text="unknown upload")
+        return web.Response(status=204)
+
+    async def _commit(self, req: web.Request) -> web.Response:
+        uid = req.match_info["uid"]
+        ns = req.match_info["ns"]
+        d = self._digest(req)
+        try:
+            await asyncio.to_thread(self.store.commit_upload, uid, d)
+        except UploadNotFoundError:
+            raise web.HTTPNotFound(text="unknown upload")
+        except DigestMismatchError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        except FileExistsInCacheError:
+            return web.Response(status=409, text="already cached")
+        await self._post_commit(ns, d)
+        return web.Response(status=201)
+
+    async def _post_commit(self, ns: str, d: Digest) -> None:
+        metainfo = await self.generator.generate(d)
+        if self.scheduler is not None:
+            self.scheduler.seed(metainfo, ns)
+        if self.writeback is not None:
+            self.writeback.enqueue(ns, d)
+        self._enqueue_replication(ns, d)
+
+    # -- replication to ring peers -----------------------------------------
+
+    def _enqueue_replication(self, ns: str, d: Digest) -> None:
+        if self.ring is None or self.retry is None or not self.self_addr:
+            return
+        for addr in self.ring.locations(d):
+            if addr != self.self_addr:
+                self.retry.add(
+                    Task(
+                        kind=REPLICATE_KIND,
+                        key=f"{addr}:{ns}:{d.hex}",
+                        payload={"addr": addr, "namespace": ns, "digest": d.hex},
+                    )
+                )
+
+    async def _execute_replication(self, task: Task) -> None:
+        d = Digest.from_hex(task.payload["digest"])
+        ns = task.payload["namespace"]
+        addr = task.payload["addr"]
+        peer = BlobClient(addr)
+        try:
+            if await peer.stat(ns, d) is not None:
+                return  # replica already has it
+            data = await asyncio.to_thread(self.store.read_cache_file, d)
+            await peer.upload(ns, d, data)
+        finally:
+            await peer.close()
+
+    # -- reads -------------------------------------------------------------
+
+    async def _ensure_local(self, ns: str, d: Digest) -> None:
+        if self.store.in_cache(d):
+            return
+        if self.refresher is None:
+            raise web.HTTPNotFound(text="blob not found")
+        try:
+            await self.refresher.refresh(ns, d)
+        except BlobNotFoundError:
+            raise web.HTTPNotFound(text="blob not found (backend miss)")
+
+    async def _stat(self, req: web.Request) -> web.Response:
+        d = self._digest(req)
+        try:
+            size = self.store.cache_size(d)
+        except KeyError:
+            raise web.HTTPNotFound(text="blob not found")
+        return web.json_response({"size": size})
+
+    async def _download(self, req: web.Request) -> web.Response:
+        ns = req.match_info["ns"]
+        d = self._digest(req)
+        await self._ensure_local(ns, d)
+        data = await asyncio.to_thread(self.store.read_cache_file, d)
+        return web.Response(body=data)
+
+    async def _metainfo(self, req: web.Request) -> web.Response:
+        ns = req.match_info["ns"]
+        d = self._digest(req)
+        await self._ensure_local(ns, d)
+        metainfo = await self.generator.generate(d)
+        if self.scheduler is not None:
+            # Metainfo fetch precedes a swarm download: make sure we seed.
+            self.scheduler.seed(metainfo, ns)
+        return web.Response(body=metainfo.serialize())
+
+    async def _delete(self, req: web.Request) -> web.Response:
+        d = self._digest(req)
+        await asyncio.to_thread(self.store.delete_cache_file, d)
+        return web.Response(status=204)
+
+    async def _health(self, req: web.Request) -> web.Response:
+        return web.Response(text="ok")

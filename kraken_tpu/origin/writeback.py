@@ -1,0 +1,56 @@
+"""Async writeback: committed blobs flow origin -> backend durably.
+
+Mirrors uber/kraken ``lib/persistedretry/writeback`` (a persistedretry task
+type uploading committed blobs to the remote backend; the blob is marked
+persist-exempt from eviction until it lands) -- upstream path, unverified;
+SURVEY.md SS2.3/SS3.2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from kraken_tpu.backend import Manager as BackendManager
+from kraken_tpu.backend.namepath import get_pather
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.persistedretry import Manager as RetryManager, Task
+from kraken_tpu.store import CAStore
+from kraken_tpu.store.metadata import PersistMetadata
+
+KIND = "writeback"
+
+
+class WritebackExecutor:
+    """Registers the ``writeback`` task kind on a retry manager."""
+
+    def __init__(
+        self,
+        store: CAStore,
+        backends: BackendManager,
+        retry: RetryManager,
+        pather: str = "sharded_docker_blob",
+    ):
+        self.store = store
+        self.backends = backends
+        self.retry = retry
+        self._pather = get_pather(pather)
+        retry.register(KIND, self._execute)
+
+    def enqueue(self, namespace: str, d: Digest) -> None:
+        """Queue a blob for backend upload; pin it against eviction."""
+        if self.backends.try_get_client(namespace) is None:
+            return  # namespace has no durable backend configured
+        self.store.set_metadata(d, PersistMetadata(True))
+        self.retry.add(
+            Task(kind=KIND, key=f"{namespace}:{d.hex}",
+                 payload={"namespace": namespace, "digest": d.hex})
+        )
+
+    async def _execute(self, task: Task) -> None:
+        namespace = task.payload["namespace"]
+        d = Digest.from_hex(task.payload["digest"])
+        client = self.backends.get_client(namespace)
+        data = await asyncio.to_thread(self.store.read_cache_file, d)
+        await client.upload(namespace, self._pather("", d.hex), data)
+        # Landed durably: unpin.
+        self.store.set_metadata(d, PersistMetadata(False))

@@ -1,0 +1,154 @@
+"""sqlite-backed durable retry queue."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import sqlite3
+import time
+from typing import Awaitable, Callable, Optional
+
+from kraken_tpu.utils.backoff import Backoff
+
+
+@dataclasses.dataclass
+class Task:
+    """One durable unit of work. ``kind`` routes to an executor; ``payload``
+    is executor-defined JSON. ``key`` dedups (same-key add is a no-op while
+    the task is pending)."""
+
+    kind: str
+    key: str
+    payload: dict
+    attempts: int = 0
+    not_before: float = 0.0
+    id: Optional[int] = None
+
+
+class TaskStore:
+    """Persistence layer. One table, tiny schema, crash-safe."""
+
+    def __init__(self, path: str):
+        self._db = sqlite3.connect(path)
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS tasks (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                kind TEXT NOT NULL,
+                key TEXT NOT NULL,
+                payload TEXT NOT NULL,
+                attempts INTEGER NOT NULL DEFAULT 0,
+                not_before REAL NOT NULL DEFAULT 0,
+                UNIQUE(kind, key)
+            )"""
+        )
+        self._db.commit()
+
+    def add(self, task: Task) -> bool:
+        """Insert; returns False if a pending task with the same (kind, key)
+        already exists."""
+        try:
+            cur = self._db.execute(
+                "INSERT INTO tasks (kind, key, payload, attempts, not_before)"
+                " VALUES (?, ?, ?, ?, ?)",
+                (task.kind, task.key, json.dumps(task.payload), task.attempts,
+                 task.not_before),
+            )
+            self._db.commit()
+            task.id = cur.lastrowid
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+    def ready(self, now: float, limit: int = 100) -> list[Task]:
+        rows = self._db.execute(
+            "SELECT id, kind, key, payload, attempts, not_before FROM tasks"
+            " WHERE not_before <= ? ORDER BY id LIMIT ?",
+            (now, limit),
+        ).fetchall()
+        return [
+            Task(kind=k, key=key, payload=json.loads(p), attempts=a,
+                 not_before=nb, id=i)
+            for i, k, key, p, a, nb in rows
+        ]
+
+    def all_pending(self) -> list[Task]:
+        return self.ready(now=float("inf"), limit=1_000_000)
+
+    def done(self, task: Task) -> None:
+        self._db.execute("DELETE FROM tasks WHERE id = ?", (task.id,))
+        self._db.commit()
+
+    def reschedule(self, task: Task, not_before: float) -> None:
+        self._db.execute(
+            "UPDATE tasks SET attempts = ?, not_before = ? WHERE id = ?",
+            (task.attempts, not_before, task.id),
+        )
+        self._db.commit()
+
+    def close(self) -> None:
+        self._db.close()
+
+
+class Manager:
+    """Polls the store and runs tasks through registered executors.
+
+    ``register(kind, fn)`` with ``fn(task) -> Awaitable[None]``; a raise
+    reschedules with exponential backoff. Call ``run_once()`` from tests or
+    ``start()`` for the background loop.
+    """
+
+    def __init__(
+        self,
+        store: TaskStore,
+        poll_interval_seconds: float = 1.0,
+        backoff: Backoff | None = None,
+        max_attempts: int = 0,  # 0 = retry forever (reference semantics)
+    ):
+        self.store = store
+        self.poll_interval = poll_interval_seconds
+        self.backoff = backoff or Backoff(base_seconds=1.0, max_seconds=300.0)
+        self.max_attempts = max_attempts
+        self._executors: dict[str, Callable[[Task], Awaitable[None]]] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def register(self, kind: str, fn: Callable[[Task], Awaitable[None]]) -> None:
+        self._executors[kind] = fn
+
+    def add(self, task: Task) -> bool:
+        return self.store.add(task)
+
+    async def run_once(self, now: float | None = None) -> int:
+        """One poll cycle; returns number of tasks that succeeded."""
+        now = time.time() if now is None else now
+        ok = 0
+        for task in self.store.ready(now):
+            fn = self._executors.get(task.kind)
+            if fn is None:
+                continue  # executor not registered (yet); leave queued
+            try:
+                await fn(task)
+            except Exception:
+                task.attempts += 1
+                if self.max_attempts and task.attempts >= self.max_attempts:
+                    self.store.done(task)
+                else:
+                    self.store.reschedule(
+                        task, now + self.backoff.delay(task.attempts - 1)
+                    )
+            else:
+                self.store.done(task)
+                ok += 1
+        return ok
+
+    def start(self) -> None:
+        async def loop():
+            while True:
+                await self.run_once()
+                await asyncio.sleep(self.poll_interval)
+
+        self._task = asyncio.create_task(loop())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()

@@ -1,0 +1,6 @@
+"""Tracker: peer membership + metainfo proxy.
+
+Mirrors uber/kraken ``tracker/`` (trackerserver announce endpoint,
+Redis-backed peerstore with TTL, peerhandoutpolicy, metainfo proxy caching
+origin responses) -- upstream paths, unverified; SURVEY.md SS2.4/SS3.4.
+"""

@@ -1,0 +1,63 @@
+"""Agent-side tracker clients: announce + metainfo fetch.
+
+Mirrors uber/kraken ``tracker/announceclient`` + ``tracker/metainfoclient``
+-- upstream paths, unverified; SURVEY.md SS2.4. These implement the
+scheduler's ``AnnounceClient`` / ``MetaInfoClient`` protocols.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import InfoHash, MetaInfo
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from urllib.parse import quote
+
+from kraken_tpu.utils.httputil import HTTPClient
+
+
+class TrackerClient:
+    """Both announce and metainfo against one tracker address."""
+
+    def __init__(
+        self,
+        addr: str,
+        peer_id: PeerID,
+        ip: str,
+        port: int,
+        is_origin: bool = False,
+        http: HTTPClient | None = None,
+    ):
+        self.addr = addr
+        self.peer_id = peer_id
+        self.ip = ip
+        self.port = port
+        self.is_origin = is_origin
+        self._http = http or HTTPClient()
+
+    async def announce(
+        self, d: Digest, h: InfoHash, namespace: str, complete: bool
+    ) -> tuple[list[PeerInfo], float]:
+        me = PeerInfo(
+            peer_id=self.peer_id,
+            ip=self.ip,
+            port=self.port,
+            origin=self.is_origin,
+            complete=complete,
+        )
+        body = await self._http.post(
+            f"http://{self.addr}/announce",
+            data=json.dumps({"info_hash": h.hex, "peer": me.to_dict()}),
+        )
+        doc = json.loads(body)
+        return [PeerInfo.from_dict(p) for p in doc["peers"]], float(doc["interval"])
+
+    async def get(self, namespace: str, d: Digest) -> MetaInfo:
+        raw = await self._http.get(
+            f"http://{self.addr}/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
+        )
+        return MetaInfo.deserialize(raw)
+
+    async def close(self) -> None:
+        await self._http.close()

@@ -1,0 +1,49 @@
+"""Peer membership per info-hash, with TTL expiry.
+
+Mirrors uber/kraken ``tracker/peerstore`` (Redis SETEX-style TTL records;
+dead agents vanish from handouts when their announces stop) -- upstream
+path, unverified; SURVEY.md SS2.4/SS5. The production reference needs an
+external Redis; here the default is an in-process TTL dict behind the same
+interface (this environment has no Redis server; the seam stays so a
+redis-protocol store can drop in).
+"""
+
+from __future__ import annotations
+
+import time
+
+from kraken_tpu.core.peer import PeerInfo
+
+
+class PeerStore:
+    """Interface: update a peer's announce record, list live peers."""
+
+    def update(self, info_hash: str, peer: PeerInfo) -> None:
+        raise NotImplementedError
+
+    def get_peers(self, info_hash: str, limit: int = 50) -> list[PeerInfo]:
+        raise NotImplementedError
+
+
+class InMemoryPeerStore(PeerStore):
+    def __init__(self, ttl_seconds: float = 30.0):
+        self.ttl = ttl_seconds
+        # info_hash -> peer_id hex -> (expiry, PeerInfo)
+        self._swarms: dict[str, dict[str, tuple[float, PeerInfo]]] = {}
+
+    def update(self, info_hash: str, peer: PeerInfo, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        swarm = self._swarms.setdefault(info_hash, {})
+        swarm[peer.peer_id.hex] = (now + self.ttl, peer)
+
+    def get_peers(
+        self, info_hash: str, limit: int = 50, now: float | None = None
+    ) -> list[PeerInfo]:
+        now = time.monotonic() if now is None else now
+        swarm = self._swarms.get(info_hash)
+        if not swarm:
+            return []
+        for pid, (expiry, _p) in list(swarm.items()):
+            if expiry <= now:
+                del swarm[pid]
+        return [p for _e, p in swarm.values()][:limit]

@@ -1,0 +1,93 @@
+"""Tracker HTTP API: announce + metainfo proxy.
+
+Mirrors uber/kraken ``tracker/trackerserver`` (announce endpoint: peer <->
+peer-list exchange with an announce interval; metainfo endpoint proxying
+the origin cluster with a TTL cache) -- upstream path, unverified;
+SURVEY.md SS2.4/SS3.4.
+
+Endpoints:
+
+    POST /announce                 body: announce record   -> {peers, interval}
+    GET  /namespace/{ns}/blobs/{d}/metainfo               -> metainfo doc
+    GET  /health
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from kraken_tpu.core.digest import Digest, DigestError
+from kraken_tpu.core.peer import PeerInfo
+from kraken_tpu.tracker.peerhandout import default_priority
+from kraken_tpu.tracker.peerstore import InMemoryPeerStore, PeerStore
+from kraken_tpu.utils.dedup import TTLCache
+
+
+class TrackerServer:
+    def __init__(
+        self,
+        peer_store: PeerStore | None = None,
+        origin_cluster=None,  # origin.client.ClusterClient (optional)
+        announce_interval_seconds: float = 3.0,
+        handout_policy=default_priority,
+        handout_limit: int = 50,
+        metainfo_cache_ttl: float = 60.0,
+    ):
+        self.peers = peer_store or InMemoryPeerStore()
+        self.origin_cluster = origin_cluster
+        self.interval = announce_interval_seconds
+        self.policy = handout_policy
+        self.handout_limit = handout_limit
+        self._metainfo_cache: TTLCache = TTLCache(metainfo_cache_ttl)
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/announce", self._announce)
+        app.router.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
+        app.router.add_get("/health", self._health)
+        return app
+
+    async def _announce(self, req: web.Request) -> web.Response:
+        try:
+            doc = await req.json()
+            info_hash = doc["info_hash"]
+            peer = PeerInfo.from_dict(doc["peer"])
+        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            raise web.HTTPBadRequest(text=f"malformed announce: {e}")
+        # Hand out peers BEFORE recording the announcer so a first announce
+        # never returns the announcer itself.
+        others = [
+            p
+            for p in self.peers.get_peers(info_hash, limit=self.handout_limit + 1)
+            if p.peer_id != peer.peer_id
+        ][: self.handout_limit]
+        self.peers.update(info_hash, peer)
+        return web.json_response(
+            {
+                "peers": [p.to_dict() for p in self.policy(others)],
+                "interval": self.interval,
+            }
+        )
+
+    async def _metainfo(self, req: web.Request) -> web.Response:
+        ns = req.match_info["ns"]
+        try:
+            d = Digest.from_hex(req.match_info["d"])
+        except DigestError:
+            raise web.HTTPBadRequest(text="malformed digest")
+        cached = self._metainfo_cache.get(d.hex)
+        if cached is None:
+            if self.origin_cluster is None:
+                raise web.HTTPNotFound(text="no origin cluster configured")
+            try:
+                metainfo = await self.origin_cluster.get_metainfo(ns, d)
+            except Exception:
+                raise web.HTTPNotFound(text="metainfo unavailable")
+            cached = metainfo.serialize()
+            self._metainfo_cache.put(d.hex, cached)
+        return web.Response(body=cached)
+
+    async def _health(self, req: web.Request) -> web.Response:
+        return web.Response(text="ok")

@@ -1,0 +1,234 @@
+"""Herd integration tests: the minimum end-to-end slice and beyond.
+
+SURVEY.md SS7 "minimum end-to-end slice": origin + tracker + agent,
+push a blob into origin's upload API -> metainfo-gen -> agent GET
+/namespace/.../blobs/<digest> -> announce -> P2P download from
+origin-as-seeder -> piece verify -> byte-identical blob out.
+
+In-process here (tier 4's process-based herd drives the same assembly via
+the CLI). Uses the real HTTP APIs end to end, including the origin upload
+protocol and the tracker metainfo proxy.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.backend import Manager as BackendManager
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.utils.httputil import HTTPClient
+
+
+async def build_herd(tmp_path, n_agents=1, backends=None, n_origins=1):
+    tracker = TrackerNode(announce_interval_seconds=0.1, peer_ttl_seconds=5.0)
+    await tracker.start()
+    origins = []
+    for i in range(n_origins):
+        o = OriginNode(
+            store_root=str(tmp_path / f"origin{i}"),
+            tracker_addr=tracker.addr,
+            backends=backends,
+        )
+        await o.start()
+        origins.append(o)
+    ring = Ring(HostList(static=[o.addr for o in origins]), max_replica=2)
+    cluster = ClusterClient(ring)
+    tracker.server.origin_cluster = cluster
+    for o in origins:
+        o.ring = ring
+        if o.server:
+            o.server.ring = ring
+    agents = []
+    for i in range(n_agents):
+        a = AgentNode(
+            store_root=str(tmp_path / f"agent{i}"), tracker_addr=tracker.addr
+        )
+        await a.start()
+        agents.append(a)
+    return tracker, origins, agents, cluster
+
+
+async def teardown(tracker, origins, agents, cluster):
+    for a in agents:
+        await a.stop()
+    for o in origins:
+        await o.stop()
+    await cluster.close()
+    await tracker.stop()
+
+
+def test_e2e_slice_upload_then_agent_pull(tmp_path):
+    """The canonical slice: upload via origin HTTP -> pull via agent HTTP."""
+
+    async def main():
+        tracker, origins, agents, cluster = await build_herd(tmp_path)
+        http = HTTPClient()
+        try:
+            blob = os.urandom(500_000)
+            d = Digest.from_bytes(blob)
+
+            # Push through the origin's chunked upload API.
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("library/test", d, blob, chunk_size=100_000)
+
+            # Origin generated metainfo at commit.
+            mi = await oc.get_metainfo("library/test", d)
+            assert mi.digest == d and mi.length == len(blob)
+
+            # Pull via the agent API: triggers tracker metainfo fetch +
+            # announce + P2P download from the seeding origin.
+            got = await http.get(
+                f"http://{agents[0].addr}/namespace/library%2Ftest/blobs/{d.hex}"
+            )
+            assert got == blob
+
+            # Agent now reports the blob via stat.
+            import json
+
+            stat = json.loads(
+                await http.get(
+                    f"http://{agents[0].addr}/namespace/library%2Ftest/blobs/{d.hex}/stat"
+                )
+            )
+            assert stat["size"] == len(blob)
+            await oc.close()
+        finally:
+            await http.close()
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
+
+
+def test_multi_agent_pull_and_peer_exchange(tmp_path):
+    async def main():
+        tracker, origins, agents, cluster = await build_herd(tmp_path, n_agents=3)
+        http = HTTPClient()
+        try:
+            blob = os.urandom(400_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            results = await asyncio.gather(
+                *(
+                    http.get(f"http://{a.addr}/namespace/ns/blobs/{d.hex}")
+                    for a in agents
+                )
+            )
+            assert all(r == blob for r in results)
+            await oc.close()
+        finally:
+            await http.close()
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
+
+
+def test_backend_miss_refresh_path(tmp_path):
+    """Agent pulls a blob the origin does NOT have cached -- origin fills
+    from the remote backend on the tracker's metainfo request
+    (SURVEY.md SS3.5)."""
+
+    async def main():
+        from kraken_tpu.backend.base import make_backend
+        from kraken_tpu.backend.namepath import get_pather
+
+        backends = BackendManager(
+            [{"namespace": ".*", "backend": "file",
+              "config": {"root": str(tmp_path / "remote")}}]
+        )
+        blob = os.urandom(300_000)
+        d = Digest.from_bytes(blob)
+        # Blob lives only in the remote backend, sharded path.
+        be = make_backend("file", {"root": str(tmp_path / "remote")})
+        await be.upload("ns", get_pather("sharded_docker_blob")("", d.hex), blob)
+
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, backends=backends
+        )
+        http = HTTPClient()
+        try:
+            got = await http.get(
+                f"http://{agents[0].addr}/namespace/ns/blobs/{d.hex}"
+            )
+            assert got == blob
+            # Origin cached it on the way through.
+            assert origins[0].store.in_cache(d)
+        finally:
+            await http.close()
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
+
+
+def test_writeback_to_backend(tmp_path):
+    """Committed blobs flow asynchronously origin -> backend."""
+
+    async def main():
+        backends = BackendManager(
+            [{"namespace": ".*", "backend": "file",
+              "config": {"root": str(tmp_path / "remote")}}]
+        )
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, backends=backends, n_agents=0
+        )
+        try:
+            blob = os.urandom(100_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            # Drive the retry queue until the writeback lands.
+            for _ in range(50):
+                await origins[0].retry.run_once()
+                from kraken_tpu.backend.namepath import get_pather
+
+                from kraken_tpu.backend.base import make_backend
+
+                be = make_backend("file", {"root": str(tmp_path / "remote")})
+                try:
+                    got = await be.download(
+                        "ns", get_pather("sharded_docker_blob")("", d.hex)
+                    )
+                    assert got == blob
+                    break
+                except Exception:
+                    await asyncio.sleep(0.05)
+            else:
+                pytest.fail("writeback never landed")
+            await oc.close()
+        finally:
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
+
+
+def test_origin_replication_to_ring_peer(tmp_path):
+    """Upload to one origin replicates to the other ring owner."""
+
+    async def main():
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, n_agents=0, n_origins=2
+        )
+        try:
+            # ring + self_addr already set post-start by build_herd; make
+            # sure each origin knows itself.
+            for o in origins:
+                o.server.self_addr = o.addr
+            blob = os.urandom(150_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            for _ in range(100):
+                await origins[0].retry.run_once()
+                if origins[1].store.in_cache(d):
+                    break
+                await asyncio.sleep(0.05)
+            assert origins[1].store.in_cache(d), "replication never landed"
+            await oc.close()
+        finally:
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())

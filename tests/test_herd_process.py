@@ -1,0 +1,85 @@
+"""Tier-4 black-box test: real processes via the CLI, real HTTP + P2P.
+
+The reference runs its herd in Docker (SURVEY.md SS4 tier 4); here each
+component is a subprocess of ``python -m kraken_tpu.cli`` -- same process
+isolation, no containers.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(args: list[str]) -> tuple[subprocess.Popen, dict]:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kraken_tpu.cli", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    for line in proc.stdout:
+        if line.startswith("READY "):
+            return proc, json.loads(line[6:])
+    raise RuntimeError(f"component died: {args}")
+
+
+def test_process_herd_e2e(tmp_path):
+    procs = []
+    try:
+        tracker, tinfo = spawn(["tracker"])
+        procs.append(tracker)
+        origin, oinfo = spawn(
+            ["origin", "--store", str(tmp_path / "origin"),
+             "--tracker", tinfo["addr"]]
+        )
+        procs.append(origin)
+        # Tracker needs the origin cluster for metainfo: restart tracker with
+        # the origin address (processes are cheap).
+        tracker.send_signal(signal.SIGTERM)
+        tracker.wait(timeout=10)
+        procs.remove(tracker)
+        tracker, tinfo2 = spawn(["tracker", "--port", tinfo["addr"].split(":")[1],
+                                 "--origins", oinfo["addr"]])
+        procs.append(tracker)
+        agent, ainfo = spawn(
+            ["agent", "--store", str(tmp_path / "agent"),
+             "--tracker", tinfo2["addr"]]
+        )
+        procs.append(agent)
+
+        async def drive():
+            from kraken_tpu.core.digest import Digest
+            from kraken_tpu.origin.client import BlobClient
+            from kraken_tpu.utils.httputil import HTTPClient
+
+            blob = os.urandom(300_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(oinfo["addr"])
+            await oc.upload("ns", d, blob)
+            http = HTTPClient(timeout_seconds=60)
+            got = await http.get(
+                f"http://{ainfo['addr']}/namespace/ns/blobs/{d.hex}"
+            )
+            await oc.close()
+            await http.close()
+            assert got == blob
+
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,218 @@
+"""Docker-registry flow tests: push an image via the proxy's v2 API, pull
+it via the agent's v2 API -- the reference's headline end-to-end scenario
+(SURVEY.md SS3.1/SS3.2), plus tag replication between two clusters."""
+
+import asyncio
+import hashlib
+import json
+import os
+
+import pytest
+
+from kraken_tpu.assembly import (
+    AgentNode,
+    BuildIndexNode,
+    OriginNode,
+    ProxyNode,
+    TrackerNode,
+)
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.utils.httputil import HTTPClient
+
+
+def make_image(nlayers=2, layer_size=50_000):
+    """A synthetic docker image: config blob + layers + schema2 manifest."""
+    layers = [os.urandom(layer_size) for _ in range(nlayers)]
+    config = json.dumps({"architecture": "amd64", "os": "linux"}).encode()
+    manifest = json.dumps(
+        {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+            "config": {
+                "mediaType": "application/vnd.docker.container.image.v1+json",
+                "size": len(config),
+                "digest": str(Digest.from_bytes(config)),
+            },
+            "layers": [
+                {
+                    "mediaType": "application/vnd.docker.image.rootfs.diff.tar.gzip",
+                    "size": len(l),
+                    "digest": str(Digest.from_bytes(l)),
+                }
+                for l in layers
+            ],
+        }
+    ).encode()
+    return config, layers, manifest
+
+
+async def push_image(http: HTTPClient, registry: str, repo: str, tag: str,
+                     config: bytes, layers: list[bytes], manifest: bytes):
+    """Client-side of `docker push` against the v2 API."""
+    for blob in [config, *layers]:
+        d = Digest.from_bytes(blob)
+        # monolithic upload: POST -> PUT?digest=
+        import aiohttp
+
+        session_resp = await http.request(
+            "POST", f"http://{registry}/v2/{repo}/blobs/uploads/",
+            ok_statuses=(202,),
+        )
+        # Location header isn't exposed by HTTPClient; re-derive via a raw call
+        # -- use aiohttp session directly for header access.
+        s = await http._get_session()
+        async with s.post(f"http://{registry}/v2/{repo}/blobs/uploads/") as r:
+            assert r.status == 202
+            loc = r.headers["Location"]
+        async with s.put(
+            f"http://{registry}{loc}?digest={d}", data=blob
+        ) as r:
+            assert r.status == 201, await r.text()
+    s = await http._get_session()
+    async with s.put(
+        f"http://{registry}/v2/{repo}/manifests/{tag}",
+        data=manifest,
+        headers={"Content-Type": "application/vnd.docker.distribution.manifest.v2+json"},
+    ) as r:
+        assert r.status == 201, await r.text()
+
+
+async def pull_image(http: HTTPClient, registry: str, repo: str, tag: str):
+    """Client-side of `docker pull`: manifest by tag, then every blob."""
+    manifest = await http.get(f"http://{registry}/v2/{repo}/manifests/{tag}")
+    doc = json.loads(manifest)
+    blobs = {}
+    for ref in [doc["config"], *doc["layers"]]:
+        data = await http.get(f"http://{registry}/v2/{repo}/blobs/{ref['digest']}")
+        assert str(Digest.from_bytes(data)) == ref["digest"]
+        blobs[ref["digest"]] = data
+    return manifest, blobs
+
+
+async def build_cluster(tmp_path, name: str, remotes=None):
+    """tracker + origin + build-index + proxy + agent, fully wired."""
+    tracker = TrackerNode(announce_interval_seconds=0.1)
+    await tracker.start()
+    origin = OriginNode(
+        store_root=str(tmp_path / name / "origin"), tracker_addr=tracker.addr
+    )
+    await origin.start()
+    ring = Ring(HostList(static=[origin.addr]), max_replica=1)
+    cluster = ClusterClient(ring)
+    tracker.server.origin_cluster = cluster
+    bindex = BuildIndexNode(
+        store_root=str(tmp_path / name / "bindex"),
+        remotes=remotes,
+        origin_cluster=cluster,
+    )
+    await bindex.start()
+    proxy = ProxyNode(origin_cluster=cluster, build_index_addr=bindex.addr)
+    await proxy.start()
+    agent = AgentNode(
+        store_root=str(tmp_path / name / "agent"),
+        tracker_addr=tracker.addr,
+        build_index_addr=bindex.addr,
+    )
+    await agent.start()
+    return {
+        "tracker": tracker, "origin": origin, "bindex": bindex,
+        "proxy": proxy, "agent": agent, "cluster": cluster,
+    }
+
+
+async def stop_cluster(c):
+    for key in ("agent", "proxy", "bindex", "origin", "tracker"):
+        await c[key].stop()
+    await c["cluster"].close()
+
+
+def test_docker_push_pull_roundtrip(tmp_path):
+    async def main():
+        c = await build_cluster(tmp_path, "c1")
+        http = HTTPClient()
+        try:
+            config, layers, manifest = make_image()
+            await push_image(
+                http, c["proxy"].addr, "library/app", "v1", config, layers, manifest
+            )
+            got_manifest, got_blobs = await pull_image(
+                http, f"{c['agent'].host}:{c['agent'].registry_port}",
+                "library/app", "v1",
+            )
+            assert got_manifest == manifest
+            assert got_blobs[str(Digest.from_bytes(config))] == config
+            for l in layers:
+                assert got_blobs[str(Digest.from_bytes(l))] == l
+
+            # tags list + catalog
+            tags = json.loads(
+                await http.get(
+                    f"http://{c['proxy'].addr}/v2/library/app/tags/list"
+                )
+            )
+            assert tags == {"name": "library/app", "tags": ["v1"]}
+            catalog = json.loads(
+                await http.get(f"http://{c['proxy'].addr}/v2/_catalog")
+            )
+            assert catalog == {"repositories": ["library/app"]}
+        finally:
+            await http.close()
+            await stop_cluster(c)
+
+    asyncio.run(main())
+
+
+def test_agent_registry_is_read_only(tmp_path):
+    async def main():
+        c = await build_cluster(tmp_path, "c1")
+        http = HTTPClient()
+        try:
+            s = await http._get_session()
+            url = f"http://{c['agent'].host}:{c['agent'].registry_port}"
+            async with s.post(f"{url}/v2/x/blobs/uploads/") as r:
+                assert r.status == 405
+            async with s.put(f"{url}/v2/x/manifests/latest", data=b"{}") as r:
+                assert r.status == 405
+        finally:
+            await http.close()
+            await stop_cluster(c)
+
+    asyncio.run(main())
+
+
+def test_cross_cluster_tag_replication(tmp_path):
+    """Push to cluster-1; its build-index replicates the tag to cluster-2's
+    build-index (SURVEY.md SS2.4 tagreplication)."""
+
+    async def main():
+        c2 = await build_cluster(tmp_path, "c2")
+        c1 = await build_cluster(tmp_path, "c1", remotes=[c2["bindex"].addr])
+        http = HTTPClient()
+        try:
+            config, layers, manifest = make_image(nlayers=1)
+            await push_image(
+                http, c1["proxy"].addr, "library/app", "v1", config, layers, manifest
+            )
+            d = Digest.from_bytes(manifest)
+            for _ in range(100):
+                await c1["bindex"].retry.run_once()
+                body = None
+                try:
+                    body = await http.get(
+                        f"http://{c2['bindex'].addr}/tags/library%2Fapp%3Av1"
+                    )
+                except Exception:
+                    await asyncio.sleep(0.05)
+                    continue
+                assert body.decode() == str(d)
+                break
+            else:
+                pytest.fail("tag never replicated")
+        finally:
+            await http.close()
+            await stop_cluster(c1)
+            await stop_cluster(c2)
+
+    asyncio.run(main())
