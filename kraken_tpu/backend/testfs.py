@@ -59,6 +59,8 @@ class TestFSClient(BackendClient):
 class TestFSServer:
     """In-memory HTTP file server. ``async with TestFSServer(port) as s:``"""
 
+    __test__ = False  # not a pytest class despite the name
+
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self.host = host
         self.port = port

@@ -65,7 +65,7 @@ class TagServer:
     def _parse(self, req: web.Request) -> tuple[str, Digest]:
         tag = unquote(req.match_info["tag"])
         try:
-            return tag, Digest.from_hex(req.match_info["d"])
+            return tag, Digest.from_str(req.match_info["d"])
         except DigestError:
             raise web.HTTPBadRequest(text="malformed digest")
 

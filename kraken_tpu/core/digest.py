@@ -61,6 +61,11 @@ class Digest:
         return cls(SHA256, hx)
 
     @classmethod
+    def from_str(cls, s: str) -> "Digest":
+        """Lenient URL-path form: ``sha256:<hex>`` or bare ``<hex>``."""
+        return cls.parse(s) if ":" in s else cls.from_hex(s)
+
+    @classmethod
     def from_bytes(cls, data: bytes | bytearray | memoryview) -> "Digest":
         return cls(SHA256, hashlib.sha256(data).hexdigest())
 

@@ -77,7 +77,10 @@ class RegistryServer:
 
     async def _get_manifest(self, req, repo: str, ref: str) -> web.Response:
         if ref.startswith("sha256:"):
-            d = Digest.parse(ref)
+            try:
+                d = Digest.parse(ref)
+            except DigestError:
+                raise web.HTTPBadRequest(text="malformed manifest reference")
         else:
             d = await self.transferer.get_tag(f"{repo}:{ref}")
             if d is None:

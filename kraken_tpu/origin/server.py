@@ -84,7 +84,7 @@ class OriginServer:
 
     def _digest(self, req: web.Request) -> Digest:
         try:
-            return Digest.from_hex(req.match_info["d"])
+            return Digest.from_str(req.match_info["d"])
         except DigestError:
             raise web.HTTPBadRequest(text="malformed digest")
 

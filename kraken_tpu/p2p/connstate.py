@@ -102,6 +102,12 @@ class ConnState:
         self._pending.get(h, set()).discard(peer)
         self._active.get(h, set()).discard(peer)
 
+    def remove_pending(self, peer: PeerID, h: InfoHash) -> None:
+        """Release only a dial reservation. Dial-path cleanup must use this,
+        not ``remove``: the same peer may have promoted a concurrent inbound
+        conn to active, and that slot belongs to the live conn."""
+        self._pending.get(h, set()).discard(peer)
+
     def clear_torrent(self, h: InfoHash) -> None:
         self._pending.pop(h, None)
         self._active.pop(h, None)

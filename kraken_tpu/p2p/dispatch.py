@@ -72,22 +72,25 @@ class Dispatcher:
     def peers(self) -> list[PeerID]:
         return list(self._peers)
 
-    def add_conn(self, conn: Conn, peer_bitfield: bytes, num_pieces: int) -> None:
-        """Adopt a handshaken conn. Starts its recv pump. A malformed
-        bitfield drops (and reports) the peer instead of raising into the
-        scheduler."""
+    def add_conn(self, conn: Conn, peer_bitfield: bytes, num_pieces: int) -> bool:
+        """Adopt a handshaken conn. Starts its recv pump. Returns False when
+        the conn is rejected (duplicate peer or malformed bitfield) -- the
+        conn is closed here and the caller must release any conn-state slot
+        it reserved for it; a rejected duplicate must never tear down the
+        live conn's accounting."""
         if conn.peer_id in self._peers:
             conn.close()
-            return
+            return False
         try:
             has = _bits_to_set(peer_bitfield, self.torrent.num_pieces)
         except PieceError as e:
             conn.close()
             self._on_peer_failure(conn.peer_id, str(e))
-            return
+            return False
         peer = _Peer(conn, has)
         self._peers[conn.peer_id] = peer
         peer.pump = asyncio.create_task(self._pump(peer))
+        return True
 
     def _availability(self) -> dict[int, int]:
         avail: dict[int, int] = {}
@@ -131,16 +134,25 @@ class Dispatcher:
         except Exception as e:  # defensive: one peer must not kill the loop
             self._drop_peer(pid, f"peer error: {e}")
 
+    def _check_index(self, msg: Message) -> int:
+        """Piece indices from the wire are untrusted: an out-of-range index
+        is a protocol violation (drops + reports the peer), never a storage
+        seek."""
+        idx = msg.header.get("index")
+        if not isinstance(idx, int) or not 0 <= idx < self.torrent.num_pieces:
+            raise PieceError(f"piece index out of range: {idx!r}")
+        return idx
+
     async def _handle(self, peer: _Peer, msg: Message) -> None:
         if msg.type == MsgType.PIECE_REQUEST:
-            idx = msg.header["index"]
+            idx = self._check_index(msg)
             if self.torrent.has_piece(idx):
                 data = await self.torrent.read_piece_async(idx)
                 await peer.conn.send(Message.piece_payload(idx, data))
         elif msg.type == MsgType.PIECE_PAYLOAD:
-            await self._on_payload(peer, msg.header["index"], msg.payload)
+            await self._on_payload(peer, self._check_index(msg), msg.payload)
         elif msg.type == MsgType.ANNOUNCE_PIECE:
-            peer.has.add(msg.header["index"])
+            peer.has.add(self._check_index(msg))
             await self._request_more(peer)
         elif msg.type == MsgType.BITFIELD:
             peer.has = _bits_to_set(msg.payload, self.torrent.num_pieces)

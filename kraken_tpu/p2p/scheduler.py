@@ -120,6 +120,9 @@ class Scheduler:
         # handshakes on blobs we seed but have no live control for.
         self._metainfo_resolver = metainfo_resolver
         self.conn_state = ConnState(self.config.conn_state)
+        # Which Conn instance owns each conn-state active slot: a stale
+        # conn's close must never release a slot a newer conn has taken.
+        self._conn_owners: dict[tuple[PeerID, InfoHash], Conn] = {}
         self._controls: dict[InfoHash, _TorrentControl] = {}
         self._coalescer: RequestCoalescer = RequestCoalescer()
         self._server: Optional[asyncio.base_events.Server] = None
@@ -242,13 +245,13 @@ class Scheduler:
                 timeout=self.config.dial_timeout,
             )
         except (OSError, WireError, asyncio.TimeoutError):
-            self.conn_state.remove(peer.peer_id, h)
+            self.conn_state.remove_pending(peer.peer_id, h)
             self.conn_state.blacklist.add(peer.peer_id, h)
             return
         # The handshaked identity wins over the (possibly stale) announced
         # one: release the announced pending slot before promoting, or a
         # restarted peer with a new id would leak pending slots forever.
-        self.conn_state.remove(peer.peer_id, h)
+        self.conn_state.remove_pending(peer.peer_id, h)
         if not self.conn_state.promote(theirs.peer_id, h):
             writer.close()
             return
@@ -300,11 +303,24 @@ class Scheduler:
         h = ctl.torrent.info_hash
         conn = Conn(reader, writer, theirs.peer_id, h, bandwidth=self.bandwidth)
         conn.start()
-        conn.closed.add_done_callback(
-            lambda _f: self.conn_state.remove(theirs.peer_id, h)
-        )
-        ctl.dispatcher.add_conn(conn, theirs.bitfield, theirs.num_pieces)
+        if not ctl.dispatcher.add_conn(conn, theirs.bitfield, theirs.num_pieces):
+            # Rejected (duplicate peer / bad bitfield); the dispatcher closed
+            # it. promote() only succeeds when no active slot exists, so the
+            # slot being released here is this conn's own.
+            self.conn_state.remove(theirs.peer_id, h)
+            return
+        key = (theirs.peer_id, h)
+        self._conn_owners[key] = conn
+        conn.closed.add_done_callback(lambda _f: self._conn_closed(key, conn))
         self.events.emit("add_active_conn", h.hex, peer=theirs.peer_id.hex)
+
+    def _conn_closed(self, key: tuple[PeerID, InfoHash], conn: Conn) -> None:
+        if self._conn_owners.get(key) is conn:
+            del self._conn_owners[key]
+            self.conn_state.remove(*key)
+            self.events.emit(
+                "drop_active_conn", key[1].hex, peer=key[0].hex
+            )
 
     # -- retry timer -------------------------------------------------------
 

@@ -5,6 +5,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import json
+import os
 import sqlite3
 import time
 from typing import Awaitable, Callable, Optional
@@ -30,6 +31,9 @@ class TaskStore:
     """Persistence layer. One table, tiny schema, crash-safe."""
 
     def __init__(self, path: str):
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         self._db = sqlite3.connect(path)
         self._db.execute(
             """CREATE TABLE IF NOT EXISTS tasks (

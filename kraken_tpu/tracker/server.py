@@ -74,7 +74,7 @@ class TrackerServer:
     async def _metainfo(self, req: web.Request) -> web.Response:
         ns = req.match_info["ns"]
         try:
-            d = Digest.from_hex(req.match_info["d"])
+            d = Digest.from_str(req.match_info["d"])
         except DigestError:
             raise web.HTTPBadRequest(text="malformed digest")
         cached = self._metainfo_cache.get(d.hex)
