@@ -66,8 +66,18 @@ def tpu_marginal_gbps() -> float:
     key = jax.random.PRNGKey(0)
     d = jax.random.bits(key, (N_TILE, PIECE_LEN), dtype=jnp.uint8)
     d.block_until_ready()
-    # Warm up: compile + drain the pipeline.
-    _ = np.asarray(hash_pieces_device(d, PIECE_LEN)[0, 0])
+    # Warm up: compile + drain the pipeline. The warmup doubles as the
+    # kernel's correctness gate on the real chip (CPU-side validation is
+    # impractical: XLA:CPU needs >5 min to compile the unrolled body).
+    import hashlib
+
+    from kraken_tpu.ops.sha256 import _digest_bytes
+
+    warm = _digest_bytes(hash_pieces_device(d, PIECE_LEN)[:2])
+    host = np.asarray(d[:2])
+    for i in range(2):
+        want = hashlib.sha256(host[i].tobytes()).digest()
+        assert warm[i].tobytes() == want, "pallas kernel digest mismatch"
 
     def timed(k: int) -> float:
         t0 = time.perf_counter()

@@ -106,13 +106,24 @@ def _make_sha256_kernel(nb_real: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("unpadded_blocks",))
-def sha256_tiles(data_u8: jax.Array, pad_block: jax.Array, unpadded_blocks: int):
+@functools.partial(jax.jit, static_argnames=("unpadded_blocks", "interpret"))
+def sha256_tiles(
+    data_u8: jax.Array,
+    pad_block: jax.Array,
+    unpadded_blocks: int,
+    interpret: bool | None = None,
+):
     """Hash T*N_TILE equal-length pieces on the Pallas path.
 
     data_u8: [M, P] uint8 with M % N_TILE == 0 and P = unpadded_blocks * 64;
     pad_block: [16] uint32 shared SHA padding block. Returns [M, 8] uint32.
+
+    ``interpret=None`` picks interpret mode iff the default backend is CPU;
+    pass it explicitly when placing the call on a non-default platform
+    (e.g. a virtual CPU mesh while a real TPU is attached).
     """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
     m = data_u8.shape[0]
     t = m // N_TILE
     nb = unpadded_blocks + 1  # + shared padding block
@@ -142,7 +153,7 @@ def sha256_tiles(data_u8: jax.Array, pad_block: jax.Array, unpadded_blocks: int)
         _make_sha256_kernel(nb),
         # Interpret mode on CPU: the kernel logic stays testable on the
         # virtual-device suite; real TPUs compile via Mosaic.
-        interpret=jax.default_backend() == "cpu",
+        interpret=interpret,
         grid=(t, ngroups),
         in_specs=[
             pl.BlockSpec(
@@ -159,7 +170,9 @@ def sha256_tiles(data_u8: jax.Array, pad_block: jax.Array, unpadded_blocks: int)
     return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(m, 8)
 
 
-def hash_pieces_device(data_u8: jax.Array, piece_length: int) -> jax.Array:
+def hash_pieces_device(
+    data_u8: jax.Array, piece_length: int, interpret: bool | None = None
+) -> jax.Array:
     """Device-resident uniform-piece hashing via the kernel.
 
     data_u8: [M, piece_length] uint8 (any M -- padded up to N_TILE
@@ -175,4 +188,4 @@ def hash_pieces_device(data_u8: jax.Array, piece_length: int) -> jax.Array:
             [data_u8, jnp.zeros((pad_rows, piece_length), dtype=jnp.uint8)]
         )
     pad = jnp.asarray(_pad_block_for(piece_length))
-    return sha256_tiles(data_u8, pad, piece_length // 64)[:m]
+    return sha256_tiles(data_u8, pad, piece_length // 64, interpret=interpret)[:m]

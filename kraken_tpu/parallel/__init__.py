@@ -1,0 +1,18 @@
+"""Multi-chip sharding of the TPU compute plane.
+
+The reference scales its hashing hot loops by adding origin hosts; this
+framework additionally scales *within* a host across a chip mesh
+(SURVEY.md SS2.7): the piece batch is data-parallel on a 1-D ``pieces``
+mesh axis over ICI, and the tiny per-piece digest matrix (32 B/piece) is
+all-gathered so every chip holds the full result for the downstream dedup
+similarity search. Host<->host blob movement stays on TCP/DCN exactly as
+in the reference -- there is no gradient-style collective to map onto ICI.
+"""
+
+from kraken_tpu.parallel.mesh import piece_mesh
+from kraken_tpu.parallel.hashplane import (
+    ShardedPieceHasher,
+    sharded_hash_pieces,
+)
+
+__all__ = ["piece_mesh", "sharded_hash_pieces", "ShardedPieceHasher"]

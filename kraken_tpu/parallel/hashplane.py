@@ -1,0 +1,160 @@
+"""Sharded piece hashing: the hash plane over a chip mesh.
+
+Replaces the reference's scale-by-adding-origin-hosts story for the hot
+loop (uber/kraken ``lib/metainfogen`` -- upstream path, unverified;
+SURVEY.md SS2.3) with in-host chip scaling: ``shard_map`` splits the piece
+batch across the ``pieces`` mesh axis, each chip runs the identical
+single-chip kernel (Pallas on real TPUs, interpret/XLA-scan on CPU), and
+the [N, 8] digest matrix is optionally all-gathered to every chip (32
+bytes/piece -- the collective is noise next to the hashing itself).
+
+Every placement is explicit (``jax.device_put`` with a ``NamedSharding``):
+the mesh may be virtual-CPU while a real accelerator is attached, and a
+stray default-device ``jnp.asarray`` would land there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kraken_tpu.core.hasher import DIGEST_SIZE, PieceHasher, register_hasher
+from kraken_tpu.ops.sha256 import (
+    _digest_bytes,
+    _pad_block_for,
+    _sha256_uniform,
+    JaxPieceHasher,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_fn(
+    mesh: Mesh,
+    unpadded_blocks: int,
+    use_pallas: bool,
+    interpret: bool,
+    replicate: bool,
+):
+    """Compile-cached sharded hash step for one (mesh, shape-bucket) pair."""
+
+    def per_shard(data_u8, pad_block):
+        if use_pallas:
+            from kraken_tpu.ops.sha256_pallas import hash_pieces_device
+
+            return hash_pieces_device(
+                data_u8, unpadded_blocks * 64, interpret=interpret
+            )
+        return _sha256_uniform(data_u8, pad_block, unpadded_blocks)
+
+    mapped = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P("pieces", None), P()),
+        out_specs=P("pieces", None),
+        # Purely data-parallel map: the varying-manual-axes analysis trips
+        # on the replicated H0 carry entering the per-shard scan.
+        check_vma=False,
+    )
+    out_spec = P() if replicate else P("pieces", None)
+    return jax.jit(mapped, out_shardings=NamedSharding(mesh, out_spec))
+
+
+def sharded_hash_pieces(
+    mesh: Mesh,
+    data_u8: np.ndarray,
+    piece_length: int,
+    *,
+    use_pallas: bool = False,
+    interpret: bool | None = None,
+    replicate: bool = True,
+) -> jax.Array:
+    """Hash [M, piece_length] uint8 pieces data-parallel over ``mesh``.
+
+    Returns [M, 8] uint32 digest words; with ``replicate=True`` the result
+    is all-gathered (replicated on every mesh device) for downstream
+    consumers like the dedup similarity search. piece_length must be a
+    multiple of 64 (the uniform fast path; ragged tails go through the
+    single-chip ragged kernel upstream of this call).
+    """
+    if piece_length % 64:
+        raise ValueError("sharded path requires piece_length % 64 == 0")
+    n_dev = mesh.devices.size
+    if interpret is None:
+        interpret = mesh.devices.flat[0].platform == "cpu"
+
+    m = data_u8.shape[0]
+    # Equal shards are mandatory under shard_map; pallas additionally pads
+    # each shard to its tile internally, so only the device quantum matters.
+    pad_rows = (-m) % n_dev
+    if pad_rows:
+        data_u8 = np.concatenate(
+            [data_u8, np.zeros((pad_rows, piece_length), dtype=np.uint8)]
+        )
+
+    x = jax.device_put(data_u8, NamedSharding(mesh, P("pieces", None)))
+    pad_block = jax.device_put(
+        _pad_block_for(piece_length), NamedSharding(mesh, P())
+    )
+    fn = _sharded_fn(
+        mesh, piece_length // 64, use_pallas, bool(interpret), replicate
+    )
+    return fn(x, pad_block)[:m]
+
+
+class ShardedPieceHasher(PieceHasher):
+    """PieceHasher that fans the uniform fast path across every local chip.
+
+    Drop-in for the single-chip ``tpu`` hasher (``hasher: tpu-sharded`` in
+    origin/agent YAML). Ragged tail pieces fall back to the single-chip
+    ragged path -- they are a rounding error of the work.
+    """
+
+    name = "tpu-sharded"
+
+    def __init__(self, mesh: Mesh | None = None, use_pallas: bool | None = None):
+        from kraken_tpu.parallel.mesh import piece_mesh
+
+        self._mesh = mesh if mesh is not None else piece_mesh()
+        if use_pallas is None:
+            use_pallas = self._mesh.devices.flat[0].platform != "cpu"
+        self._use_pallas = use_pallas
+        self._fallback = JaxPieceHasher(use_pallas=False)
+
+    def hash_pieces(self, data, piece_length: int) -> np.ndarray:
+        if piece_length <= 0:
+            raise ValueError(f"piece_length must be positive: {piece_length}")
+        view = memoryview(data)
+        total = len(view)
+        if total == 0:
+            return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        if piece_length % 64:
+            return self._fallback.hash_pieces(data, piece_length)
+        n_full = total // piece_length
+        n = (total + piece_length - 1) // piece_length
+        out = []
+        if n_full:
+            arr = np.frombuffer(view[: n_full * piece_length], dtype=np.uint8)
+            out.append(
+                _digest_bytes(
+                    sharded_hash_pieces(
+                        self._mesh,
+                        arr.reshape(n_full, piece_length),
+                        piece_length,
+                        use_pallas=self._use_pallas,
+                        replicate=False,
+                    )
+                )
+            )
+        if n > n_full:  # ragged tail piece
+            out.append(self._fallback.hash_batch([view[n_full * piece_length :]]))
+        return np.concatenate(out) if len(out) > 1 else out[0]
+
+    def hash_batch(self, pieces) -> np.ndarray:
+        return self._fallback.hash_batch(pieces)
+
+
+register_hasher("tpu-sharded", ShardedPieceHasher)

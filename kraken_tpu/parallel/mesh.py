@@ -1,0 +1,41 @@
+"""Device-mesh construction for the hash plane.
+
+One axis -- ``pieces`` -- because the only parallel dimension SHA-256
+admits is cross-piece (the 64-round chain serializes blocks within a
+piece; SURVEY.md SS7 hard part #1). A 2-D mesh buys nothing here: there is
+no second contraction axis, and digests are small enough that the gather
+cost is noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def piece_mesh(
+    n_devices: int | None = None, platform: str | None = None
+) -> Mesh:
+    """Build a 1-D ``pieces`` mesh.
+
+    ``platform=None`` uses the default platform's devices; if those are too
+    few for ``n_devices`` (the usual single-real-chip dev setup), fall back
+    to the virtual CPU devices (``--xla_force_host_platform_device_count``).
+    Every array headed for this mesh must be placed with an explicit
+    ``NamedSharding`` -- never via default-device ``jnp.asarray``, which
+    would land on the (possibly flaky, possibly version-skewed) real
+    accelerator even when the mesh is CPU-virtual.
+    """
+    if platform is None:
+        devices = jax.devices()
+        if n_devices is not None and (
+            len(devices) < n_devices or devices[0].platform == "cpu"
+        ):
+            devices = jax.devices("cpu")
+    else:
+        devices = jax.devices(platform)
+    n = n_devices if n_devices is not None else len(devices)
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), ("pieces",))

@@ -1,0 +1,83 @@
+"""Multi-device sharding tests for the hash plane.
+
+conftest.py forces an 8-way virtual CPU mesh for the whole session, so
+shard_map collectives run for real here (the permanent in-suite multi-chip
+signal; the driver's dryrun_multichip covers the same path out-of-suite).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.ops.sha256 import _digest_bytes
+from kraken_tpu.parallel import (
+    ShardedPieceHasher,
+    piece_mesh,
+    sharded_hash_pieces,
+)
+
+
+def _want(data: np.ndarray) -> list[bytes]:
+    return [hashlib.sha256(row.tobytes()).digest() for row in data]
+
+
+def test_piece_mesh_has_eight_devices():
+    mesh = piece_mesh(8)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("pieces",)
+    assert mesh.devices.flat[0].platform == "cpu"
+
+
+# The Pallas variant is opt-in: XLA:CPU needs >5 min to compile the
+# kernel's unrolled body in any CPU mode (see dryrun_multichip docstring);
+# the kernel's correctness home is the real chip (entry() + bench.py).
+_PALLAS = (
+    [False, True] if os.environ.get("RUN_PALLAS_INTERPRET") else [False]
+)
+
+
+@pytest.mark.parametrize("use_pallas", _PALLAS)
+def test_sharded_hash_matches_hashlib(use_pallas):
+    mesh = piece_mesh(8)
+    piece_len = 256
+    n = 8 * 3 + 5  # ragged vs the device quantum: exercises row padding
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(n, piece_len), dtype=np.uint8)
+    out = sharded_hash_pieces(
+        mesh, data, piece_len, use_pallas=use_pallas, replicate=True
+    )
+    assert out.shape == (n, 8)
+    got = _digest_bytes(out)
+    want = _want(data)
+    for i in range(n):
+        assert got[i].tobytes() == want[i], f"piece {i} (pallas={use_pallas})"
+
+
+def test_sharded_output_replicated():
+    mesh = piece_mesh(8)
+    data = np.zeros((16, 128), dtype=np.uint8)
+    out = sharded_hash_pieces(mesh, data, 128, replicate=True)
+    # Replicated: every device holds the full digest matrix.
+    assert out.sharding.is_fully_replicated
+
+
+def test_sharded_hasher_registry_roundtrip():
+    hasher = get_hasher("tpu-sharded")
+    assert isinstance(hasher, ShardedPieceHasher)
+    rng = np.random.default_rng(3)
+    # 10 full 256-byte pieces + a 100-byte ragged tail.
+    blob = rng.integers(0, 256, size=10 * 256 + 100, dtype=np.uint8).tobytes()
+    got = hasher.hash_pieces(blob, 256)
+    assert got.shape == (11, 32)
+    for i in range(11):
+        want = hashlib.sha256(blob[i * 256 : (i + 1) * 256]).digest()
+        assert got[i].tobytes() == want, f"piece {i}"
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
