@@ -4,24 +4,34 @@ Measures the TPU metainfo-gen hot loop (BASELINE.json config #3: batched
 SHA-256 over uniform pieces; target >= 20 GB/s/chip on v5e) against the CPU
 hashlib baseline (config #1), printing ONE JSON line:
 
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
+     "packed_kernel_gbps": ..., "host_pack_gbps_core": ...}
 
-``vs_baseline`` is the TPU/CPU speedup -- the reference hashes pieces
+``value`` is the NATURAL-layout device path (what ``hash_pieces`` delivers
+from raw piece bytes with no host-side packing) -- the honest end-to-end
+chip number. ``packed_kernel_gbps`` is the same kernel fed the word-major
+layout the native host packer produces at staging time (the production
+origin configuration); ``host_pack_gbps_core`` is that packer's measured
+single-core rate here. PERF.md holds the full measured analysis.
+
+``vs_baseline`` is the headline/CPU speedup -- the reference hashes pieces
 sequentially on the CPU (uber/kraken lib/metainfogen [UNVERIFIED]), so the
 measured CPU rate stands in for the reference baseline (BASELINE.json
 ``published`` is empty; see BASELINE.md).
 
 Methodology notes:
-- The compute plane is exercised via the Pallas kernel
-  (kraken_tpu/ops/sha256_pallas.py) on device-resident data. On this test
-  rig the TPU sits behind a network relay whose host<->device link runs at
-  ~25 MB/s with ~200 ms round-trip latency -- both orders of magnitude off
-  a production v5e host (PCIe/DMA at tens of GB/s), so end-to-end feed
-  throughput here measures the relay, not the system.
+- On this rig the TPU sits behind a network relay whose host<->device link
+  runs at ~25 MB/s with ~200 ms round-trip latency -- both orders of
+  magnitude off a production v5e host (PCIe/DMA at tens of GB/s), so
+  end-to-end feed throughput here measures the relay, not the system.
 - Relay latency is excluded by the marginal-rate method: time K_small and
-  K_large back-to-back dispatches (one result fetch each) and divide the
-  extra bytes by the extra time. Queued dispatches execute back-to-back on
-  the chip, so the slope is pure chip throughput.
+  K_large back-to-back dispatches (one tiny result fetch each) and divide
+  the extra bytes by the extra time; median of REPS runs. Queued
+  dispatches execute back-to-back on the chip, so the slope is pure chip
+  throughput.
+- The warmup doubles as the kernel correctness gate vs hashlib on every
+  bench run (CPU-side validation is impractical: XLA:CPU needs >5 min to
+  compile the unrolled kernel body -- see PERF.md).
 """
 
 import json
@@ -40,7 +50,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 PIECE_LEN = int(os.environ.get("BENCH_PIECE_LEN", 256 * 1024))
 CPU_BYTES = int(os.environ.get("BENCH_CPU_BYTES", 256 * 1024 * 1024))
 K_SMALL = 4
-K_LARGE = int(os.environ.get("BENCH_K_LARGE", 24))
+K_LARGE = int(os.environ.get("BENCH_K_LARGE", 104))
+REPS = int(os.environ.get("BENCH_REPS", 5))
 
 
 def cpu_baseline_gbps() -> float:
@@ -57,53 +68,89 @@ def cpu_baseline_gbps() -> float:
     return len(data) / (time.perf_counter() - t0) / 1e9
 
 
-def tpu_marginal_gbps() -> float:
-    import jax
-    import jax.numpy as jnp
-
-    from kraken_tpu.ops.sha256_pallas import N_TILE, hash_pieces_device
-
-    key = jax.random.PRNGKey(0)
-    d = jax.random.bits(key, (N_TILE, PIECE_LEN), dtype=jnp.uint8)
-    d.block_until_ready()
-    # Warm up: compile + drain the pipeline. The warmup doubles as the
-    # kernel's correctness gate on the real chip (CPU-side validation is
-    # impractical: XLA:CPU needs >5 min to compile the unrolled body).
-    import hashlib
-
-    from kraken_tpu.ops.sha256 import _digest_bytes
-
-    warm = _digest_bytes(hash_pieces_device(d, PIECE_LEN)[:2])
-    host = np.asarray(d[:2])
-    for i in range(2):
-        want = hashlib.sha256(host[i].tobytes()).digest()
-        assert warm[i].tobytes() == want, "pallas kernel digest mismatch"
+def _marginal(dispatch, bytes_per_dispatch: int) -> float:
+    """Median-of-REPS marginal rate of ``dispatch()`` (async, one fetch)."""
 
     def timed(k: int) -> float:
         t0 = time.perf_counter()
         out = None
         for _ in range(k):
-            out = hash_pieces_device(d, PIECE_LEN)
+            out = dispatch()
         _ = np.asarray(out[0, 0])  # forces the whole queued chain
         return time.perf_counter() - t0
 
-    t_small, t_large = timed(K_SMALL), timed(K_LARGE)
-    extra_bytes = (K_LARGE - K_SMALL) * N_TILE * PIECE_LEN
-    return extra_bytes / max(t_large - t_small, 1e-9) / 1e9
+    rates = []
+    for _ in range(REPS):
+        t_small, t_large = timed(K_SMALL), timed(K_LARGE)
+        extra = (K_LARGE - K_SMALL) * bytes_per_dispatch
+        rates.append(extra / max(t_large - t_small, 1e-9) / 1e9)
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+def tpu_rates() -> tuple[float, float, float]:
+    """(natural_gbps, packed_gbps, host_pack_gbps_core)."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from kraken_tpu.native import pack_tiles
+    from kraken_tpu.ops.sha256 import _digest_bytes
+    from kraken_tpu.ops.sha256_pallas import (
+        N_TILE,
+        hash_pieces_device,
+        packed_nb,
+        sha256_packed_tiles,
+    )
+
+    key = jax.random.PRNGKey(0)
+    d = jax.random.bits(key, (N_TILE, PIECE_LEN), dtype=jnp.uint8)
+    d.block_until_ready()
+    host = np.asarray(d[:2])
+    want = [hashlib.sha256(host[i].tobytes()).digest() for i in range(2)]
+
+    # Natural path: warmup = correctness gate.
+    warm = _digest_bytes(hash_pieces_device(d, PIECE_LEN)[:2])
+    for i in range(2):
+        assert warm[i].tobytes() == want[i], "natural kernel digest mismatch"
+    natural = _marginal(
+        lambda: hash_pieces_device(d, PIECE_LEN), N_TILE * PIECE_LEN
+    )
+
+    # Host packer rate (single core), then packed kernel path.
+    host_all = np.asarray(d)
+    nb = packed_nb(PIECE_LEN // 64)
+    packed_np = np.zeros((1, nb, 16, 1024), dtype=np.uint32)
+    t0 = time.perf_counter()
+    pack_tiles(host_all, nb, packed_np)
+    pack_gbps = host_all.nbytes / (time.perf_counter() - t0) / 1e9
+    packed = jnp.asarray(packed_np.reshape(1, nb, 16, 8, 128))
+    packed.block_until_ready()
+    warm2 = _digest_bytes(sha256_packed_tiles(packed, PIECE_LEN // 64)[:2])
+    for i in range(2):
+        assert warm2[i].tobytes() == want[i], "packed kernel digest mismatch"
+    packed_rate = _marginal(
+        lambda: sha256_packed_tiles(packed, PIECE_LEN // 64),
+        N_TILE * PIECE_LEN,
+    )
+    return natural, packed_rate, pack_gbps
 
 
 def main() -> None:
     cpu = None
     if os.environ.get("BENCH_SKIP_CPU") != "1":
         cpu = cpu_baseline_gbps()
-    tpu = tpu_marginal_gbps()
+    natural, packed_rate, pack_gbps = tpu_rates()
     print(
         json.dumps(
             {
                 "metric": "batched_sha256_metainfo_gen",
-                "value": round(tpu, 3),
+                "value": round(natural, 3),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(tpu / cpu, 3) if cpu else None,
+                "vs_baseline": round(natural / cpu, 3) if cpu else None,
+                "packed_kernel_gbps": round(packed_rate, 2),
+                "host_pack_gbps_core": round(pack_gbps, 2),
             }
         )
     )

@@ -1,0 +1,117 @@
+"""Native host-side components (C, built on first use, ctypes-bound).
+
+The reference keeps its hot loops in Go on the host; here the chip does
+the hashing and the host's only hot job is FEEDING it (SURVEY.md SS7 hard
+part #2).  This package holds those feeder kernels.  No pybind11 in the
+image -- plain ctypes over a cc-compiled shared object, with a NumPy
+fallback when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hostpack.c")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("clang")
+    if cc is None:
+        return None
+    out = os.path.join(_HERE, "_hostpack.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    # Build into a temp file then atomically rename: concurrent importers
+    # (test workers, herd processes) must never load a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            [cc, "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, out)
+        return out
+    except (subprocess.CalledProcessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.kt_pack_tiles.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+        ]
+        lib.kt_pack_tiles.restype = None
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def have_native_packer() -> bool:
+    return _load() is not None
+
+
+def pack_tiles(
+    data: np.ndarray, nb_out: int, out: np.ndarray | None = None
+) -> np.ndarray:
+    """Pack [M, piece_len] uint8 pieces (M % 1024 == 0, piece_len % 64 == 0)
+    into the kernel's word-major [T, nb_out, 16, 8*128] big-endian u32
+    layout.  Uses the C packer when available, NumPy otherwise."""
+    m, piece_len = data.shape
+    if m % 1024 or piece_len % 64:
+        raise ValueError("pack_tiles: need M % 1024 == 0 and piece_len % 64 == 0")
+    nbd = piece_len // 64
+    if nb_out < nbd:
+        raise ValueError("pack_tiles: nb_out < piece blocks")
+    t = m // 1024
+    if out is None:
+        out = np.zeros((t, nb_out, 16, 1024), dtype=np.uint32)
+    data = np.ascontiguousarray(data)
+    lib = _load()
+    if lib is not None:
+        lib.kt_pack_tiles(
+            data.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            m,
+            piece_len,
+            nb_out,
+        )
+        return out
+    # NumPy fallback: same layout, ~10x slower.
+    w = data.reshape(t, 1024, nbd, 16, 4)
+    be = (
+        (w[..., 0].astype(np.uint32) << 24)
+        | (w[..., 1].astype(np.uint32) << 16)
+        | (w[..., 2].astype(np.uint32) << 8)
+        | w[..., 3].astype(np.uint32)
+    )  # [t, 1024, nbd, 16]
+    out[:, :nbd] = be.transpose(0, 2, 3, 1)
+    return out

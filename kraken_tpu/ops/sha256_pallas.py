@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for batched SHA-256 -- the tuned metainfo-gen path.
+"""Pallas TPU kernels for batched SHA-256 -- the tuned metainfo-gen path.
 
 Why a kernel (SURVEY.md SS7 hard part #1): the portable XLA scan in
 :mod:`kraken_tpu.ops.sha256` pays a loop-iteration overhead per 64-byte
@@ -6,20 +6,36 @@ block (the carry bounces through HBM and every iteration is a separate
 fused-kernel launch), which caps throughput far below the VPU's integer
 rate. Here the whole block chain runs inside one ``pallas_call``:
 
-- grid = (piece_tiles, blocks). Pallas revisits the same output block for
-  every ``b`` step of a tile, so the running [8, N] hash state lives in
-  VMEM for the whole chain -- written back to HBM once per tile.
-- the input is pre-packed (one XLA transpose) to [T, B, 16, N] uint32 so
-  each grid step's DMA is one contiguous [16, N] slab (64 KiB at N=1024);
-  Pallas double-buffers these loads against compute automatically.
+- grid = (piece_tiles, block_groups). Pallas revisits the same output
+  block for every ``b`` step of a tile, so the running [8, N] hash state
+  lives in VMEM for the whole chain -- written back to HBM once per tile.
 - the 48 schedule extensions + 64 rounds are fully unrolled straight-line
   vector ops on [N]-wide uint32 lanes (N=1024 = a full 8x128 VPU tile per
-  op). Unlike XLA:CPU, Mosaic compiles the ~1300-op body without
+  op). Unlike XLA:CPU, Mosaic compiles the ~6k-op body without
   pathological simplification passes.
+- the message schedule runs as a 16-word ring (w[i+16] computed in place
+  right after round i consumes w[i]), keeping ~24 vector registers live
+  instead of 72 -- a fully materialized 64-entry schedule spills.
 
 All parallelism is cross-piece: SHA-256's chain serializes blocks within a
 piece, so pieces are the batch axis and the block axis is the grid's inner
 sequential dimension.
+
+Two input layouts (PERF.md has the measured analysis, v5e 2026-07-29):
+
+- **natural** ``[M, piece_len] uint8`` -- what the store hands over. The
+  kernel transposes each [N_TILE, _KB*16]-word slab in VMEM to get pieces
+  onto VPU lanes. That relayout is the binding constraint: ~18 GB/s/chip
+  end-to-end (the rounds alone run ~5x faster). Measured alternatives --
+  per-sublane-group square transposes (14), MXU byte-plane transpose via
+  identity matmul (13.8), XLA pre-transpose (10.7), two-pass repack
+  kernel (15.6) -- are all slower.
+- **packed** ``[T, NB, 16, 8, 128] uint32`` big-endian word-major tiles,
+  produced at feed time by the native host packer
+  (:mod:`kraken_tpu.native`, AVX-512 blocked transpose). The kernel then
+  does pure rounds: **~92 GB/s/chip** measured. This is the production
+  origin path: the packer replaces the staging memcpy the feeder performs
+  anyway.
 """
 
 from __future__ import annotations
@@ -32,7 +48,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from kraken_tpu.ops.sha256 import _H0, _K, _pack_be_u32, _pad_block_for
+from kraken_tpu.ops.sha256 import _H0, _K, _pad_block_for
 
 # Pieces per grid tile, laid out as an explicit (sublane, lane) = (8, 128)
 # VPU tile so every round op maps to whole vector registers. VMEM per grid
@@ -41,7 +57,8 @@ _SUB = 8
 _LANES = 128
 N_TILE = _SUB * _LANES
 # Blocks folded per grid step: amortizes per-step pipeline overhead (the
-# block chain is ~16k steps/tile for 4 MiB pieces if KB=1).
+# block chain is ~16k steps/tile for 4 MiB pieces if KB=1). Swept 8/16/32
+# on v5e: flat at ~18 GB/s for the natural path; 8 keeps VMEM small.
 _KB = 8
 
 
@@ -49,19 +66,53 @@ def _rotr(x, n):
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _make_sha256_kernel(nb_real: int):
-    """Build the grid-step kernel for a chain of ``nb_real`` blocks.
+def _bswap32(x):
+    """LE device word -> BE SHA word (vector shifts; ~6 VPU ops)."""
+    return (
+        ((x & np.uint32(0xFF)) << np.uint32(24))
+        | ((x & np.uint32(0xFF00)) << np.uint32(8))
+        | ((x >> np.uint32(8)) & np.uint32(0xFF00))
+        | (x >> np.uint32(24))
+    )
 
-    Each step folds ``_KB`` consecutive blocks of every piece in tile ``t``
-    into the running state. blk_ref: [1, _KB, 16, 8, 128]; out_ref:
-    [1, 8, 8, 128] (revisited across the block-group axis -- carries the
-    state in VMEM).
 
-    The message schedule runs as a 16-word ring interleaved into the
-    rounds (w[i+16] = w[i] + s0(w[i+1]) + w[i+9] + s1(w[i+14]) computed in
-    place right after round i consumes w[i]), keeping ~24 vector registers
-    live instead of 72 -- a fully materialized 64-entry schedule spills.
+def _rounds64(state, wget):
+    """One SHA-256 compression (fully unrolled, 16-word schedule ring).
+
+    ``state``: list of 8 [_SUB, _LANES] uint32 tiles; ``wget(j)`` returns
+    message word j as a tile. Returns the post-feed-forward state.
     """
+    a, b, c, d, e, f, g, h = state
+    w = [wget(j) for j in range(16)]
+    for i in range(64):
+        wi = w[i % 16]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_K[i]) + wi
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        a, b, c, d, e, f, g, h = t1 + s0 + maj, a, b, c, d + t1, e, f, g
+        if i < 48:
+            w15 = w[(i + 1) % 16]
+            w2 = w[(i + 14) % 16]
+            e0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+            e1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+            w[i % 16] = wi + e0 + w[(i + 9) % 16] + e1
+    return [s + v for s, v in zip(state, (a, b, c, d, e, f, g, h))]
+
+
+def _make_kernel(nb_real: int, pad_words: np.ndarray, packed: bool):
+    """Grid-step kernel for a chain of ``nb_real`` data blocks.
+
+    The shared SHA padding block is folded from compile-time constants
+    (``pad_words``) after the last real block -- it never exists in HBM.
+    ``packed=False``: blk_ref is a natural [1, N_TILE, _KB*16] LE-word
+    slab, transposed in VMEM. ``packed=True``: blk_ref is pre-packed
+    [1, _KB, 16, _SUB, _LANES] BE words -- no relayout at all.
+    out_ref: [1, 8, _SUB, _LANES], revisited across the block-group axis
+    (carries the running state in VMEM).
+    """
+    ngroups = (nb_real + _KB - 1) // _KB
 
     def kernel(blk_ref, out_ref):
         b = pl.program_id(1)
@@ -72,38 +123,55 @@ def _make_sha256_kernel(nb_real: int):
                 out_ref[0, i, :, :] = jnp.full((_SUB, _LANES), _H0[i], jnp.uint32)
 
         state = [out_ref[0, i, :, :] for i in range(8)]
+        if not packed:
+            # Piece-major -> word-major as ONE up-front transpose. A/B on
+            # v5e (median of 5): monolithic = 18.4 GB/s end-to-end vs 14.1
+            # for per-sublane-group square transposes -- the big form gives
+            # Mosaic's scheduler independent relayout ops to interleave
+            # into the round chain's dependency bubbles.
+            w_t = jnp.transpose(blk_ref[0], (1, 0)).reshape(
+                _KB, 16, _SUB, _LANES
+            )
         for kb in range(_KB):
-            w = [blk_ref[0, kb, j, :, :] for j in range(16)]
-            a, bb, c, d, e, f, g, h = state
-            for i in range(64):
-                wi = w[i % 16]
-                s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-                ch = (e & f) ^ (~e & g)
-                t1 = h + s1 + ch + np.uint32(_K[i]) + wi
-                s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-                maj = (a & bb) ^ (a & c) ^ (bb & c)
-                a, bb, c, d, e, f, g, h = t1 + s0 + maj, a, bb, c, d + t1, e, f, g
-                if i < 48:
-                    w15 = w[(i + 1) % 16]
-                    w2 = w[(i + 14) % 16]
-                    e0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
-                    e1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
-                    w[i % 16] = wi + e0 + w[(i + 9) % 16] + e1
-            if (nb_real % _KB) and kb >= nb_real % _KB:
-                # Zero-padding blocks past the real chain must not fold in.
-                # kb position is only padding in the LAST group; elsewhere
-                # it's always real (static bound check keeps it free).
-                valid = (b + 1) * _KB <= nb_real
-                new = [jnp.where(valid, s + v, s)
-                       for s, v in zip(state, (a, bb, c, d, e, f, g, h))]
+            if packed:
+                new = _rounds64(
+                    state, lambda j, kb=kb: blk_ref[0, kb, j, :, :]
+                )
             else:
-                new = [s + v for s, v in zip(state, (a, bb, c, d, e, f, g, h))]
-            state = new
+                new = _rounds64(
+                    state, lambda j, kb=kb: _bswap32(w_t[kb, j])
+                )
+            if (nb_real % _KB) and kb >= nb_real % _KB:
+                # A position past the real chain only occurs in the final
+                # (ragged) group; elsewhere the static bound keeps it free.
+                valid = (b + 1) * _KB <= nb_real
+                state = [jnp.where(valid, nv, s) for nv, s in zip(new, state)]
+            else:
+                state = new
 
-        for i in range(8):
-            out_ref[0, i, :, :] = state[i]
+        @pl.when(b == ngroups - 1)
+        def _fold_pad():
+            st = _rounds64(
+                state,
+                lambda j: jnp.full((_SUB, _LANES), np.uint32(pad_words[j]),
+                                   jnp.uint32),
+            )
+            for i in range(8):
+                out_ref[0, i, :, :] = st[i]
+
+        @pl.when(b != ngroups - 1)
+        def _store():
+            for i in range(8):
+                out_ref[0, i, :, :] = state[i]
 
     return kernel
+
+
+def _resolve_interpret(interpret: bool | None) -> bool:
+    # interpret=None picks interpret mode iff the default backend is CPU;
+    # pass it explicitly when placing the call on a non-default platform
+    # (e.g. a virtual CPU mesh while a real TPU is attached).
+    return jax.default_backend() == "cpu" if interpret is None else interpret
 
 
 @functools.partial(jax.jit, static_argnames=("unpadded_blocks", "interpret"))
@@ -113,46 +181,67 @@ def sha256_tiles(
     unpadded_blocks: int,
     interpret: bool | None = None,
 ):
-    """Hash T*N_TILE equal-length pieces on the Pallas path.
+    """Hash T*N_TILE equal-length pieces from the NATURAL layout.
 
     data_u8: [M, P] uint8 with M % N_TILE == 0 and P = unpadded_blocks * 64;
-    pad_block: [16] uint32 shared SHA padding block. Returns [M, 8] uint32.
-
-    ``interpret=None`` picks interpret mode iff the default backend is CPU;
-    pass it explicitly when placing the call on a non-default platform
-    (e.g. a virtual CPU mesh while a real TPU is attached).
+    pad_block: [16] uint32 shared SHA padding block (kept for API
+    stability; the kernel folds compile-time constants). Returns [M, 8]
+    uint32 digest words.
     """
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+    interpret = _resolve_interpret(interpret)
     m = data_u8.shape[0]
     t = m // N_TILE
-    nb = unpadded_blocks + 1  # + shared padding block
-
-    # Pack bytes to big-endian words and lay out [T, B, 16, 8, 128] so the
-    # kernel's per-step DMA is contiguous and each word is a full VPU tile.
-    words = _pack_be_u32(data_u8.reshape(m, unpadded_blocks, 64))  # [M, B0, 16]
-    words = words.reshape(t, N_TILE, unpadded_blocks, 16).transpose(0, 2, 3, 1)
-    words = words.reshape(t, unpadded_blocks, 16, _SUB, _LANES)
-    pad = jnp.broadcast_to(
-        pad_block[None, None, :, None, None], (t, 1, 16, _SUB, _LANES)
-    )
-    words = jnp.concatenate([words, pad], axis=1)  # [T, B, 16, 8, 128]
-
-    # Pad the block axis to whole _KB groups (kernel skips the zero blocks).
+    nb = unpadded_blocks
     ngroups = (nb + _KB - 1) // _KB
-    if ngroups * _KB != nb:
-        words = jnp.concatenate(
-            [
-                words,
-                jnp.zeros((t, ngroups * _KB - nb, 16, _SUB, _LANES), jnp.uint32),
-            ],
-            axis=1,
-        )
+
+    # Bitcast bytes -> LE u32 words in natural piece-major order: zero XLA
+    # data movement (an XLA pre-transpose was the v1 bottleneck: ~12 GB/s).
+    words = jax.lax.bitcast_convert_type(
+        data_u8.reshape(m, nb * 16, 4), jnp.uint32
+    ).reshape(t, N_TILE, nb * 16)
+
+    pad_words = np.asarray(_pad_block_for(nb * 64), dtype=np.uint32)
 
     out = pl.pallas_call(
-        _make_sha256_kernel(nb),
-        # Interpret mode on CPU: the kernel logic stays testable on the
-        # virtual-device suite; real TPUs compile via Mosaic.
+        _make_kernel(nb, pad_words, packed=False),
+        interpret=interpret,
+        grid=(t, ngroups),
+        in_specs=[
+            pl.BlockSpec(
+                (1, N_TILE, _KB * 16), lambda ti, bi: (ti, 0, bi),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, _SUB, _LANES), lambda ti, bi: (ti, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, 8, _SUB, _LANES), jnp.uint32),
+    )(words)
+    return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(m, 8)
+
+
+@functools.partial(jax.jit, static_argnames=("unpadded_blocks", "interpret"))
+def sha256_packed_tiles(
+    packed: jax.Array,
+    unpadded_blocks: int,
+    interpret: bool | None = None,
+):
+    """Hash pieces already in the PACKED word-major layout.
+
+    packed: [T, NB, 16, 8, 128] uint32 big-endian words from
+    :func:`kraken_tpu.native.pack_tiles` with NB = ceil(unpadded_blocks /
+    _KB) * _KB (trailing blocks ignored). Returns [T*N_TILE, 8] uint32.
+    Pure rounds, no relayout: ~92 GB/s/chip measured on v5e.
+    """
+    interpret = _resolve_interpret(interpret)
+    t = packed.shape[0]
+    nb = unpadded_blocks
+    ngroups = (nb + _KB - 1) // _KB
+    pad_words = np.asarray(_pad_block_for(nb * 64), dtype=np.uint32)
+
+    out = pl.pallas_call(
+        _make_kernel(nb, pad_words, packed=True),
         interpret=interpret,
         grid=(t, ngroups),
         in_specs=[
@@ -166,14 +255,19 @@ def sha256_tiles(
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((t, 8, _SUB, _LANES), jnp.uint32),
-    )(words)
-    return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(m, 8)
+    )(packed)
+    return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(t * N_TILE, 8)
+
+
+def packed_nb(unpadded_blocks: int) -> int:
+    """Block-axis extent of the packed layout for a given chain length."""
+    return ((unpadded_blocks + _KB - 1) // _KB) * _KB
 
 
 def hash_pieces_device(
     data_u8: jax.Array, piece_length: int, interpret: bool | None = None
 ) -> jax.Array:
-    """Device-resident uniform-piece hashing via the kernel.
+    """Device-resident uniform-piece hashing from the natural layout.
 
     data_u8: [M, piece_length] uint8 (any M -- padded up to N_TILE
     internally); returns [M, 8] uint32 digest words. piece_length must be a
@@ -189,3 +283,30 @@ def hash_pieces_device(
         )
     pad = jnp.asarray(_pad_block_for(piece_length))
     return sha256_tiles(data_u8, pad, piece_length // 64, interpret=interpret)[:m]
+
+
+def hash_packed_pieces(
+    data: np.ndarray, piece_length: int, interpret: bool | None = None
+) -> jax.Array:
+    """Host pack (native AVX-512 when available) + packed-kernel hash.
+
+    data: host [M, piece_length] uint8. The pack replaces the staging copy
+    a production feeder performs anyway; see PERF.md for the feed-rate
+    math. Returns [M, 8] uint32 digest words on device.
+    """
+    from kraken_tpu.native import pack_tiles
+
+    if piece_length % 64:
+        raise ValueError("pallas path requires piece_length % 64 == 0")
+    m = data.shape[0]
+    pad_rows = (-m) % N_TILE
+    if pad_rows:
+        data = np.concatenate(
+            [data, np.zeros((pad_rows, piece_length), dtype=np.uint8)]
+        )
+    nb = packed_nb(piece_length // 64)
+    packed = pack_tiles(np.ascontiguousarray(data), nb)
+    packed = packed.reshape(-1, nb, 16, _SUB, _LANES)
+    return sha256_packed_tiles(
+        jnp.asarray(packed), piece_length // 64, interpret=interpret
+    )[:m]
