@@ -97,7 +97,10 @@ class OriginNode:
         retry_db: str = "",
         piece_lengths: PieceLengthConfig | None = None,
         cleanup: CleanupConfig | None = None,
+        dedup: bool = True,
     ):
+        from kraken_tpu.origin.dedup import DedupIndex
+
         self.host = host
         self.http_port = http_port
         self.p2p_port = p2p_port
@@ -105,6 +108,9 @@ class OriginNode:
         self.store = CAStore(store_root)
         self.generator = Generator(
             self.store, hasher=get_hasher(hasher), piece_lengths=piece_lengths
+        )
+        self.dedup = (
+            DedupIndex(self.store, hasher=get_hasher(hasher)) if dedup else None
         )
         self.backends = backends
         self.refresher = (
@@ -167,6 +173,7 @@ class OriginNode:
             ring=self.ring,
             self_addr=self.self_addr,
             scheduler=self.scheduler,
+            dedup=self.dedup,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port
@@ -180,6 +187,9 @@ class OriginNode:
             metainfo = self.generator.get_cached(d)
             if metainfo is not None:
                 self.scheduler.seed(metainfo, "startup")
+        # Rebuild the dedup index from persisted sketch sidecars.
+        if self.dedup is not None:
+            await asyncio.to_thread(self.dedup.load_existing)
 
     async def stop(self) -> None:
         self.retry.stop()

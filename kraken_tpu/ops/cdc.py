@@ -40,21 +40,27 @@ from kraken_tpu.ops import next_pow2
 
 _WINDOW = 32  # bytes of history in a 32-bit gear hash
 
-# Deterministic 256-entry gear table: framework constant, must never change
-# (chunk boundaries are a persistent on-disk contract once dedup metadata is
-# written). Generated from SHA-256 of the entry index.
-def _make_gear() -> np.ndarray:
-    import hashlib
-
-    out = np.empty(256, dtype=np.uint32)
-    for i in range(256):
-        out[i] = int.from_bytes(
-            hashlib.sha256(b"kraken-tpu-gear-%d" % i).digest()[:4], "big"
-        )
-    return out
+# Deterministic gear function: framework constant, must never change (chunk
+# boundaries are a persistent on-disk contract once dedup metadata is
+# written). Defined ARITHMETICALLY (murmur-style avalanche of the byte)
+# rather than as a lookup table: TPUs have no fast arbitrary gather -- a
+# 256-entry table lookup ran the device pass at ~0.1 GB/s, while the same
+# dispersion as 6 vector ops runs at memory speed. The table form below is
+# derived from the function and is only used by host-side code.
+_GEAR_C1 = 0x9E3779B1  # golden-ratio odd constant
+_GEAR_C2 = 0x85EBCA77  # murmur3-style mixer
 
 
-GEAR = _make_gear()
+def _gear_fn_py(b: int) -> int:
+    """Reference arithmetic gear: byte -> well-dispersed uint32."""
+    x = ((b + 1) * _GEAR_C1) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * _GEAR_C2) & 0xFFFFFFFF
+    x ^= x >> 13
+    return x
+
+
+GEAR = np.array([_gear_fn_py(i) for i in range(256)], dtype=np.uint32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +141,14 @@ def _next_cut_reference(data: bytes, start: int, n: int, p: CDCParams) -> int:
 # -- TPU vector pass --------------------------------------------------------
 
 
+def _gear_fn_vec(b_u32: jax.Array) -> jax.Array:
+    """Vectorized arithmetic gear (exactly :func:`_gear_fn_py`)."""
+    x = (b_u32 + np.uint32(1)) * np.uint32(_GEAR_C1)
+    x = x ^ (x >> np.uint32(15))
+    x = x * np.uint32(_GEAR_C2)
+    return x ^ (x >> np.uint32(13))
+
+
 @functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
 def _gear_candidates(data_u8: jax.Array, mask_s: int, mask_l: int):
     """Rolling gear hash at every offset + both mask tests.
@@ -143,16 +157,22 @@ def _gear_candidates(data_u8: jax.Array, mask_s: int, mask_l: int):
     ``strict[i]`` means the hash of the 32-byte window ending at ``i``
     (inclusive) hits the strict mask.
 
-    The windowed form: h_i = sum_{j=0..31} GEAR[b_{i-j}] << j. Computed as
-    32 shifted adds over the gathered table -- pure VPU work, no
-    sequential dependence.
+    The windowed form: h_i = sum_{j=0..31} gear(b_{i-j}) << j. The gear
+    values come from the arithmetic mix (no gather -- see GEAR comment)
+    and the 32 shifted adds read a single zero-padded buffer at 32
+    offsets, which XLA fuses into one pass over memory (the previous
+    per-shift ``concatenate`` materialized 32 full copies).
     """
-    g = jnp.asarray(GEAR)[data_u8.astype(jnp.int32)]  # [L] uint32
+    g = _gear_fn_vec(data_u8.astype(jnp.uint32))  # [L] uint32
+    n = g.shape[0]
+    gp = jnp.concatenate([jnp.zeros(_WINDOW - 1, dtype=jnp.uint32), g])
     h = g
-    for j in range(1, min(_WINDOW, data_u8.shape[0])):
-        # shift the gather right by j: h_i += GEAR[b_{i-j}] << j
-        rolled = jnp.concatenate([jnp.zeros(j, dtype=jnp.uint32), g[:-j]])
-        h = h + (rolled << np.uint32(j))
+    for j in range(1, min(_WINDOW, n)):
+        # h_i += gear(b_{i-j}) << j ; slice of the one padded buffer.
+        h = h + (
+            jax.lax.dynamic_slice(gp, (_WINDOW - 1 - j,), (n,))
+            << np.uint32(j)
+        )
     strict = (h & np.uint32(mask_s)) == 0
     loose = (h & np.uint32(mask_l)) == 0
     return strict, loose

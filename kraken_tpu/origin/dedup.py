@@ -1,0 +1,199 @@
+"""Cross-layer dedup plane: CDC chunks -> TPU fingerprints -> LSH index.
+
+North-star capability absent from the reference (BASELINE.json configs
+#4-5; SURVEY.md SS2.6 table): on every blob that lands in an origin's
+CAStore, the blob is content-defined-chunked (:mod:`kraken_tpu.ops.cdc`),
+each chunk is fingerprinted through the batched SHA plane, a MinHash
+sketch is built (:mod:`kraken_tpu.ops.minhash`), and the sketch is
+inserted into an LSH index so near-duplicate layers are queryable at
+``GET /namespace/{ns}/blobs/{d}/similar``.
+
+Sketches and per-chunk (fingerprint, size) tables persist as metadata
+sidecars beside the blob, so restarts rebuild the index from disk without
+re-chunking, and the corpus-level dedup ratio (bytes of chunks already
+seen elsewhere / total bytes) is exact across restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+
+import numpy as np
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import PieceHasher, get_hasher
+from kraken_tpu.ops.cdc import CDCParams, chunk_spans
+from kraken_tpu.ops.minhash import (
+    LSHIndex,
+    MinHasher,
+    fingerprints_from_digests,
+)
+from kraken_tpu.store import CAStore, Metadata, register_metadata
+
+_MAGIC = 0xC5
+_VERSION = 1
+
+
+@register_metadata
+class ChunkSketchMetadata(Metadata):
+    """Persisted dedup record: MinHash sketch + per-chunk (fp, size) table."""
+
+    name = "chunksketch"
+
+    def __init__(
+        self, sketch: np.ndarray, fps: np.ndarray, sizes: np.ndarray
+    ):
+        self.sketch = np.asarray(sketch, dtype=np.uint32)
+        self.fps = np.asarray(fps, dtype=np.uint32)
+        self.sizes = np.asarray(sizes, dtype=np.uint32)
+        if self.fps.shape != self.sizes.shape:
+            raise ValueError("fps/sizes length mismatch")
+
+    def serialize(self) -> bytes:
+        head = struct.pack(
+            "<BBHI", _MAGIC, _VERSION, self.sketch.size, self.fps.size
+        )
+        return (
+            head
+            + self.sketch.tobytes()
+            + self.fps.tobytes()
+            + self.sizes.tobytes()
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "ChunkSketchMetadata":
+        magic, version, k, n = struct.unpack_from("<BBHI", raw, 0)
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError("bad chunksketch record")
+        off = struct.calcsize("<BBHI")
+        sketch = np.frombuffer(raw, dtype=np.uint32, count=k, offset=off)
+        off += 4 * k
+        fps = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
+        off += 4 * n
+        sizes = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
+        return cls(sketch.copy(), fps.copy(), sizes.copy())
+
+
+class DedupIndex:
+    """Origin-side near-duplicate service over one CAStore.
+
+    Thread-safe for the blocking entry points (they run in worker threads
+    via ``asyncio.to_thread``); the LSH index and chunk ledger mutate under
+    one lock. CDC + hashing + sketching (the heavy part) run outside it.
+    """
+
+    def __init__(
+        self,
+        store: CAStore,
+        hasher: PieceHasher | None = None,
+        params: CDCParams | None = None,
+        num_hashes: int = 128,
+        num_bands: int = 32,
+    ):
+        self.store = store
+        self.hasher = hasher or get_hasher("cpu")
+        self.params = params or CDCParams()
+        self.minhasher = MinHasher(num_hashes=num_hashes)
+        self._index = LSHIndex(self.minhasher, num_bands=num_bands)
+        self._lock = threading.Lock()
+        self._indexed: set[str] = set()
+        # Chunk ledger: fp -> size of first occurrence. Drives the exact
+        # corpus dedup accounting (duplicate bytes / total bytes).
+        self._seen: dict[int, int] = {}
+        self.total_bytes = 0
+        self.duplicate_bytes = 0
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def dedup_ratio(self) -> float:
+        """Fraction of ingested bytes whose chunks were already stored."""
+        return self.duplicate_bytes / self.total_bytes if self.total_bytes else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blobs": len(self._indexed),
+                "unique_chunks": len(self._seen),
+                "total_bytes": self.total_bytes,
+                "duplicate_bytes": self.duplicate_bytes,
+                "dedup_ratio": round(self.dedup_ratio, 4),
+            }
+
+    # -- ingest ------------------------------------------------------------
+
+    def _compute_record(self, data: bytes) -> ChunkSketchMetadata:
+        spans = chunk_spans(data, self.params)
+        view = memoryview(data)
+        chunks = [view[s:e] for s, e in spans]
+        digests = self.hasher.hash_batch(chunks)  # batched TPU dispatch
+        # Per-chunk fp table keeps duplicates/order (sizes align 1:1);
+        # the sketch uses the deduped set.
+        fps_all = (
+            np.ascontiguousarray(digests[:, :4]).view(">u4").reshape(-1)
+            .astype(np.uint32)
+        )
+        sizes = np.asarray([e - s for s, e in spans], dtype=np.uint32)
+        sketch = self.minhasher.sketch(fingerprints_from_digests(digests))
+        return ChunkSketchMetadata(sketch, fps_all, sizes)
+
+    def add_blob_sync(self, d: Digest) -> ChunkSketchMetadata:
+        """Chunk + sketch + index blob ``d`` (idempotent; loads the sidecar
+        if one exists). Raises KeyError if the blob is not in cache."""
+        with self._lock:
+            if d.hex in self._indexed:
+                return self.store.get_metadata(d, ChunkSketchMetadata)
+        record = self.store.get_metadata(d, ChunkSketchMetadata)
+        if record is None:
+            data = self.store.read_cache_file(d)  # KeyError if absent
+            record = self._compute_record(data)
+            self.store.set_metadata(d, record)
+        self._admit(d, record)
+        return record
+
+    def _admit(self, d: Digest, record: ChunkSketchMetadata) -> None:
+        with self._lock:
+            if d.hex in self._indexed:
+                return
+            self._indexed.add(d.hex)
+            self._index.add(d.hex, record.sketch)
+            for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):
+                self.total_bytes += size
+                if fp in self._seen:
+                    self.duplicate_bytes += size
+                else:
+                    self._seen[fp] = size
+
+    async def add_blob(self, d: Digest) -> None:
+        await asyncio.to_thread(self.add_blob_sync, d)
+
+    def load_existing(self) -> int:
+        """Index every cached blob that already has a sketch sidecar (origin
+        startup); returns the number admitted."""
+        n = 0
+        for d in self.store.list_cache_digests():
+            record = self.store.get_metadata(d, ChunkSketchMetadata)
+            if record is not None:
+                self._admit(d, record)
+                n += 1
+        return n
+
+    # -- query -------------------------------------------------------------
+
+    def similar(
+        self, d: Digest, k: int = 10, min_jaccard: float = 0.05
+    ) -> list[dict]:
+        """Near-duplicate blobs of ``d`` (must be indexed or have a sidecar):
+        [{"digest": hex, "score": estimated-Jaccard}], best first."""
+        record = self.store.get_metadata(d, ChunkSketchMetadata)
+        if record is None:
+            raise KeyError(d.hex)
+        with self._lock:
+            hits = self._index.query(record.sketch, k=k + 1, min_jaccard=min_jaccard)
+        return [
+            {"digest": key, "score": round(score, 4)}
+            for key, score in hits
+            if key != d.hex
+        ][:k]

@@ -12,6 +12,8 @@ Endpoints:
     GET    /namespace/{ns}/blobs/{d}                        -> blob bytes
     GET    /namespace/{ns}/blobs/{d}/stat                   -> {"size": n}
     GET    /namespace/{ns}/blobs/{d}/metainfo               -> metainfo doc
+    GET    /namespace/{ns}/blobs/{d}/similar                -> near-dup list
+    GET    /dedup/stats                                     -> corpus stats
     DELETE /namespace/{ns}/blobs/{d}
     GET    /health
 
@@ -55,6 +57,7 @@ class OriginServer:
         ring: Ring | None = None,
         self_addr: str = "",
         scheduler=None,  # p2p Scheduler seeding our blobs (optional)
+        dedup=None,  # origin.dedup.DedupIndex (optional)
     ):
         self.store = store
         self.generator = generator
@@ -64,6 +67,8 @@ class OriginServer:
         self.ring = ring
         self.self_addr = self_addr
         self.scheduler = scheduler
+        self.dedup = dedup
+        self._dedup_tasks: set[asyncio.Task] = set()
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
 
@@ -77,6 +82,8 @@ class OriginServer:
         r.add_put("/namespace/{ns}/blobs/{d}/uploads/{uid}/commit", self._commit)
         r.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
         r.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
+        r.add_get("/namespace/{ns}/blobs/{d}/similar", self._similar)
+        r.add_get("/dedup/stats", self._dedup_stats)
         r.add_get("/namespace/{ns}/blobs/{d}", self._download)
         r.add_delete("/namespace/{ns}/blobs/{d}", self._delete)
         r.add_get("/health", self._health)
@@ -126,6 +133,23 @@ class OriginServer:
         if self.writeback is not None:
             self.writeback.enqueue(ns, d)
         self._enqueue_replication(ns, d)
+        self._schedule_dedup(d)
+
+    def _schedule_dedup(self, d: Digest) -> None:
+        """Chunk+sketch+index off the request path; failures are non-fatal
+        (the sidecar is recomputed on the next touch)."""
+        if self.dedup is None:
+            return
+
+        async def run():
+            try:
+                await self.dedup.add_blob(d)
+            except Exception:
+                pass
+
+        task = asyncio.create_task(run())
+        self._dedup_tasks.add(task)
+        task.add_done_callback(self._dedup_tasks.discard)
 
     # -- replication to ring peers -----------------------------------------
 
@@ -166,6 +190,7 @@ class OriginServer:
             await self.refresher.refresh(ns, d)
         except BlobNotFoundError:
             raise web.HTTPNotFound(text="blob not found (backend miss)")
+        self._schedule_dedup(d)
 
     async def _stat(self, req: web.Request) -> web.Response:
         d = self._digest(req)
@@ -191,6 +216,26 @@ class OriginServer:
             # Metainfo fetch precedes a swarm download: make sure we seed.
             self.scheduler.seed(metainfo, ns)
         return web.Response(body=metainfo.serialize())
+
+    async def _similar(self, req: web.Request) -> web.Response:
+        if self.dedup is None:
+            raise web.HTTPNotFound(text="dedup index disabled")
+        d = self._digest(req)
+        k = int(req.query.get("k", "10"))
+        min_j = float(req.query.get("min_jaccard", "0.05"))
+        try:
+            # Ensure this blob is indexed (sync path: cheap when the
+            # sidecar exists; chunks+sketches on first touch otherwise).
+            await asyncio.to_thread(self.dedup.add_blob_sync, d)
+            hits = await asyncio.to_thread(self.dedup.similar, d, k, min_j)
+        except KeyError:
+            raise web.HTTPNotFound(text="blob not found")
+        return web.json_response({"similar": hits})
+
+    async def _dedup_stats(self, req: web.Request) -> web.Response:
+        if self.dedup is None:
+            raise web.HTTPNotFound(text="dedup index disabled")
+        return web.json_response(self.dedup.stats())
 
     async def _delete(self, req: web.Request) -> web.Response:
         d = self._digest(req)
