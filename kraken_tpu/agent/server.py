@@ -16,6 +16,7 @@ Endpoints:
 from __future__ import annotations
 
 import asyncio
+import urllib.parse
 
 from aiohttp import web
 
@@ -48,7 +49,7 @@ class AgentServer:
             raise web.HTTPBadRequest(text="malformed digest")
 
     async def _download(self, req: web.Request) -> web.Response:
-        ns = req.match_info["ns"]
+        ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         if not self.store.in_cache(d):
             try:

@@ -28,3 +28,6 @@ import kraken_tpu.backend.filebackend  # noqa: E402,F401
 import kraken_tpu.backend.httpbackend  # noqa: E402,F401
 import kraken_tpu.backend.testfs  # noqa: E402,F401
 import kraken_tpu.backend.shadowbackend  # noqa: E402,F401
+import kraken_tpu.backend.s3backend  # noqa: E402,F401  (also: gcs)
+import kraken_tpu.backend.hdfsbackend  # noqa: E402,F401
+import kraken_tpu.backend.registrybackend  # noqa: E402,F401

@@ -1,0 +1,116 @@
+"""Pull-through backend against an upstream Docker registry.
+
+Mirrors uber/kraken ``lib/backend/registrybackend`` (blobs + tags clients
+speaking the Registry v2 API to an existing registry; how real clusters
+bootstrap content they didn't push) -- upstream path, unverified; SURVEY.md
+SS2.3.
+
+Two registrations:
+
+- ``registry_blob``: name = blob digest (hex or ``sha256:<hex>``);
+  download GETs ``/v2/{namespace}/blobs/sha256:<hex>``. Read-only.
+- ``registry_tag``: name = ``repo:tag``; download resolves the manifest
+  and returns the manifest DIGEST string (the tag value the build-index
+  stores), taken from ``Docker-Content-Digest`` or hashed from the body.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from kraken_tpu.backend.base import (
+    BackendClient,
+    BackendError,
+    BlobInfo,
+    BlobNotFoundError,
+    register_backend,
+)
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+
+_MANIFEST_ACCEPT = ", ".join(
+    (
+        "application/vnd.docker.distribution.manifest.v2+json",
+        "application/vnd.docker.distribution.manifest.list.v2+json",
+        "application/vnd.oci.image.manifest.v1+json",
+        "application/vnd.oci.image.index.v1+json",
+    )
+)
+
+
+def _full_digest(name: str) -> str:
+    return name if name.startswith("sha256:") else f"sha256:{name}"
+
+
+class _RegistryBase(BackendClient):
+    def __init__(self, config: dict):
+        addr = config["address"]
+        scheme = "https" if config.get("tls", False) else "http"
+        self.base = f"{scheme}://{addr}/v2"
+        self._http = HTTPClient(retries=config.get("retries", 3))
+
+    async def upload(self, namespace: str, name: str, data: bytes) -> None:
+        raise BackendError("registry backend is read-only (pull-through)")
+
+    async def list(self, prefix: str) -> list[str]:
+        raise BackendError("registry backend does not support list")
+
+    async def close(self) -> None:
+        await self._http.close()
+
+
+@register_backend("registry_blob")
+class RegistryBlobBackend(_RegistryBase):
+    """config: address ("host:port"), tls (false), retries."""
+
+    def _url(self, namespace: str, name: str) -> str:
+        return f"{self.base}/{namespace}/blobs/{_full_digest(name)}"
+
+    async def stat(self, namespace: str, name: str) -> BlobInfo:
+        try:
+            _s, headers, _b = await self._http.request_full(
+                "HEAD", self._url(namespace, name), ok_statuses=(200,),
+                retry_5xx=False,
+            )
+        except HTTPError as e:
+            if e.status == 404:
+                raise BlobNotFoundError(name) from None
+            raise
+        return BlobInfo(int(headers.get("Content-Length", 0)))
+
+    async def download(self, namespace: str, name: str) -> bytes:
+        try:
+            return await self._http.get(self._url(namespace, name))
+        except HTTPError as e:
+            if e.status == 404:
+                raise BlobNotFoundError(name) from None
+            raise
+
+
+@register_backend("registry_tag")
+class RegistryTagBackend(_RegistryBase):
+    """Resolves ``repo:tag`` names to manifest digests via the upstream."""
+
+    def _url(self, name: str) -> str:
+        repo, sep, tag = name.rpartition(":")
+        if not sep:
+            raise BackendError(f"tag name must be repo:tag, got {name!r}")
+        return f"{self.base}/{repo}/manifests/{tag}"
+
+    async def stat(self, namespace: str, name: str) -> BlobInfo:
+        digest = await self.download(namespace, name)
+        return BlobInfo(len(digest))
+
+    async def download(self, namespace: str, name: str) -> bytes:
+        try:
+            _s, headers, body = await self._http.request_full(
+                "GET", self._url(name),
+                headers={"Accept": _MANIFEST_ACCEPT}, ok_statuses=(200,),
+            )
+        except HTTPError as e:
+            if e.status == 404:
+                raise BlobNotFoundError(name) from None
+            raise
+        digest = headers.get("Docker-Content-Digest")
+        if not digest:
+            digest = "sha256:" + hashlib.sha256(body).hexdigest()
+        return digest.encode()

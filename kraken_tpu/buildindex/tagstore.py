@@ -13,7 +13,6 @@ import urllib.parse
 from typing import Optional
 
 from kraken_tpu.backend import Manager as BackendManager
-from kraken_tpu.backend.namepath import get_pather
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
 
@@ -81,9 +80,7 @@ class TagStore:
         if client is None:
             return None
         try:
-            raw = await client.download(
-                namespace or tag, get_pather("docker_tag")("", tag)
-            )
+            raw = await client.download(namespace or tag, tag)
         except Exception:
             return None
         d = Digest.parse(raw.decode().strip())
@@ -97,4 +94,4 @@ class TagStore:
         if d is None:
             return
         client = self.backends.get_client(ns)
-        await client.upload(ns, get_pather("docker_tag")("", tag), str(d).encode())
+        await client.upload(ns, tag, str(d).encode())

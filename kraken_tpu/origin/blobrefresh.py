@@ -8,7 +8,6 @@ SURVEY.md SS2.3/SS3.5. Requests coalesce so a miss storm pulls once.
 from __future__ import annotations
 
 from kraken_tpu.backend import BlobNotFoundError, Manager
-from kraken_tpu.backend.namepath import get_pather
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.metainfogen import Generator
 from kraken_tpu.store import CAStore
@@ -21,12 +20,10 @@ class Refresher:
         store: CAStore,
         backends: Manager,
         generator: Generator,
-        pather: str = "sharded_docker_blob",
     ):
         self.store = store
         self.backends = backends
         self.generator = generator
-        self._pather = get_pather(pather)
         self._coalescer: RequestCoalescer = RequestCoalescer()
 
     async def refresh(self, namespace: str, d: Digest) -> None:
@@ -43,7 +40,9 @@ class Refresher:
         client = self.backends.try_get_client(namespace)
         if client is None:
             raise BlobNotFoundError(f"no backend for namespace {namespace!r}")
-        data = await client.download(namespace, self._pather("", d.hex))
+        # Logical name only: each backend owns its physical layout
+        # (pather) -- see kraken_tpu/backend/namepath.py.
+        data = await client.download(namespace, d.hex)
         actual = Digest.from_bytes(data)
         if actual != d:
             raise BlobNotFoundError(

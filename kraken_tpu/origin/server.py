@@ -25,6 +25,7 @@ The origin seeds every cached blob over the P2P plane via its scheduler.
 from __future__ import annotations
 
 import asyncio
+import urllib.parse
 from typing import Optional
 
 from aiohttp import web
@@ -113,7 +114,7 @@ class OriginServer:
 
     async def _commit(self, req: web.Request) -> web.Response:
         uid = req.match_info["uid"]
-        ns = req.match_info["ns"]
+        ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         try:
             await asyncio.to_thread(self.store.commit_upload, uid, d)
@@ -201,14 +202,14 @@ class OriginServer:
         return web.json_response({"size": size})
 
     async def _download(self, req: web.Request) -> web.Response:
-        ns = req.match_info["ns"]
+        ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
         data = await asyncio.to_thread(self.store.read_cache_file, d)
         return web.Response(body=data)
 
     async def _metainfo(self, req: web.Request) -> web.Response:
-        ns = req.match_info["ns"]
+        ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
         metainfo = await self.generator.generate(d)

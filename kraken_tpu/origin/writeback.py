@@ -11,7 +11,6 @@ from __future__ import annotations
 import asyncio
 
 from kraken_tpu.backend import Manager as BackendManager
-from kraken_tpu.backend.namepath import get_pather
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
 from kraken_tpu.store import CAStore
@@ -28,12 +27,10 @@ class WritebackExecutor:
         store: CAStore,
         backends: BackendManager,
         retry: RetryManager,
-        pather: str = "sharded_docker_blob",
     ):
         self.store = store
         self.backends = backends
         self.retry = retry
-        self._pather = get_pather(pather)
         retry.register(KIND, self._execute)
 
     def enqueue(self, namespace: str, d: Digest) -> None:
@@ -51,6 +48,6 @@ class WritebackExecutor:
         d = Digest.from_hex(task.payload["digest"])
         client = self.backends.get_client(namespace)
         data = await asyncio.to_thread(self.store.read_cache_file, d)
-        await client.upload(namespace, self._pather("", d.hex), data)
+        await client.upload(namespace, d.hex, data)  # backend owns pathing
         # Landed durably: unpin.
         self.store.set_metadata(d, PersistMetadata(False))

@@ -15,6 +15,7 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import urllib.parse
 
 from aiohttp import web
 
@@ -72,7 +73,7 @@ class TrackerServer:
         )
 
     async def _metainfo(self, req: web.Request) -> web.Response:
-        ns = req.match_info["ns"]
+        ns = urllib.parse.unquote(req.match_info["ns"])
         try:
             d = Digest.from_str(req.match_info["d"])
         except DigestError:

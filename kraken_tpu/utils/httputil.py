@@ -102,6 +102,42 @@ class HTTPClient:
         assert last_err is not None
         raise last_err
 
+    async def request_full(
+        self,
+        method: str,
+        url: str,
+        *,
+        data: Any = None,
+        headers: dict | None = None,
+        ok_statuses: tuple[int, ...] = (200, 201, 204),
+        retry_5xx: bool = True,
+        allow_redirects: bool = True,
+    ) -> tuple[int, dict, bytes]:
+        """Like :meth:`request` but returns (status, headers, body) --
+        needed by backends that read response headers (Content-Length,
+        Docker-Content-Digest, redirect Location)."""
+        last_err: Exception | None = None
+        for attempt in range(self._retries + 1):
+            try:
+                session = await self._get_session()
+                async with session.request(
+                    method, url, data=data, headers=headers,
+                    allow_redirects=allow_redirects,
+                ) as resp:
+                    body = await resp.read()
+                    if resp.status in ok_statuses:
+                        return resp.status, dict(resp.headers), body
+                    err = HTTPError(method, url, resp.status, body)
+                    if resp.status < 500 or not retry_5xx:
+                        raise err
+                    last_err = err
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
+                last_err = e
+            if attempt < self._retries:
+                await asyncio.sleep(self._backoff.delay(attempt))
+        assert last_err is not None
+        raise last_err
+
     async def get(self, url: str, **kw) -> bytes:
         return await self.request("GET", url, **kw)
 

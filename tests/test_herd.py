@@ -134,7 +134,6 @@ def test_backend_miss_refresh_path(tmp_path):
 
     async def main():
         from kraken_tpu.backend.base import make_backend
-        from kraken_tpu.backend.namepath import get_pather
 
         backends = BackendManager(
             [{"namespace": ".*", "backend": "file",
@@ -142,9 +141,10 @@ def test_backend_miss_refresh_path(tmp_path):
         )
         blob = os.urandom(300_000)
         d = Digest.from_bytes(blob)
-        # Blob lives only in the remote backend, sharded path.
+        # Blob lives only in the remote backend (logical name; the
+        # backend owns physical pathing).
         be = make_backend("file", {"root": str(tmp_path / "remote")})
-        await be.upload("ns", get_pather("sharded_docker_blob")("", d.hex), blob)
+        await be.upload("ns", d.hex, blob)
 
         tracker, origins, agents, cluster = await build_herd(
             tmp_path, backends=backends
@@ -183,15 +183,11 @@ def test_writeback_to_backend(tmp_path):
             # Drive the retry queue until the writeback lands.
             for _ in range(50):
                 await origins[0].retry.run_once()
-                from kraken_tpu.backend.namepath import get_pather
-
                 from kraken_tpu.backend.base import make_backend
 
                 be = make_backend("file", {"root": str(tmp_path / "remote")})
                 try:
-                    got = await be.download(
-                        "ns", get_pather("sharded_docker_blob")("", d.hex)
-                    )
+                    got = await be.download("ns", d.hex)
                     assert got == blob
                     break
                 except Exception:
