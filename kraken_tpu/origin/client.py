@@ -98,10 +98,15 @@ class ClusterClient:
         self,
         ring: Ring,
         client_factory: Callable[[str], BlobClient] | None = None,
+        health=None,  # placement.healthcheck.PassiveFilter (optional)
     ):
         self.ring = ring
         self._factory = client_factory or BlobClient
         self._clients: dict[str, BlobClient] = {}
+        # Every request outcome feeds the passive filter; when it is also
+        # the ring's health_filter, failing origins leave the ring on the
+        # next refresh (SURVEY.md SS5 failure detection).
+        self.health = health
 
     def _client(self, addr: str) -> BlobClient:
         if addr not in self._clients:
@@ -111,13 +116,21 @@ class ClusterClient:
     def clients_for(self, d: Digest) -> list[BlobClient]:
         return [self._client(a) for a in self.ring.locations(d)]
 
+    def _report(self, c: BlobClient, ok: bool) -> None:
+        if self.health is not None:
+            (self.health.succeeded if ok else self.health.failed)(c.addr)
+
     async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
         last: Exception | None = None
         for c in self.clients_for(d):
             try:
-                return await c.stat(namespace, d)
+                out = await c.stat(namespace, d)
             except Exception as e:
+                self._report(c, False)
                 last = e
+                continue
+            self._report(c, True)
+            return out
         if last:
             raise last
         return None
@@ -126,18 +139,26 @@ class ClusterClient:
         last: Exception | None = None
         for c in self.clients_for(d):
             try:
-                return await c.download(namespace, d)
+                out = await c.download(namespace, d)
             except Exception as e:
+                self._report(c, False)
                 last = e
+                continue
+            self._report(c, True)
+            return out
         raise last or KeyError(str(d))
 
     async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
         last: Exception | None = None
         for c in self.clients_for(d):
             try:
-                return await c.get_metainfo(namespace, d)
+                out = await c.get_metainfo(namespace, d)
             except Exception as e:
+                self._report(c, False)
                 last = e
+                continue
+            self._report(c, True)
+            return out
         raise last or KeyError(str(d))
 
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
@@ -147,7 +168,9 @@ class ClusterClient:
         for c in self.clients_for(d):
             try:
                 await c.upload(namespace, d, data)
+                self._report(c, True)
             except Exception as e:
+                self._report(c, False)
                 errs.append(e)
         if len(errs) == len(self.clients_for(d)):
             raise errs[0]

@@ -160,6 +160,19 @@ class Scheduler:
         metainfo = await self.metainfo_client.get(namespace, d)
         ctl = self._get_or_create_control(metainfo, namespace)
         await asyncio.shield(ctl.dispatcher.done)
+        if not self.config.seed_on_complete:
+            # Download-only mode: tear the torrent down instead of
+            # lazily seeding it (e.g. bandwidth-constrained edge agents).
+            self._remove_control(metainfo.info_hash)
+
+    def _remove_control(self, h: InfoHash) -> None:
+        ctl = self._controls.pop(h, None)
+        if ctl is None:
+            return
+        ctl.cancel_tasks()
+        ctl.dispatcher.close()
+        self.conn_state.clear_torrent(h)
+        self.events.emit("remove_torrent", h.hex)
 
     def seed(self, metainfo: MetaInfo, namespace: str) -> None:
         """Start seeding a complete local blob (origin startup / post-

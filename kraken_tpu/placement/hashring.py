@@ -42,6 +42,11 @@ class Ring:
     def members(self) -> list[str]:
         return list(self._members)
 
+    def all_hosts(self) -> list[str]:
+        """Unfiltered membership -- what health monitors must keep probing
+        (a host filtered out of ``members`` still needs probes to recover)."""
+        return self._hosts.resolve()
+
     def on_change(self, fn: Callable[[list[str]], None]) -> None:
         self._listeners.append(fn)
 

@@ -98,6 +98,25 @@ class TTIMetadata(Metadata):
 
 
 @register_metadata
+class NamespaceMetadata(Metadata):
+    """The namespace a blob was committed under -- needed by the repair
+    path, which re-replicates blobs long after the upload request (and its
+    namespace) is gone."""
+
+    name = "namespace"
+
+    def __init__(self, namespace: str):
+        self.namespace = namespace
+
+    def serialize(self) -> bytes:
+        return self.namespace.encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "NamespaceMetadata":
+        return cls(raw.decode())
+
+
+@register_metadata
 class PersistMetadata(Metadata):
     """Marks a cache file as exempt from eviction (e.g. pending writeback)."""
 
