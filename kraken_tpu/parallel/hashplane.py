@@ -102,7 +102,13 @@ def sharded_hash_pieces(
     fn = _sharded_fn(
         mesh, piece_length // 64, use_pallas, bool(interpret), replicate
     )
-    return fn(x, pad_block)[:m]
+    out = fn(x, pad_block)
+    if pad_rows:
+        # Static-index slice: a dynamic `out[:m]` gather eagerly transfers
+        # its int32 start index to the DEFAULT device -- the round-2 driver
+        # failure, where that device was a version-skewed real TPU.
+        out = jax.lax.slice_in_dim(out, 0, m)
+    return out
 
 
 class ShardedPieceHasher(PieceHasher):

@@ -77,7 +77,33 @@ def test_sharded_hasher_registry_roundtrip():
         assert got[i].tobytes() == want, f"piece {i}"
 
 
-def test_graft_dryrun_multichip():
-    import __graft_entry__ as g
+def test_graft_dryrun_is_hermetic():
+    """The dryrun must pass with a HOSTILE parent environment.
 
-    g.dryrun_multichip(8)
+    Round-2 regression: the driver gate failed because the dryrun depended
+    on the driver's XLA_FLAGS for device count and let an eager gather
+    index land on the default (real, version-skewed) TPU device. The
+    subprocess re-exec must scrub both: bogus JAX_PLATFORMS, no XLA_FLAGS.
+    Inside the dryrun, transfer_guard_host_to_device("disallow") turns any
+    stray implicit default-device placement into a hard failure.
+    """
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "tpu"  # bogus here: no TPU in the test env
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__; __graft_entry__.dryrun_multichip(8)",
+        ],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
