@@ -24,18 +24,22 @@ sequential dimension.
 Two input layouts (PERF.md has the measured analysis, v5e 2026-07-29):
 
 - **natural** ``[M, piece_len] uint8`` -- what the store hands over. The
-  kernel transposes each [N_TILE, _KB*16]-word slab in VMEM to get pieces
-  onto VPU lanes. That relayout is the binding constraint: ~18 GB/s/chip
-  end-to-end (the rounds alone run ~5x faster). Measured alternatives --
-  per-sublane-group square transposes (14), MXU byte-plane transpose via
+  kernel transposes each [N_TILE, _KB*64] BYTE slab in VMEM (u8
+  granularity) and recombines the four byte planes into big-endian words
+  with vector shifts -- the BE combine is the byteswap, for free.
+  **~68 GB/s/chip** measured (median of repeated runs, r3). The round-2
+  u32-word transpose managed only ~18: Mosaic's 32-bit transpose was the
+  binding constraint; the u8 transpose of the same bytes runs ~4x faster
+  and the u16 variant sits between (~22). Older alternatives -- per-
+  sublane-group square transposes (14), MXU byte-plane transpose via
   identity matmul (13.8), XLA pre-transpose (10.7), two-pass repack
-  kernel (15.6) -- are all slower.
+  kernel (15.6) -- all slower still.
 - **packed** ``[T, NB, 16, 8, 128] uint32`` big-endian word-major tiles,
   produced at feed time by the native host packer
   (:mod:`kraken_tpu.native`, AVX-512 blocked transpose). The kernel then
-  does pure rounds: **~92 GB/s/chip** measured. This is the production
-  origin path: the packer replaces the staging memcpy the feeder performs
-  anyway.
+  does pure rounds: **~92 GB/s/chip** measured. Worth it only when the
+  feeder host has the cores to pack at line rate; the u8 natural path
+  made this optional rather than mandatory for >=20 GB/s.
 """
 
 from __future__ import annotations
@@ -106,9 +110,9 @@ def _make_kernel(nb_real: int, pad_words: np.ndarray, packed: bool):
 
     The shared SHA padding block is folded from compile-time constants
     (``pad_words``) after the last real block -- it never exists in HBM.
-    ``packed=False``: blk_ref is a natural [1, N_TILE, _KB*16] LE-word
-    slab, transposed in VMEM. ``packed=True``: blk_ref is pre-packed
-    [1, _KB, 16, _SUB, _LANES] BE words -- no relayout at all.
+    ``packed=False``: blk_ref is a natural [1, N_TILE, _KB*64] uint8 BYTE
+    slab, transposed in VMEM at u8 granularity. ``packed=True``: blk_ref
+    is pre-packed [1, _KB, 16, _SUB, _LANES] BE words -- no relayout.
     out_ref: [1, 8, _SUB, _LANES], revisited across the block-group axis
     (carries the running state in VMEM).
     """
@@ -124,14 +128,28 @@ def _make_kernel(nb_real: int, pad_words: np.ndarray, packed: bool):
 
         state = [out_ref[0, i, :, :] for i in range(8)]
         if not packed:
-            # Piece-major -> word-major as ONE up-front transpose. A/B on
-            # v5e (median of 5): monolithic = 18.4 GB/s end-to-end vs 14.1
-            # for per-sublane-group square transposes -- the big form gives
-            # Mosaic's scheduler independent relayout ops to interleave
-            # into the round chain's dependency bubbles.
-            w_t = jnp.transpose(blk_ref[0], (1, 0)).reshape(
-                _KB, 16, _SUB, _LANES
+            # Piece-major -> word-major as ONE up-front BYTE transpose.
+            # Granularity matters enormously on v5e (measured r3, same
+            # kernel otherwise): u8 transpose ~68 GB/s end-to-end, u16
+            # ~22, u32 ~18. Recombining the four byte planes into
+            # big-endian words costs 3 shifts + 3 ors per word and IS the
+            # byteswap -- the LE->BE conversion falls out of plane order.
+            t8 = jnp.transpose(blk_ref[0], (1, 0)).reshape(
+                _KB, 16, 4, _SUB, _LANES
             )
+
+            def _word(kb, j):
+                b0 = t8[kb, j, 0].astype(jnp.uint32)
+                b1 = t8[kb, j, 1].astype(jnp.uint32)
+                b2 = t8[kb, j, 2].astype(jnp.uint32)
+                b3 = t8[kb, j, 3].astype(jnp.uint32)
+                return (
+                    (b0 << np.uint32(24))
+                    | (b1 << np.uint32(16))
+                    | (b2 << np.uint32(8))
+                    | b3
+                )
+
         for kb in range(_KB):
             if packed:
                 new = _rounds64(
@@ -139,7 +157,7 @@ def _make_kernel(nb_real: int, pad_words: np.ndarray, packed: bool):
                 )
             else:
                 new = _rounds64(
-                    state, lambda j, kb=kb: _bswap32(w_t[kb, j])
+                    state, lambda j, kb=kb: _word(kb, j)
                 )
             if (nb_real % _KB) and kb >= nb_real % _KB:
                 # A position past the real chain only occurs in the final
