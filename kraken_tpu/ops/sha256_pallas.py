@@ -27,7 +27,7 @@ Two input layouts (PERF.md has the measured analysis, v5e 2026-07-29):
   kernel transposes each [N_TILE, _KB*64] BYTE slab in VMEM (u8
   granularity) and recombines the four byte planes into big-endian words
   with vector shifts -- the BE combine is the byteswap, for free.
-  **~68 GB/s/chip** measured (median of repeated runs, r3). The round-2
+  **~75 GB/s/chip** measured (median of repeated runs, r3). The round-2
   u32-word transpose managed only ~18: Mosaic's 32-bit transpose was the
   binding constraint; the u8 transpose of the same bytes runs ~4x faster
   and the u16 variant sits between (~22). Older alternatives -- per-
@@ -212,11 +212,16 @@ def sha256_tiles(
     nb = unpadded_blocks
     ngroups = (nb + _KB - 1) // _KB
 
-    # Bitcast bytes -> LE u32 words in natural piece-major order: zero XLA
-    # data movement (an XLA pre-transpose was the v1 bottleneck: ~12 GB/s).
-    words = jax.lax.bitcast_convert_type(
-        data_u8.reshape(m, nb * 16, 4), jnp.uint32
-    ).reshape(t, N_TILE, nb * 16)
+    # Natural piece-major BYTE slabs, one _KB-block group per grid step --
+    # no XLA-side data movement (an XLA pre-transpose was the v1
+    # bottleneck: ~12 GB/s); the kernel does the u8 relayout in VMEM.
+    slabs = data_u8.reshape(t, N_TILE, nb * 64)
+    if nb % _KB:
+        # Pad the block axis so the final (masked) grid group has a real
+        # slab to DMA; the kernel's validity mask ignores the content.
+        slabs = jnp.pad(
+            slabs, ((0, 0), (0, 0), (0, (ngroups * _KB - nb) * 64))
+        )
 
     pad_words = np.asarray(_pad_block_for(nb * 64), dtype=np.uint32)
 
@@ -226,7 +231,7 @@ def sha256_tiles(
         grid=(t, ngroups),
         in_specs=[
             pl.BlockSpec(
-                (1, N_TILE, _KB * 16), lambda ti, bi: (ti, 0, bi),
+                (1, N_TILE, _KB * 64), lambda ti, bi: (ti, 0, bi),
                 memory_space=pltpu.VMEM,
             )
         ],
@@ -235,7 +240,7 @@ def sha256_tiles(
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((t, 8, _SUB, _LANES), jnp.uint32),
-    )(words)
+    )(slabs)
     return out.reshape(t, 8, N_TILE).transpose(0, 2, 1).reshape(m, 8)
 
 
