@@ -37,7 +37,8 @@ def _build() -> Optional[str]:
     os.close(fd)
     try:
         subprocess.run(
-            [cc, "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", tmp],
+            [cc, "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
             check=True,
             capture_output=True,
         )
@@ -61,14 +62,15 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(path)
-        lib.kt_pack_tiles.argtypes = [
+        lib.kt_pack_tiles_mt.argtypes = [
             ctypes.c_void_p,
             ctypes.c_void_p,
+            ctypes.c_size_t,
             ctypes.c_size_t,
             ctypes.c_size_t,
             ctypes.c_size_t,
         ]
-        lib.kt_pack_tiles.restype = None
+        lib.kt_pack_tiles_mt.restype = None
         _LIB = lib
     except OSError:
         _LIB = None
@@ -79,12 +81,26 @@ def have_native_packer() -> bool:
     return _load() is not None
 
 
+def default_pack_threads() -> int:
+    """Feeder thread count: all cores (the pack is memory-bound, L1-blocked,
+    and embarrassingly parallel over 16-piece groups), overridable via
+    ``KT_PACK_THREADS``."""
+    env = os.environ.get("KT_PACK_THREADS")
+    if env:
+        return max(1, int(env))
+    return max(1, os.cpu_count() or 1)
+
+
 def pack_tiles(
-    data: np.ndarray, nb_out: int, out: np.ndarray | None = None
+    data: np.ndarray,
+    nb_out: int,
+    out: np.ndarray | None = None,
+    threads: int | None = None,
 ) -> np.ndarray:
     """Pack [M, piece_len] uint8 pieces (M % 1024 == 0, piece_len % 64 == 0)
     into the kernel's word-major [T, nb_out, 16, 8*128] big-endian u32
-    layout.  Uses the C packer when available, NumPy otherwise."""
+    layout.  Uses the C packer (multi-threaded over 16-piece groups) when
+    available, NumPy otherwise."""
     m, piece_len = data.shape
     if m % 1024 or piece_len % 64:
         raise ValueError("pack_tiles: need M % 1024 == 0 and piece_len % 64 == 0")
@@ -97,12 +113,13 @@ def pack_tiles(
     data = np.ascontiguousarray(data)
     lib = _load()
     if lib is not None:
-        lib.kt_pack_tiles(
+        lib.kt_pack_tiles_mt(
             data.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p),
             m,
             piece_len,
             nb_out,
+            default_pack_threads() if threads is None else max(1, threads),
         )
         return out
     # NumPy fallback: same layout, ~10x slower.

@@ -3,23 +3,25 @@
  * The SHA-256 Pallas kernel consumes word-major tiles
  * ([T, NB, 16, 8, 128] big-endian u32: word j of block b for the 1024
  * pieces of tile t, pieces laid out minor so each word is a full 8x128
- * VPU tile).  Producing that layout ON the TPU costs a VMEM relayout that
- * caps the end-to-end rate at ~18 GB/s/chip (measured on v5e across five
- * kernel formulations, 2026-07-29), while the relayout-free kernel runs
- * at ~92 GB/s/chip.  So the layout transform belongs on the HOST, where
- * it is a blocked transpose riding the staging copy the feeder does
- * anyway (pieces arrive from NIC/disk and must be copied into the upload
- * buffer regardless -- the transform replaces that memcpy, it does not
- * add a pass).
+ * VPU tile).  Since r3 the natural-layout kernel relayouts in VMEM at u8
+ * granularity at ~75 GB/s/chip, so this packer is an optional ~8% win
+ * (packed kernel ~80-92 GB/s/chip) rather than the only route to target;
+ * it remains the right call on feeder hosts with spare cores because the
+ * transform replaces the staging memcpy the feeder performs anyway
+ * (pieces arrive from NIC/disk and must be copied into the upload buffer
+ * regardless -- it does not add a pass).
  *
  * 16x16-u32 blocked transpose + byte swap; one (pieces-chunk, block)
- * working set is 1 KiB src + 1 KiB dst, L1-resident.  Single-threaded
- * here; the loop over `t` (and `b`) is embarrassingly parallel for
- * production hosts with more cores.
+ * working set is 1 KiB src + 1 KiB dst, L1-resident.  The work
+ * decomposes into independent 16-piece groups, parallelized over a
+ * pthread pool in kt_pack_tiles_mt (each group touches a disjoint
+ * 16-lane stripe of every destination word tile, so workers never share
+ * cache lines within a 64 B store row).
  */
 
 #include <stdint.h>
 #include <inttypes.h>
+#include <pthread.h>
 #include <stddef.h>
 #include <string.h>
 
@@ -28,27 +30,39 @@
 #endif
 
 #define KT_TILE 1024u /* pieces per device tile (8 sublanes x 128 lanes) */
+#define KT_GRP 16u    /* pieces per work unit (one 16x16 transpose block) */
+#define KT_GRP_PER_TILE (KT_TILE / KT_GRP)
+#define KT_MAX_THREADS 64
 
-static void pack_scalar(const uint8_t *restrict src, uint32_t *restrict dst,
-                        size_t n_pieces, size_t piece_len, size_t nb_out)
+/* One contiguous range of 16-piece groups; group g lives in tile
+ * g / KT_GRP_PER_TILE at piece offset (g % KT_GRP_PER_TILE) * 16. */
+typedef struct {
+    const uint8_t *src;
+    uint32_t *dst;
+    size_t piece_len;
+    size_t nb_out;
+    size_t g_start, g_end;
+} kt_pack_job;
+
+static void pack_range_scalar(const kt_pack_job *job)
 {
+    const size_t piece_len = job->piece_len;
     const size_t nbd = piece_len / 64;
-    const size_t t_count = n_pieces / KT_TILE;
 
-    for (size_t t = 0; t < t_count; t++) {
-        const uint8_t *sp0 = src + t * KT_TILE * piece_len;
-        uint32_t *dp0 = dst + t * nb_out * 16 * KT_TILE;
+    for (size_t g = job->g_start; g < job->g_end; g++) {
+        const size_t t = g / KT_GRP_PER_TILE;
+        const size_t p0 = (g % KT_GRP_PER_TILE) * KT_GRP;
+        const uint8_t *sp0 = job->src + t * KT_TILE * piece_len;
+        uint32_t *dp0 = job->dst + t * job->nb_out * 16 * KT_TILE;
         for (size_t b = 0; b < nbd; b++) {
             uint32_t *dpb = dp0 + b * 16 * KT_TILE;
-            for (size_t p0 = 0; p0 < KT_TILE; p0 += 16) {
-                for (size_t pp = 0; pp < 16; pp++) {
-                    const uint8_t *s = sp0 + (p0 + pp) * piece_len + b * 64;
-                    uint32_t *d = dpb + p0 + pp;
-                    for (size_t j = 0; j < 16; j++) {
-                        uint32_t v;
-                        memcpy(&v, s + 4 * j, 4);
-                        d[j * KT_TILE] = __builtin_bswap32(v);
-                    }
+            for (size_t pp = 0; pp < KT_GRP; pp++) {
+                const uint8_t *s = sp0 + (p0 + pp) * piece_len + b * 64;
+                uint32_t *d = dpb + p0 + pp;
+                for (size_t j = 0; j < 16; j++) {
+                    uint32_t v;
+                    memcpy(&v, s + 4 * j, 4);
+                    d[j * KT_TILE] = __builtin_bswap32(v);
                 }
             }
         }
@@ -89,40 +103,39 @@ static inline void tr16x16(__m512i r[16])
 /* AVX-512: contiguous 64B row loads, one vpshufb byte swap per row,
  * in-register transpose, contiguous 64B row stores. */
 __attribute__((target("avx512f,avx512bw")))
-static void pack_avx512(const uint8_t *restrict src, uint32_t *restrict dst,
-                        size_t n_pieces, size_t piece_len, size_t nb_out)
+static void pack_range_avx512(const kt_pack_job *job)
 {
+    const size_t piece_len = job->piece_len;
     const size_t nbd = piece_len / 64;
-    const size_t t_count = n_pieces / KT_TILE;
     const __m512i bswap = _mm512_broadcast_i32x4(
         _mm_setr_epi8(3, 2, 1, 0, 7, 6, 5, 4, 11, 10, 9, 8, 15, 14, 13, 12));
 
-    for (size_t t = 0; t < t_count; t++) {
-        const uint8_t *sp0 = src + t * KT_TILE * piece_len;
-        uint32_t *dp0 = dst + t * nb_out * 16 * KT_TILE;
-        for (size_t p0 = 0; p0 < KT_TILE; p0 += 16) {
-            /* b inner, p0 outer: the 16 source pieces stream sequentially
-             * through their blocks (hardware prefetch friendly). */
-            for (size_t b = 0; b < nbd; b++) {
-                uint32_t *dpb = dp0 + b * 16 * KT_TILE + p0;
-                __m512i r[16];
-                for (int pp = 0; pp < 16; pp++) {
-                    r[pp] = _mm512_loadu_si512(
-                        (const void *)(sp0 + (p0 + pp) * piece_len + b * 64));
-                    r[pp] = _mm512_shuffle_epi8(r[pp], bswap);
-                }
-                tr16x16(r);
-                if (((uintptr_t)dpb & 63) == 0) {
-                    /* Fresh lines, never re-read before the device upload:
-                     * non-temporal stores skip the read-for-ownership that
-                     * otherwise doubles write traffic. */
-                    for (int j = 0; j < 16; j++)
-                        _mm512_stream_si512(
-                            (__m512i *)(dpb + j * KT_TILE), r[j]);
-                } else {
-                    for (int j = 0; j < 16; j++)
-                        _mm512_storeu_si512((void *)(dpb + j * KT_TILE), r[j]);
-                }
+    for (size_t g = job->g_start; g < job->g_end; g++) {
+        const size_t t = g / KT_GRP_PER_TILE;
+        const size_t p0 = (g % KT_GRP_PER_TILE) * KT_GRP;
+        const uint8_t *sp0 = job->src + t * KT_TILE * piece_len;
+        uint32_t *dp0 = job->dst + t * job->nb_out * 16 * KT_TILE;
+        /* b inner: the 16 source pieces stream sequentially through
+         * their blocks (hardware prefetch friendly). */
+        for (size_t b = 0; b < nbd; b++) {
+            uint32_t *dpb = dp0 + b * 16 * KT_TILE + p0;
+            __m512i r[16];
+            for (int pp = 0; pp < 16; pp++) {
+                r[pp] = _mm512_loadu_si512(
+                    (const void *)(sp0 + (p0 + pp) * piece_len + b * 64));
+                r[pp] = _mm512_shuffle_epi8(r[pp], bswap);
+            }
+            tr16x16(r);
+            if (((uintptr_t)dpb & 63) == 0) {
+                /* Fresh lines, never re-read before the device upload:
+                 * non-temporal stores skip the read-for-ownership that
+                 * otherwise doubles write traffic. */
+                for (int j = 0; j < 16; j++)
+                    _mm512_stream_si512(
+                        (__m512i *)(dpb + j * KT_TILE), r[j]);
+            } else {
+                for (int j = 0; j < 16; j++)
+                    _mm512_storeu_si512((void *)(dpb + j * KT_TILE), r[j]);
             }
         }
     }
@@ -130,20 +143,72 @@ static void pack_avx512(const uint8_t *restrict src, uint32_t *restrict dst,
 }
 #endif
 
-/* src: n_pieces x piece_len bytes, piece-major (natural layout).
- * dst: (n_pieces/1024) x nb_out x 16 x 1024 u32 (word-major tiles).
- * n_pieces % 1024 == 0 and piece_len % 64 == 0 (caller pads);
- * nb_out >= piece_len/64 (trailing groups are left untouched). */
-void kt_pack_tiles(const uint8_t *restrict src, uint32_t *restrict dst,
-                   size_t n_pieces, size_t piece_len, size_t nb_out)
+static void pack_range(const kt_pack_job *job)
 {
 #if defined(__x86_64__)
     if (__builtin_cpu_supports("avx512f") &&
         __builtin_cpu_supports("avx512bw") &&
-        piece_len <= (1u << 27) /* i32 gather offsets: 16*piece_len < 2^31 */) {
-        pack_avx512(src, dst, n_pieces, piece_len, nb_out);
+        job->piece_len <= (1u << 27)) {
+        pack_range_avx512(job);
         return;
     }
 #endif
-    pack_scalar(src, dst, n_pieces, piece_len, nb_out);
+    pack_range_scalar(job);
+}
+
+static void *pack_worker(void *arg)
+{
+    pack_range((const kt_pack_job *)arg);
+    return NULL;
+}
+
+/* src: n_pieces x piece_len bytes, piece-major (natural layout).
+ * dst: (n_pieces/1024) x nb_out x 16 x 1024 u32 (word-major tiles).
+ * n_pieces % 1024 == 0 and piece_len % 64 == 0 (caller pads);
+ * nb_out >= piece_len/64 (trailing groups are left untouched).
+ * n_threads <= 1 packs on the calling thread. */
+void kt_pack_tiles_mt(const uint8_t *restrict src, uint32_t *restrict dst,
+                      size_t n_pieces, size_t piece_len, size_t nb_out,
+                      size_t n_threads)
+{
+    const size_t n_groups = n_pieces / KT_GRP;
+    if (n_threads > KT_MAX_THREADS)
+        n_threads = KT_MAX_THREADS;
+    if (n_threads > n_groups)
+        n_threads = n_groups;
+
+    if (n_threads <= 1) {
+        kt_pack_job job = {src, dst, piece_len, nb_out, 0, n_groups};
+        pack_range(&job);
+        return;
+    }
+
+    pthread_t tids[KT_MAX_THREADS];
+    kt_pack_job jobs[KT_MAX_THREADS];
+    size_t spawned = 0;
+    const size_t per = n_groups / n_threads;
+    const size_t rem = n_groups % n_threads;
+    size_t g = 0;
+    for (size_t i = 0; i < n_threads; i++) {
+        const size_t take = per + (i < rem ? 1 : 0);
+        jobs[i] = (kt_pack_job){src, dst, piece_len, nb_out, g, g + take};
+        g += take;
+    }
+    for (size_t i = 1; i < n_threads; i++) {
+        if (pthread_create(&tids[i], NULL, pack_worker, &jobs[i]) != 0)
+            break; /* fall back: run unspawned shards inline below */
+        spawned = i;
+    }
+    /* Shard 0 plus any shards whose thread failed to spawn. */
+    pack_range(&jobs[0]);
+    for (size_t i = spawned + 1; i < n_threads; i++)
+        pack_range(&jobs[i]);
+    for (size_t i = 1; i <= spawned; i++)
+        pthread_join(tids[i], NULL);
+}
+
+void kt_pack_tiles(const uint8_t *restrict src, uint32_t *restrict dst,
+                   size_t n_pieces, size_t piece_len, size_t nb_out)
+{
+    kt_pack_tiles_mt(src, dst, n_pieces, piece_len, nb_out, 1);
 }

@@ -42,6 +42,22 @@ def test_pack_tiles_validates_shape():
         native.pack_tiles(np.zeros((1024, 63), dtype=np.uint8), 1)
 
 
+@pytest.mark.parametrize("threads", [1, 3, 8, 64])
+def test_pack_tiles_threaded_matches_single(threads):
+    """The pthread fan-out over 16-piece groups must be bit-identical to
+    the single-threaded pack for every thread count (including more
+    threads than groups, which clamps)."""
+    if not native.have_native_packer():
+        pytest.skip("no C toolchain")
+    rng = np.random.default_rng(threads)
+    data = rng.integers(0, 256, size=(2048, 448), dtype=np.uint8)
+    nb_out = 8
+    base = native.pack_tiles(data, nb_out, threads=1)
+    got = native.pack_tiles(data, nb_out, threads=threads)
+    assert np.array_equal(got, base)
+    assert np.array_equal(got, _reference(data, nb_out))
+
+
 def test_scalar_and_simd_paths_agree():
     """The runtime-dispatched C path must agree with the NumPy fallback
     (covers both when the build has AVX-512 and when it doesn't)."""
