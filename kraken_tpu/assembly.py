@@ -124,7 +124,15 @@ class OriginNode:
         )
         self.ring = ring
         self.self_addr = self_addr
-        self.cleanup = CleanupManager(self.store, cleanup) if cleanup else None
+        self.cleanup = (
+            CleanupManager(
+                self.store,
+                cleanup,
+                on_evict=self.dedup.remove_sync if self.dedup else None,
+            )
+            if cleanup
+            else None
+        )
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[OriginServer] = None
         self._runner: Optional[web.AppRunner] = None

@@ -58,7 +58,11 @@ def sigv4_headers(
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
 
-    canonical_uri = urllib.parse.quote(parts.path or "/", safe="/")
+    # The request path is already single-percent-encoded by the caller
+    # (``_url`` quotes the key); S3 canonicalizes the path exactly as sent,
+    # so re-quoting here would double-encode ('%' -> '%25') and produce
+    # SignatureDoesNotMatch for any key containing ':', '+', space, etc.
+    canonical_uri = parts.path or "/"
     # Query keys/values must be sorted and URI-encoded.
     q = urllib.parse.parse_qsl(parts.query, keep_blank_values=True)
     canonical_query = "&".join(

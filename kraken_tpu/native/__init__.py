@@ -72,7 +72,10 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.kt_pack_tiles_mt.restype = None
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale cached _hostpack.so from an older source
+        # (timestamp-preserving deploys defeat the mtime check) lacks the
+        # symbol -- fall back to NumPy rather than crash the feeder.
         _LIB = None
     return _LIB
 
@@ -87,7 +90,10 @@ def default_pack_threads() -> int:
     ``KT_PACK_THREADS``."""
     env = os.environ.get("KT_PACK_THREADS")
     if env:
-        return max(1, int(env))
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass  # malformed override: ignore, use the core count
     return max(1, os.cpu_count() or 1)
 
 

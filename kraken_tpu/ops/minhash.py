@@ -138,19 +138,62 @@ class LSHIndex:
         self._buckets: list[dict[bytes, list[int]]] = [{} for _ in range(num_bands)]
         self._keys: list[Hashable] = []
         self._sketches: list[np.ndarray] = []
+        self._key_idx: dict[Hashable, int] = {}  # live key -> row (latest wins)
+        self._removed: set[int] = set()  # tombstoned row indices
         self._corpus: np.ndarray | None = None  # rebuilt lazily on query
 
     def __len__(self) -> int:
-        return len(self._keys)
+        return len(self._keys) - len(self._removed)
 
     def add(self, key: Hashable, sketch: np.ndarray) -> None:
         idx = len(self._keys)
         self._keys.append(key)
         self._sketches.append(np.asarray(sketch, dtype=np.uint32))
+        self._key_idx[key] = idx
         self._corpus = None
         for band, bucket in enumerate(self._buckets):
             sig = self._sketches[idx][band * self.rows : (band + 1) * self.rows].tobytes()
             bucket.setdefault(sig, []).append(idx)
+
+    def remove(self, key: Hashable) -> bool:
+        """Tombstone ``key``: its row leaves every band bucket (so it can
+        never be a candidate again); the corpus slot is reclaimed by
+        :meth:`_compact` once tombstones dominate, so a churn workload
+        (add+delete cycles) stays O(live), not O(ever-added). Returns False
+        if ``key`` is not present."""
+        idx = self._key_idx.pop(key, None)
+        if idx is None:
+            return False
+        self._removed.add(idx)
+        sketch = self._sketches[idx]
+        for band, bucket in enumerate(self._buckets):
+            sig = sketch[band * self.rows : (band + 1) * self.rows].tobytes()
+            rows = bucket.get(sig)
+            if rows is not None:
+                try:
+                    rows.remove(idx)
+                except ValueError:
+                    pass
+                if not rows:
+                    del bucket[sig]
+        if len(self._removed) > 64 and len(self._removed) * 2 > len(self._keys):
+            self._compact()
+        return True
+
+    def _compact(self) -> None:
+        """Rebuild rows/buckets without tombstones (amortized O(1)/remove)."""
+        live = [i for i in range(len(self._keys)) if i not in self._removed]
+        keys = [self._keys[i] for i in live]
+        sketches = [self._sketches[i] for i in live]
+        self._keys, self._sketches = keys, sketches
+        self._removed = set()
+        self._key_idx = {k: i for i, k in enumerate(keys)}
+        self._corpus = None
+        self._buckets = [{} for _ in range(self.num_bands)]
+        for idx, sketch in enumerate(sketches):
+            for band, bucket in enumerate(self._buckets):
+                sig = sketch[band * self.rows : (band + 1) * self.rows].tobytes()
+                bucket.setdefault(sig, []).append(idx)
 
     def candidates(self, sketch: np.ndarray) -> set[int]:
         """Indices sharing at least one band signature with ``sketch``."""
@@ -186,10 +229,11 @@ class LSHIndex:
         Exact over sketches; used when recall matters more than latency and
         as the oracle for LSH recall tests.
         """
-        if not self._keys:
+        live = [i for i in range(len(self._keys)) if i not in self._removed]
+        if not live:
             return []
         if self._corpus is None:
             self._corpus = np.stack(self._sketches)
-        scores = _score(np.asarray(sketch, dtype=np.uint32), self._corpus)
+        scores = _score(np.asarray(sketch, dtype=np.uint32), self._corpus[live])
         order = np.argsort(-scores)[:k]
-        return [(self._keys[i], float(scores[i])) for i in order]
+        return [(self._keys[live[i]], float(scores[i])) for i in order]

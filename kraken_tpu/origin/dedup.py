@@ -33,7 +33,11 @@ from kraken_tpu.ops.minhash import (
 from kraken_tpu.store import CAStore, Metadata, register_metadata
 
 _MAGIC = 0xC5
-_VERSION = 1
+# v2: ledger fingerprints widened to 64-bit (first 8 digest bytes). The v1
+# 32-bit ledger saw likely birthday collisions past ~2^16 unique chunks,
+# silently inflating duplicate_bytes; 32-bit fps remain only inside the
+# MinHash sketch, where collision noise is within estimation error.
+_VERSION = 2
 
 
 @register_metadata
@@ -46,7 +50,7 @@ class ChunkSketchMetadata(Metadata):
         self, sketch: np.ndarray, fps: np.ndarray, sizes: np.ndarray
     ):
         self.sketch = np.asarray(sketch, dtype=np.uint32)
-        self.fps = np.asarray(fps, dtype=np.uint32)
+        self.fps = np.asarray(fps, dtype=np.uint64)
         self.sizes = np.asarray(sizes, dtype=np.uint32)
         if self.fps.shape != self.sizes.shape:
             raise ValueError("fps/sizes length mismatch")
@@ -66,12 +70,14 @@ class ChunkSketchMetadata(Metadata):
     def deserialize(cls, raw: bytes) -> "ChunkSketchMetadata":
         magic, version, k, n = struct.unpack_from("<BBHI", raw, 0)
         if magic != _MAGIC or version != _VERSION:
+            # Old-version sidecars are recomputed, not migrated: v1 stored
+            # truncated fingerprints that cannot be widened after the fact.
             raise ValueError("bad chunksketch record")
         off = struct.calcsize("<BBHI")
         sketch = np.frombuffer(raw, dtype=np.uint32, count=k, offset=off)
         off += 4 * k
-        fps = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
-        off += 4 * n
+        fps = np.frombuffer(raw, dtype=np.uint64, count=n, offset=off)
+        off += 8 * n
         sizes = np.frombuffer(raw, dtype=np.uint32, count=n, offset=off)
         return cls(sketch.copy(), fps.copy(), sizes.copy())
 
@@ -99,8 +105,10 @@ class DedupIndex:
         self._index = LSHIndex(self.minhasher, num_bands=num_bands)
         self._lock = threading.Lock()
         self._indexed: set[str] = set()
-        # Chunk ledger: fp -> size of first occurrence. Drives the exact
-        # corpus dedup accounting (duplicate bytes / total bytes).
+        # Chunk ledger: 64-bit fp -> refcount across indexed blobs. Drives
+        # the exact corpus dedup accounting (duplicate bytes / total bytes)
+        # and supports removal: invariant is
+        # duplicate_bytes == total_bytes - sum(size of each unique fp).
         self._seen: dict[int, int] = {}
         self.total_bytes = 0
         self.duplicate_bytes = 0
@@ -130,22 +138,29 @@ class DedupIndex:
         chunks = [view[s:e] for s, e in spans]
         digests = self.hasher.hash_batch(chunks)  # batched TPU dispatch
         # Per-chunk fp table keeps duplicates/order (sizes align 1:1);
-        # the sketch uses the deduped set.
+        # the sketch uses the deduped 32-bit set.
         fps_all = (
-            np.ascontiguousarray(digests[:, :4]).view(">u4").reshape(-1)
-            .astype(np.uint32)
+            np.ascontiguousarray(digests[:, :8]).view(">u8").reshape(-1)
+            .astype(np.uint64)
         )
         sizes = np.asarray([e - s for s, e in spans], dtype=np.uint32)
         sketch = self.minhasher.sketch(fingerprints_from_digests(digests))
         return ChunkSketchMetadata(sketch, fps_all, sizes)
+
+    def _load_record(self, d: Digest) -> ChunkSketchMetadata | None:
+        """Sidecar record for ``d``, or None if absent or old-version."""
+        try:
+            return self.store.get_metadata(d, ChunkSketchMetadata)
+        except ValueError:
+            return None
 
     def add_blob_sync(self, d: Digest) -> ChunkSketchMetadata:
         """Chunk + sketch + index blob ``d`` (idempotent; loads the sidecar
         if one exists). Raises KeyError if the blob is not in cache."""
         with self._lock:
             if d.hex in self._indexed:
-                return self.store.get_metadata(d, ChunkSketchMetadata)
-        record = self.store.get_metadata(d, ChunkSketchMetadata)
+                return self._load_record(d)
+        record = self._load_record(d)
         if record is None:
             data = self.store.read_cache_file(d)  # KeyError if absent
             record = self._compute_record(data)
@@ -162,19 +177,48 @@ class DedupIndex:
             for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):
                 self.total_bytes += size
                 if fp in self._seen:
+                    self._seen[fp] += 1
                     self.duplicate_bytes += size
                 else:
-                    self._seen[fp] = size
+                    self._seen[fp] = 1
 
     async def add_blob(self, d: Digest) -> None:
         await asyncio.to_thread(self.add_blob_sync, d)
+
+    def remove_sync(self, d: Digest) -> bool:
+        """Drop blob ``d`` from the index and the corpus accounting (called
+        on DELETE and on cache eviction). The sidecar may already be gone
+        (the store deletes metadata with the blob), so the ledger is
+        adjusted from the record only when it is still readable."""
+        record = self._load_record(d)
+        with self._lock:
+            if d.hex not in self._indexed:
+                return False
+            self._indexed.discard(d.hex)
+            self._index.remove(d.hex)
+            if record is None:
+                return True
+            for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):
+                count = self._seen.get(fp, 0)
+                if count == 0:
+                    continue
+                self.total_bytes -= size
+                if count > 1:
+                    self._seen[fp] = count - 1
+                    self.duplicate_bytes -= size
+                else:
+                    del self._seen[fp]
+            return True
+
+    async def remove(self, d: Digest) -> bool:
+        return await asyncio.to_thread(self.remove_sync, d)
 
     def load_existing(self) -> int:
         """Index every cached blob that already has a sketch sidecar (origin
         startup); returns the number admitted."""
         n = 0
         for d in self.store.list_cache_digests():
-            record = self.store.get_metadata(d, ChunkSketchMetadata)
+            record = self._load_record(d)
             if record is not None:
                 self._admit(d, record)
                 n += 1
@@ -187,7 +231,7 @@ class DedupIndex:
     ) -> list[dict]:
         """Near-duplicate blobs of ``d`` (must be indexed or have a sidecar):
         [{"digest": hex, "score": estimated-Jaccard}], best first."""
-        record = self.store.get_metadata(d, ChunkSketchMetadata)
+        record = self._load_record(d)
         if record is None:
             raise KeyError(d.hex)
         with self._lock:

@@ -41,6 +41,7 @@ from kraken_tpu.persistedretry import Manager as RetryManager, Task
 from kraken_tpu.placement.hashring import Ring
 from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
+from kraken_tpu.store.metadata import NamespaceMetadata
 
 REPLICATE_KIND = "replicate"
 
@@ -128,6 +129,12 @@ class OriginServer:
         return web.Response(status=201)
 
     async def _post_commit(self, ns: str, d: Digest) -> None:
+        # Remember the namespace beside the blob: the repair path
+        # re-replicates long after the upload request (and its namespace)
+        # is gone (store/metadata.py NamespaceMetadata).
+        await asyncio.to_thread(
+            self.store.set_metadata, d, NamespaceMetadata(ns)
+        )
         metainfo = await self.generator.generate(d)
         if self.scheduler is not None:
             self.scheduler.seed(metainfo, ns)
@@ -222,8 +229,13 @@ class OriginServer:
         if self.dedup is None:
             raise web.HTTPNotFound(text="dedup index disabled")
         d = self._digest(req)
-        k = int(req.query.get("k", "10"))
-        min_j = float(req.query.get("min_jaccard", "0.05"))
+        try:
+            k = int(req.query.get("k", "10"))
+            min_j = float(req.query.get("min_jaccard", "0.05"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="malformed k/min_jaccard")
+        if k <= 0 or not 0.0 <= min_j <= 1.0:
+            raise web.HTTPBadRequest(text="k must be >0, min_jaccard in [0,1]")
         try:
             # Ensure this blob is indexed (sync path: cheap when the
             # sidecar exists; chunks+sketches on first touch otherwise).
@@ -240,6 +252,10 @@ class OriginServer:
 
     async def _delete(self, req: web.Request) -> web.Response:
         d = self._digest(req)
+        if self.dedup is not None:
+            # Before the blob goes: the sidecar must still be readable for
+            # the ledger adjustment.
+            await self.dedup.remove(d)
         await asyncio.to_thread(self.store.delete_cache_file, d)
         return web.Response(status=204)
 

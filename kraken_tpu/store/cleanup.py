@@ -33,9 +33,26 @@ class CleanupConfig:
 
 
 class CleanupManager:
-    def __init__(self, store: CAStore, config: CleanupConfig | None = None):
+    def __init__(
+        self,
+        store: CAStore,
+        config: CleanupConfig | None = None,
+        on_evict=None,
+    ):
         self.store = store
         self.config = config or CleanupConfig()
+        # Called with the Digest BEFORE deletion (sidecars still readable):
+        # e.g. DedupIndex.remove_sync, so eviction doesn't leave ghost
+        # entries in the similarity index. Failures don't block eviction.
+        self.on_evict = on_evict
+
+    def _evict(self, d: Digest) -> None:
+        if self.on_evict is not None:
+            try:
+                self.on_evict(d)
+            except Exception:
+                pass
+        self.store.delete_cache_file(d)
 
     def touch(self, d: Digest) -> None:
         """Record an access (callers: every blob read path)."""
@@ -70,7 +87,7 @@ class CleanupManager:
         if cfg.tti_seconds > 0:
             for d, last in list(entries):
                 if now - last > cfg.tti_seconds:
-                    self.store.delete_cache_file(d)
+                    self._evict(d)
                     evicted.append(d)
                     entries.remove((d, last))
 
@@ -85,7 +102,7 @@ class CleanupManager:
                         size = self.store.cache_size(d)
                     except KeyError:
                         continue
-                    self.store.delete_cache_file(d)
+                    self._evict(d)
                     evicted.append(d)
                     usage -= size
         return evicted

@@ -211,6 +211,63 @@ class FakeUpstreamRegistry:
 # -- s3 ----------------------------------------------------------------------
 
 
+def test_sigv4_canonical_uri_is_single_encoded():
+    """Keys needing percent-encoding (':', '+', space) must sign with the
+    request path as-sent, NOT re-encoded ('%' -> '%25' would yield
+    SignatureDoesNotMatch on real AWS/GCS).
+
+    Verified against an INDEPENDENT SigV4 derivation below (canonical
+    request built by hand from the AWS spec) -- the FakeS3 re-derives with
+    the same sigv4_headers function, so it structurally cannot catch a
+    canonicalization bug.
+    """
+    import datetime
+    import hashlib as _hl
+    import hmac as _hmac
+
+    key = "repo:tag+v1 latest"  # ':' '+' ' ' all need encoding
+    quoted = urllib.parse.quote(key)  # single-encoded, as _url() sends it
+    assert "%" in quoted
+    url = f"https://bucket.example.com/{quoted}"
+    access, secret, region = "AKIDEXAMPLE", "SECRETEXAMPLE", "us-west-2"
+    now = datetime.datetime(2026, 7, 29, 12, 0, 0,
+                            tzinfo=datetime.timezone.utc)
+    payload_sha = _hl.sha256(b"").hexdigest()
+
+    got = sigv4_headers(
+        "GET", url, access_key=access, secret_key=secret, region=region,
+        payload_sha256=payload_sha, now=now,
+    )["Authorization"]
+
+    # Independent derivation, straight from the SigV4 spec: the canonical
+    # URI is the absolute path exactly as it appears on the wire.
+    creq = "\n".join((
+        "GET",
+        "/" + quoted,
+        "",
+        f"host:bucket.example.com\nx-amz-content-sha256:{payload_sha}\n"
+        f"x-amz-date:20260729T120000Z\n",
+        "host;x-amz-content-sha256;x-amz-date",
+        payload_sha,
+    ))
+    scope = f"20260729/{region}/s3/aws4_request"
+    sts = "\n".join((
+        "AWS4-HMAC-SHA256", "20260729T120000Z", scope,
+        _hl.sha256(creq.encode()).hexdigest(),
+    ))
+    k = _hmac.new(b"AWS4" + secret.encode(), b"20260729",
+                  _hl.sha256).digest()
+    for step in (region, "s3", "aws4_request"):
+        k = _hmac.new(k, step.encode(), _hl.sha256).digest()
+    sig = _hmac.new(k, sts.encode(), _hl.sha256).hexdigest()
+    want = (
+        f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+        f"SignedHeaders=host;x-amz-content-sha256;x-amz-date, "
+        f"Signature={sig}"
+    )
+    assert got == want
+
+
 def test_s3_roundtrip_stat_list_and_missing():
     async def main():
         async with FakeS3() as s3:

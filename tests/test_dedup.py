@@ -75,13 +75,61 @@ def test_sidecar_persistence_rebuilds_index(tmp_path):
 def test_sketch_metadata_roundtrip():
     md = ChunkSketchMetadata(
         sketch=np.arange(128, dtype=np.uint32),
-        fps=np.array([1, 2, 3], dtype=np.uint32),
+        # Ledger fingerprints are 64-bit (first 8 digest bytes): 32-bit
+        # truncation hits birthday collisions past ~2^16 unique chunks.
+        fps=np.array([1, 2, 1 << 40], dtype=np.uint64),
         sizes=np.array([10, 20, 30], dtype=np.uint32),
     )
     back = ChunkSketchMetadata.deserialize(md.serialize())
+    assert back.fps.dtype == np.uint64
     assert np.array_equal(back.sketch, md.sketch)
     assert np.array_equal(back.fps, md.fps)
     assert np.array_equal(back.sizes, md.sizes)
+
+
+def test_stale_sidecar_version_recomputed(tmp_path):
+    """A v1 (32-bit-fps) sidecar is treated as absent and recomputed."""
+    import struct
+
+    from kraken_tpu.origin.dedup import _MAGIC
+
+    rng = np.random.default_rng(7)
+    a, _, _ = _near_dup_blobs(rng)
+    store = CAStore(str(tmp_path))
+    da = _store_blob(store, a)
+    v1 = struct.pack("<BBHI", _MAGIC, 1, 0, 0)
+    with open(store.cache_path(da) + "._md_chunksketch", "wb") as f:
+        f.write(v1)
+
+    index = DedupIndex(store, params=PARAMS)
+    assert index.load_existing() == 0  # stale sidecar not admitted
+    record = index.add_blob_sync(da)  # recomputed, not crashed
+    assert record.fps.dtype == np.uint64 and record.fps.size > 0
+    assert index.stats()["blobs"] == 1
+
+
+def test_remove_blob_restores_accounting(tmp_path):
+    rng = np.random.default_rng(5)
+    a, b, _ = _near_dup_blobs(rng)
+    store = CAStore(str(tmp_path))
+    index = DedupIndex(store, params=PARAMS)
+    da, db = _store_blob(store, a), _store_blob(store, b)
+    index.add_blob_sync(da)
+    stats_a_only = index.stats()
+    index.add_blob_sync(db)
+    assert index.duplicate_bytes > 0
+
+    assert index.remove_sync(db)
+    assert index.stats() == stats_a_only
+    assert all(h["digest"] != db.hex for h in index.similar(da, k=5))
+    assert not index.remove_sync(db)  # already gone
+
+    # Re-admission restores the exact pre-removal state.
+    index.add_blob_sync(db)
+    assert index.stats()["blobs"] == 2
+    assert index.duplicate_bytes > 0
+    hits = index.similar(da, k=5)
+    assert hits and hits[0]["digest"] == db.hex
 
 
 def test_add_blob_idempotent(tmp_path):
@@ -141,5 +189,27 @@ async def _origin_http_similar(tmp_path):
                 stats = await r.json()
             assert stats["blobs"] == 2
             assert stats["duplicate_bytes"] > 0
+
+            # Malformed query params are a client error, not a 500.
+            async with http.get(url, params={"k": "bogus"}) as r:
+                assert r.status == 400
+            async with http.get(url, params={"min_jaccard": "nan%"}) as r:
+                assert r.status == 400
+            async with http.get(url, params={"k": "0"}) as r:
+                assert r.status == 400
+
+            # DELETE drops the blob from the index, not just the store.
+            del_url = (
+                f"http://{node.addr}/namespace/test/blobs/{digests[1]}"
+            )
+            async with http.delete(del_url) as r:
+                assert r.status == 204
+            async with http.get(f"http://{node.addr}/dedup/stats") as r:
+                stats = await r.json()
+            assert stats["blobs"] == 1
+            assert stats["duplicate_bytes"] == 0
+            async with http.get(url) as r:
+                hits = (await r.json())["similar"]
+            assert all(h["digest"] != digests[1].hex for h in hits)
     finally:
         await node.stop()

@@ -109,3 +109,30 @@ def test_fingerprints_from_digests():
 def test_bands_must_divide():
     with pytest.raises(ValueError):
         LSHIndex(MinHasher(num_hashes=100), num_bands=32)
+
+
+def test_lsh_remove_and_compaction():
+    """Removal drops candidates immediately; churn (add+delete cycles)
+    compacts tombstones so memory stays O(live)."""
+    rng = np.random.default_rng(9)
+    mh = MinHasher(num_hashes=64)
+    index = LSHIndex(mh, num_bands=16)
+
+    keep = rng.integers(0, 1 << 32, size=500, dtype=np.uint64).astype(np.uint32)
+    index.add("keep", mh.sketch(keep))
+
+    # Churn well past the compaction threshold (64 tombstones).
+    for i in range(200):
+        s = rng.integers(0, 1 << 32, size=500, dtype=np.uint64).astype(np.uint32)
+        index.add(f"tmp{i}", mh.sketch(s))
+        assert index.remove(f"tmp{i}")
+    assert not index.remove("tmp0")  # already gone
+    assert len(index) == 1
+    assert len(index._keys) < 100  # tombstones were compacted away
+
+    # The survivor is still found, exactly, by both query paths.
+    q = mh.sketch(keep)
+    assert index.query(q, k=3)[0][0] == "keep"
+    assert index.query_brute(q, k=3)[0][0] == "keep"
+    # Removed keys never appear.
+    assert all(k == "keep" for k, _ in index.query(q, k=10))
