@@ -48,7 +48,7 @@ class AgentServer:
         except DigestError:
             raise web.HTTPBadRequest(text="malformed digest")
 
-    async def _download(self, req: web.Request) -> web.Response:
+    async def _download(self, req: web.Request) -> web.StreamResponse:
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         if not self.store.in_cache(d):
@@ -60,8 +60,11 @@ class AgentServer:
                 raise web.HTTPGatewayTimeout(text="download timed out")
             except Exception as e:
                 raise web.HTTPInternalServerError(text=f"download failed: {e}")
-        data = await asyncio.to_thread(self.store.read_cache_file, d)
-        return web.Response(body=data)
+        # sendfile from the cache: O(1) request memory for any blob size.
+        return web.FileResponse(
+            self.store.cache_path(d),
+            headers={"Content-Type": "application/octet-stream"},
+        )
 
     async def _stat(self, req: web.Request) -> web.Response:
         d = self._digest(req)

@@ -98,6 +98,7 @@ class OriginNode:
         piece_lengths: PieceLengthConfig | None = None,
         cleanup: CleanupConfig | None = None,
         dedup: bool = True,
+        hash_window_bytes: int = 256 * 1024 * 1024,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -107,7 +108,10 @@ class OriginNode:
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root)
         self.generator = Generator(
-            self.store, hasher=get_hasher(hasher), piece_lengths=piece_lengths
+            self.store,
+            hasher=get_hasher(hasher),
+            piece_lengths=piece_lengths,
+            window_bytes=hash_window_bytes,
         )
         self.dedup = (
             DedupIndex(self.store, hasher=get_hasher(hasher)) if dedup else None

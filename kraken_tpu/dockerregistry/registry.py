@@ -23,8 +23,13 @@ The namespace for blob storage is the repo name, as in the reference.
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import hashlib
 import json
+import os
+import tempfile
+import time
 import uuid as uuidlib
 
 from aiohttp import web
@@ -43,10 +48,40 @@ _MANIFEST_TYPES = (
 class RegistryServer:
     """v2 API; ``read_only`` distinguishes agent (pull) from proxy (push)."""
 
-    def __init__(self, transferer: ImageTransferer, read_only: bool = True):
+    def __init__(
+        self,
+        transferer: ImageTransferer,
+        read_only: bool = True,
+        upload_dir: str | None = None,
+        upload_ttl_seconds: float = 3600.0,
+    ):
         self.transferer = transferer
         self.read_only = read_only
-        self._uploads: dict[str, bytearray] = {}
+        # Push uploads spill to disk (an interrupted ``docker push`` must
+        # not pin blob-sized buffers in RAM for the process lifetime);
+        # sessions idle past the TTL are purged lazily on the next upload.
+        self._upload_dir = upload_dir or tempfile.mkdtemp(
+            prefix="kt-registry-upload-"
+        )
+        os.makedirs(self._upload_dir, exist_ok=True)
+        self._upload_ttl = upload_ttl_seconds
+        self._uploads: dict[str, float] = {}  # uid -> last-touched
+
+    def _upload_path(self, uid: str) -> str:
+        return os.path.join(self._upload_dir, uid)
+
+    def _purge_stale_uploads(self, now: float | None = None) -> int:
+        now = time.time() if now is None else now
+        stale = [
+            uid
+            for uid, touched in self._uploads.items()
+            if now - touched > self._upload_ttl
+        ]
+        for uid in stale:
+            del self._uploads[uid]
+            with contextlib.suppress(OSError):
+                os.unlink(self._upload_path(uid))
+        return len(stale)
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=1 << 30)
@@ -123,18 +158,46 @@ class RegistryServer:
             raise web.HTTPBadRequest(text="malformed digest")
         if req.method not in ("GET", "HEAD"):
             raise web.HTTPMethodNotAllowed(req.method, ["GET", "HEAD"])
+        if req.method == "HEAD":
+            try:
+                size = await self.transferer.stat(repo, d)
+            except Exception:
+                raise web.HTTPNotFound(text="blob unknown")
+            if size is None:
+                raise web.HTTPNotFound(text="blob unknown")
+            return web.Response(headers={
+                "Docker-Content-Digest": str(d),
+                "Content-Length": str(size),
+                "Content-Type": "application/octet-stream",
+            })
+        # GET streams from a local file (agent: the CAStore cache; proxy: a
+        # spooled temp) -- O(chunk) request memory for any layer size.
         try:
-            data = await self.transferer.download(repo, d)
+            path, is_temp = await self.transferer.download_path(repo, d)
         except Exception:
             raise web.HTTPNotFound(text="blob unknown")
         headers = {
             "Docker-Content-Digest": str(d),
-            "Content-Length": str(len(data)),
             "Content-Type": "application/octet-stream",
         }
-        if req.method == "HEAD":
-            return web.Response(headers=headers)
-        return web.Response(body=data, headers=headers)
+        if not is_temp:
+            return web.FileResponse(path, headers=headers)
+        try:
+            resp = web.StreamResponse(headers={
+                **headers, "Content-Length": str(os.path.getsize(path)),
+            })
+            await resp.prepare(req)
+            with open(path, "rb") as f:
+                while True:
+                    chunk = await asyncio.to_thread(f.read, 1 << 20)
+                    if not chunk:
+                        break
+                    await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(path)
 
     # -- push upload flow --------------------------------------------------
 
@@ -144,9 +207,12 @@ class RegistryServer:
 
     async def _start_upload(self, req: web.Request) -> web.Response:
         self._check_writable()
+        self._purge_stale_uploads()
         repo = req.match_info["repo"]
         uid = uuidlib.uuid4().hex
-        self._uploads[uid] = bytearray()
+        with open(self._upload_path(uid), "wb"):
+            pass
+        self._uploads[uid] = time.time()
         return web.Response(
             status=202,
             headers={
@@ -156,20 +222,39 @@ class RegistryServer:
             },
         )
 
+    async def _append_body(self, req: web.Request, uid: str) -> int:
+        """Stream the request body onto the upload's spool file; returns
+        the resulting total size. Touches the session as the stream
+        progresses (a multi-hour PATCH must not look idle), and refuses to
+        resurrect a session the TTL purge removed mid-stream."""
+        path = self._upload_path(uid)
+        self._uploads[uid] = time.time()
+        with open(path, "ab") as f:
+            i = 0
+            async for chunk in req.content.iter_chunked(1 << 20):
+                await asyncio.to_thread(f.write, chunk)
+                i += 1
+                if i % 64 == 0 and uid in self._uploads:
+                    self._uploads[uid] = time.time()
+        if uid not in self._uploads:
+            # Purged concurrently: the spool file was unlinked under us.
+            raise web.HTTPNotFound(text="upload expired")
+        self._uploads[uid] = time.time()
+        return os.path.getsize(path)
+
     async def _patch_upload(self, req: web.Request) -> web.Response:
         self._check_writable()
         uid = req.match_info["uid"]
-        buf = self._uploads.get(uid)
-        if buf is None:
+        if uid not in self._uploads:
             raise web.HTTPNotFound(text="upload unknown")
-        buf.extend(await req.read())
+        size = await self._append_body(req, uid)
         repo = req.match_info["repo"]
         return web.Response(
             status=202,
             headers={
                 "Location": f"/v2/{repo}/blobs/uploads/{uid}",
                 "Docker-Upload-UUID": uid,
-                "Range": f"0-{len(buf) - 1}",
+                "Range": f"0-{size - 1}",
             },
         )
 
@@ -177,18 +262,30 @@ class RegistryServer:
         self._check_writable()
         uid = req.match_info["uid"]
         repo = req.match_info["repo"]
-        buf = self._uploads.pop(uid, None)
-        if buf is None:
+        if uid not in self._uploads:
             raise web.HTTPNotFound(text="upload unknown")
-        buf.extend(await req.read())  # final chunk may ride the PUT
+        path = self._upload_path(uid)
         try:
-            d = Digest.parse(req.query["digest"])
-        except (KeyError, DigestError):
-            raise web.HTTPBadRequest(text="missing/malformed digest param")
-        actual = hashlib.sha256(buf).hexdigest()
-        if actual != d.hex:
-            raise web.HTTPBadRequest(text="digest mismatch")
-        await self.transferer.upload(repo, d, bytes(buf))
+            await self._append_body(req, uid)  # final chunk may ride the PUT
+            try:
+                d = Digest.parse(req.query["digest"])
+            except (KeyError, DigestError):
+                raise web.HTTPBadRequest(text="missing/malformed digest param")
+
+            def _file_sha() -> str:
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    while chunk := f.read(1 << 20):
+                        h.update(chunk)
+                return h.hexdigest()
+
+            if await asyncio.to_thread(_file_sha) != d.hex:
+                raise web.HTTPBadRequest(text="digest mismatch")
+            await self.transferer.upload_file(repo, d, path)
+        finally:
+            self._uploads.pop(uid, None)
+            with contextlib.suppress(OSError):
+                os.unlink(path)
         return web.Response(
             status=201, headers={"Docker-Content-Digest": str(d)}
         )

@@ -9,6 +9,9 @@ put + replicate) -- upstream path, unverified; SURVEY.md SS2.4.
 from __future__ import annotations
 
 import asyncio
+import os
+import tempfile
+import uuid as uuidlib
 from typing import Optional, Protocol
 
 from kraken_tpu.buildindex.server import TagClient
@@ -19,8 +22,17 @@ from kraken_tpu.store import CAStore
 
 
 class ImageTransferer(Protocol):
+    # ``download``/``upload`` buffer whole bodies: manifests only (KBs).
     async def download(self, namespace: str, d: Digest) -> bytes: ...
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None: ...
+    # Blob movement is file-based so the registry never holds a layer in RAM.
+    async def stat(self, namespace: str, d: Digest) -> Optional[int]: ...
+    async def download_path(
+        self, namespace: str, d: Digest
+    ) -> tuple[str, bool]: ...
+    async def upload_file(
+        self, namespace: str, d: Digest, path: str
+    ) -> None: ...
     async def get_tag(self, tag: str) -> Optional[Digest]: ...
     async def put_tag(self, tag: str, d: Digest) -> None: ...
     async def list_repo_tags(self, repo: str) -> list[str]: ...
@@ -35,12 +47,29 @@ class ReadOnlyTransferer:
         self.scheduler = scheduler
         self.tags = tags
 
-    async def download(self, namespace: str, d: Digest) -> bytes:
+    async def _ensure_local(self, namespace: str, d: Digest) -> None:
         if not self.store.in_cache(d):
             await self.scheduler.download(namespace, d)
+
+    async def download(self, namespace: str, d: Digest) -> bytes:
+        await self._ensure_local(namespace, d)
         return await asyncio.to_thread(self.store.read_cache_file, d)
 
+    async def stat(self, namespace: str, d: Digest) -> Optional[int]:
+        await self._ensure_local(namespace, d)
+        return self.store.cache_size(d)
+
+    async def download_path(
+        self, namespace: str, d: Digest
+    ) -> tuple[str, bool]:
+        """(cache path, is_temp=False): blobs stream straight off the CAStore."""
+        await self._ensure_local(namespace, d)
+        return self.store.cache_path(d), False
+
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
+        raise PermissionError("agent registry is read-only; push via the proxy")
+
+    async def upload_file(self, namespace: str, d: Digest, path: str) -> None:
         raise PermissionError("agent registry is read-only; push via the proxy")
 
     async def get_tag(self, tag: str) -> Optional[Digest]:
@@ -63,15 +92,35 @@ class ProxyTransferer:
     """Proxy-side: pushes fan blobs to the origin replica set and tags to
     the build-index (with cross-cluster replication)."""
 
-    def __init__(self, origins: ClusterClient, tags: TagClient):
+    def __init__(
+        self, origins: ClusterClient, tags: TagClient,
+        spool_dir: str | None = None,
+    ):
         self.origins = origins
         self.tags = tags
+        # Pass-through blob reads spool here (deleted after each response).
+        self._spool = spool_dir or tempfile.mkdtemp(prefix="kt-proxy-spool-")
 
     async def download(self, namespace: str, d: Digest) -> bytes:
         return await self.origins.download(namespace, d)
 
+    async def stat(self, namespace: str, d: Digest) -> Optional[int]:
+        info = await self.origins.stat(namespace, d)
+        return None if info is None else info.size
+
+    async def download_path(
+        self, namespace: str, d: Digest
+    ) -> tuple[str, bool]:
+        """(spooled temp path, is_temp=True): caller deletes after use."""
+        dest = os.path.join(self._spool, f"{d.hex}.{uuidlib.uuid4().hex}")
+        await self.origins.download_to_file(namespace, d, dest)
+        return dest, True
+
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
         await self.origins.upload(namespace, d, data)
+
+    async def upload_file(self, namespace: str, d: Digest, path: str) -> None:
+        await self.origins.upload_from_file(namespace, d, path)
 
     async def get_tag(self, tag: str) -> Optional[Digest]:
         try:

@@ -214,29 +214,64 @@ def _host_select_cuts(
     return cuts
 
 
+# Large blobs run the vector pass in fixed-size segments: the gear hash at
+# position i depends only on bytes [i-31, i], so segments with a 31-byte
+# left overlap produce bit-identical candidates to one whole-blob pass
+# while bounding device/host memory to O(segment) (the u32 intermediates
+# are 4-8x the byte count -- a whole-blob pass on a 10 GiB layer would
+# materialize tens of GB).
+_SEGMENT = 4 * 1024 * 1024
+
+
+def _candidate_indices(
+    arr: np.ndarray, n: int, params: CDCParams
+) -> tuple[np.ndarray, np.ndarray]:
+    """Global strict/loose candidate positions over ``arr[:n]``."""
+    if n <= _SEGMENT:
+        # Small blobs: bucket to the next power of two (bounded jit cache).
+        # Zero-pad bytes cannot create in-range candidates because only
+        # positions < n are kept.
+        padded = next_pow2(n)
+        if padded != n:
+            arr = np.concatenate([arr[:n], np.zeros(padded - n, dtype=np.uint8)])
+        strict, loose = _gear_candidates(
+            jnp.asarray(arr), params.mask_strict, params.mask_loose
+        )
+        return (
+            np.flatnonzero(np.asarray(strict)[:n]),
+            np.flatnonzero(np.asarray(loose)[:n]),
+        )
+    buf_len = _SEGMENT + _WINDOW - 1  # one fixed jit shape for every segment
+    strict_parts: list[np.ndarray] = []
+    loose_parts: list[np.ndarray] = []
+    buf = np.zeros(buf_len, dtype=np.uint8)
+    for s in range(0, n, _SEGMENT):
+        lo = max(0, s - (_WINDOW - 1))
+        seg = arr[lo : min(s + _SEGMENT, n)]
+        buf[: len(seg)] = seg
+        buf[len(seg) :] = 0
+        strict, loose = _gear_candidates(
+            jnp.asarray(buf), params.mask_strict, params.mask_loose
+        )
+        local = slice(s - lo, len(seg))  # valid, non-overlap positions
+        strict_parts.append(np.flatnonzero(np.asarray(strict)[local]) + s)
+        loose_parts.append(np.flatnonzero(np.asarray(loose)[local]) + s)
+    return np.concatenate(strict_parts), np.concatenate(loose_parts)
+
+
 def chunk(data: bytes | memoryview, params: CDCParams = CDCParams()) -> list[int]:
     """Content-defined chunk boundaries (end offsets, exclusive).
 
-    TPU vector pass for the hashes + host scan for the cut policy; exactly
-    equal to :func:`chunk_reference`.
+    TPU vector pass for the hashes (segmented: O(segment) memory for any
+    blob size) + host scan for the cut policy; exactly equal to
+    :func:`chunk_reference`.
     """
     view = memoryview(data)
     n = len(view)
     if n == 0:
         return []
-    # Bucket the length to the next power of two (zero-padded) so the jit
-    # cache stays small across arbitrary blob sizes; padding positions are
-    # dropped below. Zero-pad bytes cannot create in-range candidates
-    # because only positions < n are kept.
     arr = np.frombuffer(view, dtype=np.uint8)
-    padded = next_pow2(n)
-    if padded != n:
-        arr = np.concatenate([arr, np.zeros(padded - n, dtype=np.uint8)])
-    strict, loose = _gear_candidates(
-        jnp.asarray(arr), params.mask_strict, params.mask_loose
-    )
-    strict_idx = np.flatnonzero(np.asarray(strict)[:n])
-    loose_idx = np.flatnonzero(np.asarray(loose)[:n])
+    strict_idx, loose_idx = _candidate_indices(arr, n, params)
     return _host_select_cuts(strict_idx, loose_idx, n, params)
 
 

@@ -146,6 +146,10 @@ class LSHIndex:
         return len(self._keys) - len(self._removed)
 
     def add(self, key: Hashable, sketch: np.ndarray) -> None:
+        if key in self._key_idx:
+            # Re-adding replaces: tombstone the old row, or it would stay
+            # live in the band buckets forever (unremovable ghost).
+            self.remove(key)
         idx = len(self._keys)
         self._keys.append(key)
         self._sketches.append(np.asarray(sketch, dtype=np.uint32))

@@ -8,6 +8,7 @@ unverified; SURVEY.md SS2.4.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Callable, Optional
 
 from kraken_tpu.core.digest import Digest
@@ -48,6 +49,15 @@ class BlobClient:
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}")
         )
 
+    async def download_to_file(
+        self, namespace: str, d: Digest, dest_path: str
+    ) -> int:
+        """Stream the blob to ``dest_path`` -- O(chunk) memory, any size."""
+        return await self._http.get_to_file(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"),
+            dest_path,
+        )
+
     async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
         raw = await self._http.get(
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo")
@@ -57,16 +67,50 @@ class BlobClient:
     async def upload(self, namespace: str, d: Digest, data: bytes,
                      chunk_size: int = 16 * 1024 * 1024) -> None:
         """Chunked upload: start -> PATCH chunks -> commit."""
+        uid = await self._start_upload(namespace, d)
+        for off in range(0, len(data), chunk_size) or [0]:
+            await self._patch_chunk(
+                namespace, d, uid, off, data[off : off + chunk_size]
+            )
+        await self._commit_upload(namespace, d, uid)
+
+    async def upload_from_file(
+        self, namespace: str, d: Digest, path: str,
+        chunk_size: int = 16 * 1024 * 1024,
+    ) -> None:
+        """Chunked upload streamed from a local file -- O(chunk) memory
+        (replication and proxy pushes of arbitrarily large blobs)."""
+        uid = await self._start_upload(namespace, d)
+        off = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = await asyncio.to_thread(f.read, chunk_size)
+                if not chunk and off > 0:
+                    break
+                await self._patch_chunk(namespace, d, uid, off, chunk)
+                off += len(chunk)
+                if not chunk:
+                    break  # zero-length blob: one empty PATCH
+        await self._commit_upload(namespace, d, uid)
+
+    async def _start_upload(self, namespace: str, d: Digest) -> str:
         body = await self._http.post(
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads")
         )
-        uid = body.decode()
-        for off in range(0, len(data), chunk_size) or [0]:
-            await self._http.patch(
-                self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads/{uid}"),
-                data=data[off : off + chunk_size],
-                headers={"X-Upload-Offset": str(off)},
-            )
+        return body.decode()
+
+    async def _patch_chunk(
+        self, namespace: str, d: Digest, uid: str, offset: int, chunk: bytes
+    ) -> None:
+        await self._http.patch(
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads/{uid}"),
+            data=chunk,
+            headers={"X-Upload-Offset": str(offset)},
+        )
+
+    async def _commit_upload(
+        self, namespace: str, d: Digest, uid: str
+    ) -> None:
         await self._http.put(
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads/{uid}/commit"),
             ok_statuses=(200, 201, 204, 409),  # 409 = already cached: success
@@ -161,6 +205,21 @@ class ClusterClient:
             return out
         raise last or KeyError(str(d))
 
+    async def download_to_file(
+        self, namespace: str, d: Digest, dest_path: str
+    ) -> int:
+        last: Exception | None = None
+        for c in self.clients_for(d):
+            try:
+                out = await c.download_to_file(namespace, d, dest_path)
+            except Exception as e:
+                self._report(c, False)
+                last = e
+                continue
+            self._report(c, True)
+            return out
+        raise last or KeyError(str(d))
+
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
         """Upload to every replica; success if at least one accepted (the
         origins replicate among themselves on the repair path)."""
@@ -168,6 +227,21 @@ class ClusterClient:
         for c in self.clients_for(d):
             try:
                 await c.upload(namespace, d, data)
+                self._report(c, True)
+            except Exception as e:
+                self._report(c, False)
+                errs.append(e)
+        if len(errs) == len(self.clients_for(d)):
+            raise errs[0]
+
+    async def upload_from_file(
+        self, namespace: str, d: Digest, path: str
+    ) -> None:
+        """File-streamed :meth:`upload` -- same every-replica fan-out."""
+        errs = []
+        for c in self.clients_for(d):
+            try:
+                await c.upload_from_file(namespace, d, path)
                 self._report(c, True)
             except Exception as e:
                 self._report(c, False)

@@ -17,6 +17,8 @@ seen elsewhere / total bytes) is exact across restarts.
 from __future__ import annotations
 
 import asyncio
+import mmap
+import os
 import struct
 import threading
 
@@ -132,7 +134,9 @@ class DedupIndex:
 
     # -- ingest ------------------------------------------------------------
 
-    def _compute_record(self, data: bytes) -> ChunkSketchMetadata:
+    def _compute_record(
+        self, data: bytes | memoryview
+    ) -> ChunkSketchMetadata:
         spans = chunk_spans(data, self.params)
         view = memoryview(data)
         chunks = [view[s:e] for s, e in spans]
@@ -159,11 +163,26 @@ class DedupIndex:
         if one exists). Raises KeyError if the blob is not in cache."""
         with self._lock:
             if d.hex in self._indexed:
-                return self._load_record(d)
+                record = self._load_record(d)
+                if record is not None:
+                    return record
+                # Sidecar vanished under us (concurrent DELETE): fall
+                # through and recompute -- read_cache_file below raises
+                # KeyError if the blob itself is gone too.
         record = self._load_record(d)
         if record is None:
-            data = self.store.read_cache_file(d)  # KeyError if absent
-            record = self._compute_record(data)
+            # mmap, not read(): CDC + chunk hashing walk the blob
+            # sequentially, so the heap stays O(chunk) and the pages are
+            # reclaimable file cache even for multi-GiB layers.
+            with self.store.open_cache_file(d) as f:  # KeyError if absent
+                size = os.fstat(f.fileno()).st_size
+                if size == 0:
+                    record = self._compute_record(b"")
+                else:
+                    with mmap.mmap(
+                        f.fileno(), 0, access=mmap.ACCESS_READ
+                    ) as mm:
+                        record = self._compute_record(memoryview(mm))
             self.store.set_metadata(d, record)
         self._admit(d, record)
         return record

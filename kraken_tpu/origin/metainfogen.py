@@ -16,6 +16,8 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 
+import numpy as np
+
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.hasher import PieceHasher, get_hasher
 from kraken_tpu.core.metainfo import MetaInfo
@@ -68,25 +70,44 @@ class Generator:
         store: CAStore,
         hasher: PieceHasher | None = None,
         piece_lengths: PieceLengthConfig | None = None,
+        window_bytes: int = 256 * 1024 * 1024,
     ):
         self.store = store
         self.hasher = hasher or get_hasher("cpu")
         self.piece_lengths = piece_lengths or PieceLengthConfig()
+        # Blobs are hashed through a sliding window of whole pieces, so
+        # generation memory is O(window), not O(blob). The window is the
+        # hasher's batch: TPU origins with RAM to spare should raise it
+        # toward N_TILE * piece_length (4 GiB at 4 MiB pieces) for full
+        # dispatch occupancy; the default trades ~piece-batch occupancy
+        # for a bounded footprint.
+        self.window_bytes = window_bytes
 
     def get_cached(self, d: Digest) -> MetaInfo | None:
         md = self.store.get_metadata(d, TorrentMetaMetadata)
         return md.metainfo if md else None
 
     def generate_sync(self, d: Digest) -> MetaInfo:
-        """Hash every piece of blob ``d`` (one batched dispatch) and persist
-        the MetaInfo. Idempotent. Raises KeyError if the blob is absent."""
+        """Hash every piece of blob ``d`` (windowed batched dispatches) and
+        persist the MetaInfo. Idempotent. Raises KeyError if the blob is
+        absent."""
         cached = self.get_cached(d)
         if cached is not None:
             return cached
-        data = self.store.read_cache_file(d)  # KeyError if absent
-        piece_length = self.piece_lengths.piece_length(len(data))
-        hashes = self.hasher.hash_pieces(data, piece_length)
-        metainfo = MetaInfo(d, len(data), piece_length, hashes.tobytes())
+        size = self.store.cache_size(d)  # KeyError if absent
+        piece_length = self.piece_lengths.piece_length(size)
+        window = max(piece_length, self.window_bytes // piece_length * piece_length)
+        parts = []
+        with self.store.open_cache_file(d) as f:
+            while True:
+                data = f.read(window)
+                if not data and parts:
+                    break
+                parts.append(self.hasher.hash_pieces(data, piece_length))
+                if len(data) < window:
+                    break
+        hashes = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        metainfo = MetaInfo(d, size, piece_length, hashes.tobytes())
         self.store.set_metadata(d, TorrentMetaMetadata(metainfo))
         return metainfo
 

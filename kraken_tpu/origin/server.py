@@ -105,12 +105,23 @@ class OriginServer:
 
     async def _patch_upload(self, req: web.Request) -> web.Response:
         uid = req.match_info["uid"]
-        offset = int(req.headers.get("X-Upload-Offset", "0"))
-        data = await req.read()
         try:
-            await asyncio.to_thread(self.store.write_upload_chunk, uid, offset, data)
+            offset = int(req.headers.get("X-Upload-Offset", "0"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="malformed X-Upload-Offset")
+        # Stream the request body straight into the upload file (one held
+        # handle): one PATCH may carry an arbitrarily large body without
+        # O(body) RAM or per-chunk reopen syscalls.
+        try:
+            f = self.store.open_upload_file(uid)
         except UploadNotFoundError:
             raise web.HTTPNotFound(text="unknown upload")
+        try:
+            f.seek(offset)
+            async for chunk in req.content.iter_chunked(1 << 20):
+                await asyncio.to_thread(f.write, chunk)
+        finally:
+            f.close()
         return web.Response(status=204)
 
     async def _commit(self, req: web.Request) -> web.Response:
@@ -182,8 +193,9 @@ class OriginServer:
         try:
             if await peer.stat(ns, d) is not None:
                 return  # replica already has it
-            data = await asyncio.to_thread(self.store.read_cache_file, d)
-            await peer.upload(ns, d, data)
+            # Stream from disk: replication of a 10 GiB layer must not
+            # hold the layer in RAM.
+            await peer.upload_from_file(ns, d, self.store.cache_path(d))
         finally:
             await peer.close()
 
@@ -208,12 +220,15 @@ class OriginServer:
             raise web.HTTPNotFound(text="blob not found")
         return web.json_response({"size": size})
 
-    async def _download(self, req: web.Request) -> web.Response:
+    async def _download(self, req: web.Request) -> web.StreamResponse:
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
-        data = await asyncio.to_thread(self.store.read_cache_file, d)
-        return web.Response(body=data)
+        # sendfile from the cache: O(1) request memory for any blob size.
+        return web.FileResponse(
+            self.store.cache_path(d),
+            headers={"Content-Type": "application/octet-stream"},
+        )
 
     async def _metainfo(self, req: web.Request) -> web.Response:
         ns = urllib.parse.unquote(req.match_info["ns"])

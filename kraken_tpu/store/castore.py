@@ -94,6 +94,14 @@ class CAStore:
             f.seek(offset)
             f.write(data)
 
+    def open_upload_file(self, uid: str) -> BinaryIO:
+        """Writable handle on an in-progress upload (callers that stream
+        many chunks hold one handle instead of re-opening per chunk)."""
+        path = self._upload_path(uid)
+        if not os.path.exists(path):
+            raise UploadNotFoundError(uid)
+        return open(path, "r+b")
+
     def upload_size(self, uid: str) -> int:
         path = self._upload_path(uid)
         if not os.path.exists(path):

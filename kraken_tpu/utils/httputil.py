@@ -8,6 +8,8 @@ unverified; SURVEY.md SS2.5. Built on aiohttp.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import os
 from typing import Any
 
 import aiohttp
@@ -133,6 +135,51 @@ class HTTPClient:
                     last_err = err
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
                 last_err = e
+            if attempt < self._retries:
+                await asyncio.sleep(self._backoff.delay(attempt))
+        assert last_err is not None
+        raise last_err
+
+    async def get_to_file(
+        self,
+        url: str,
+        dest_path: str,
+        *,
+        headers: dict | None = None,
+        chunk_size: int = 1 << 20,
+        retry_5xx: bool = True,
+    ) -> int:
+        """Stream a GET body to ``dest_path`` (written via a temp file,
+        atomically renamed) without buffering it in RAM; returns the byte
+        count. Whole-transfer retries, same policy as :meth:`request`."""
+        last_err: Exception | None = None
+        tmp = f"{dest_path}.http{os.getpid()}.tmp"
+        for attempt in range(self._retries + 1):
+            try:
+                session = await self._get_session()
+                async with session.get(url, headers=headers) as resp:
+                    if resp.status != 200:
+                        body = await resp.read()
+                        err = HTTPError("GET", url, resp.status, body)
+                        if resp.status < 500 or not retry_5xx:
+                            raise err
+                        last_err = err
+                    else:
+                        size = 0
+                        with open(tmp, "wb") as f:
+                            async for chunk in resp.content.iter_chunked(
+                                chunk_size
+                            ):
+                                await asyncio.to_thread(f.write, chunk)
+                                size += len(chunk)
+                        os.replace(tmp, dest_path)
+                        return size
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError,
+                    aiohttp.ClientPayloadError) as e:
+                last_err = e
+            finally:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp)
             if attempt < self._retries:
                 await asyncio.sleep(self._backoff.delay(attempt))
         assert last_err is not None

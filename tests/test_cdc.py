@@ -86,3 +86,19 @@ def test_param_validation():
         CDCParams(min_size=1 << 20, avg_size=1 << 16, max_size=1 << 22)
     with pytest.raises(ValueError):
         CDCParams(min_size=16, avg_size=64, max_size=256)  # < window
+
+
+def test_segmented_pass_matches_whole_blob(monkeypatch):
+    """Blobs larger than the segment produce bit-identical cuts to the
+    whole-blob pass AND the sequential reference (the 31-byte overlap
+    carries the full gear history across segment boundaries)."""
+    import kraken_tpu.ops.cdc as cdc
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=1_000_000, dtype=np.uint8).tobytes()
+
+    whole = chunk(data, P)  # n < _SEGMENT: single-pass path
+    monkeypatch.setattr(cdc, "_SEGMENT", 128 * 1024)
+    segmented = chunk(data, P)
+    assert segmented == whole
+    assert segmented == chunk_reference(data, P)
