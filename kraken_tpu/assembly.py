@@ -30,6 +30,8 @@ from kraken_tpu.origin.server import OriginServer
 from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement.healthcheck import ActiveMonitor
+from kraken_tpu.utils.httputil import HTTPClient
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -56,7 +58,8 @@ class TrackerNode:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  origin_cluster: ClusterClient | None = None,
                  announce_interval_seconds: float = 3.0,
-                 peer_ttl_seconds: float = 30.0):
+                 peer_ttl_seconds: float = 30.0,
+                 ring_refresh_seconds: float = 5.0):
         self.host = host
         self.port = port
         self.server = TrackerServer(
@@ -64,7 +67,9 @@ class TrackerNode:
             origin_cluster=origin_cluster,
             announce_interval_seconds=announce_interval_seconds,
         )
+        self.ring_refresh = ring_refresh_seconds
         self._runner: Optional[web.AppRunner] = None
+        self._refresh_task: Optional[asyncio.Task] = None
 
     @property
     def addr(self) -> str:
@@ -74,8 +79,24 @@ class TrackerNode:
         self._runner, self.port = await _serve(
             self.server.make_app(), self.host, self.port
         )
+        # The cluster's passive health filter only takes effect when the
+        # ring re-resolves; refresh it periodically (resolved each tick:
+        # herd harnesses attach origin_cluster after start).
+        self._refresh_task = asyncio.create_task(self._refresh_loop())
+
+    async def _refresh_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.ring_refresh)
+            cluster = self.server.origin_cluster
+            try:
+                if cluster is not None:
+                    cluster.ring.refresh()
+            except Exception:
+                pass
 
     async def stop(self) -> None:
+        if self._refresh_task:
+            self._refresh_task.cancel()
         if self._runner:
             await self._runner.cleanup()
 
@@ -99,6 +120,8 @@ class OriginNode:
         cleanup: CleanupConfig | None = None,
         dedup: bool = True,
         hash_window_bytes: int = 256 * 1024 * 1024,
+        health_interval_seconds: float = 5.0,
+        health_fail_threshold: int = 3,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -137,10 +160,16 @@ class OriginNode:
             if cleanup
             else None
         )
+        self.health_interval = health_interval_seconds
+        self.health_fail_threshold = health_fail_threshold
+        self.monitor: Optional[ActiveMonitor] = None
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[OriginServer] = None
         self._runner: Optional[web.AppRunner] = None
         self._tracker_client: Optional[TrackerClient] = None
+        self._health_http: Optional[HTTPClient] = None
+        self._health_task: Optional[asyncio.Task] = None
+        self._repair_tasks: set[asyncio.Task] = set()
 
     @property
     def addr(self) -> str:
@@ -202,8 +231,56 @@ class OriginNode:
         # Rebuild the dedup index from persisted sketch sidecars.
         if self.dedup is not None:
             await asyncio.to_thread(self.dedup.load_existing)
+        # Failure plane (SURVEY.md SS5): probe ring peers, refresh
+        # membership, and repair (re-replicate) on every change.
+        if self.ring is not None:
+            self._health_http = HTTPClient(timeout_seconds=2.0, retries=0)
+            self.monitor = ActiveMonitor(
+                probe=self._probe_origin,
+                fail_threshold=self.health_fail_threshold,
+            )
+            if not self.ring.has_health_filter:
+                self.ring.set_health_filter(self.monitor.filter)
+            self.ring.on_change(self._on_ring_change)
+            self._health_task = asyncio.create_task(self._health_loop())
+
+    async def _probe_origin(self, host: str) -> bool:
+        try:
+            await self._health_http.get(
+                f"http://{host}/health", retry_5xx=False
+            )
+            return True
+        except Exception:
+            return False
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval)
+            try:
+                peers = [
+                    h for h in self.ring.all_hosts() if h != self.self_addr
+                ]
+                await self.monitor.check_all(peers)
+                self.ring.refresh()  # fires _on_ring_change on membership change
+            except Exception:
+                pass
+
+    def _on_ring_change(self, _hosts: list[str]) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # construction-time refresh: no loop, nothing to repair yet
+        if self.server is None:
+            return
+        t = loop.create_task(self.server.repair())
+        self._repair_tasks.add(t)
+        t.add_done_callback(self._repair_tasks.discard)
 
     async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        for t in list(self._repair_tasks):
+            t.cancel()
         self.retry.stop()
         if self.scheduler:
             await self.scheduler.stop()
@@ -211,6 +288,8 @@ class OriginNode:
             await self._runner.cleanup()
         if self._tracker_client:
             await self._tracker_client.close()
+        if self._health_http:
+            await self._health_http.close()
 
 
 class BuildIndexNode:

@@ -24,6 +24,7 @@ from kraken_tpu.backend import Manager as BackendManager
 from kraken_tpu.configutil import load_config
 from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement.healthcheck import PassiveFilter
 
 
 async def _run_until_signal(node, describe: dict) -> None:
@@ -84,9 +85,14 @@ def main(argv: list[str] | None = None) -> None:
         origin_addrs = [a for a in (origins or "").split(",") if a]
         cluster = None
         if origin_addrs:
+            # Passive health: request failures drop an origin from the
+            # ring on the next refresh (tracker's periodic refresh loop).
+            health = PassiveFilter()
             cluster = ClusterClient(
                 Ring(HostList(static=origin_addrs),
-                     max_replica=cfg.get("max_replica", 3))
+                     max_replica=cfg.get("max_replica", 3),
+                     health_filter=health.filter),
+                health=health,
             )
         node = TrackerNode(
             host=host, port=port, origin_cluster=cluster,

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
-import hashlib
 import json
 import os
 import tempfile
@@ -254,7 +253,7 @@ class RegistryServer:
             headers={
                 "Location": f"/v2/{repo}/blobs/uploads/{uid}",
                 "Docker-Upload-UUID": uid,
-                "Range": f"0-{size - 1}",
+                "Range": f"0-{max(size - 1, 0)}",
             },
         )
 
@@ -272,14 +271,11 @@ class RegistryServer:
             except (KeyError, DigestError):
                 raise web.HTTPBadRequest(text="missing/malformed digest param")
 
-            def _file_sha() -> str:
-                h = hashlib.sha256()
+            def _file_digest() -> Digest:
                 with open(path, "rb") as f:
-                    while chunk := f.read(1 << 20):
-                        h.update(chunk)
-                return h.hexdigest()
+                    return Digest.from_reader(f)
 
-            if await asyncio.to_thread(_file_sha) != d.hex:
+            if await asyncio.to_thread(_file_digest) != d:
                 raise web.HTTPBadRequest(text="digest mismatch")
             await self.transferer.upload_file(repo, d, path)
         finally:

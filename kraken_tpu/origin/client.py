@@ -19,6 +19,8 @@ from urllib.parse import quote
 
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError
 
+_RAISE = object()  # _try_each sentinel: no default, raise on exhaustion
+
 
 class BlobClient:
     """HTTP client for one origin."""
@@ -164,90 +166,71 @@ class ClusterClient:
         if self.health is not None:
             (self.health.succeeded if ok else self.health.failed)(c.addr)
 
-    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+    async def _try_each(self, d: Digest, op, *, default=_RAISE):
+        """Read policy: try each replica in ring order, return the first
+        success; feed every outcome to the health filter. With all replicas
+        failed, raise the last error (or return ``default`` if given and no
+        replica errored -- i.e. the ring was empty)."""
         last: Exception | None = None
         for c in self.clients_for(d):
             try:
-                out = await c.stat(namespace, d)
+                out = await op(c)
             except Exception as e:
                 self._report(c, False)
                 last = e
                 continue
             self._report(c, True)
             return out
-        if last:
+        if last is not None:
             raise last
-        return None
+        if default is not _RAISE:
+            return default
+        raise KeyError(str(d))
+
+    async def _fan_out(self, d: Digest, op) -> None:
+        """Write policy: send to EVERY replica (as the reference's proxy
+        upload does, so any one can serve and replicate onward); success if
+        at least one accepted. The replica set is captured once -- a ring
+        refresh mid-fan-out must not turn total failure into silence."""
+        clients = self.clients_for(d)
+        errs = []
+        for c in clients:
+            try:
+                await op(c)
+                self._report(c, True)
+            except Exception as e:
+                self._report(c, False)
+                errs.append(e)
+        if clients and len(errs) == len(clients):
+            raise errs[0]
+
+    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+        return await self._try_each(
+            d, lambda c: c.stat(namespace, d), default=None
+        )
 
     async def download(self, namespace: str, d: Digest) -> bytes:
-        last: Exception | None = None
-        for c in self.clients_for(d):
-            try:
-                out = await c.download(namespace, d)
-            except Exception as e:
-                self._report(c, False)
-                last = e
-                continue
-            self._report(c, True)
-            return out
-        raise last or KeyError(str(d))
+        return await self._try_each(d, lambda c: c.download(namespace, d))
 
     async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
-        last: Exception | None = None
-        for c in self.clients_for(d):
-            try:
-                out = await c.get_metainfo(namespace, d)
-            except Exception as e:
-                self._report(c, False)
-                last = e
-                continue
-            self._report(c, True)
-            return out
-        raise last or KeyError(str(d))
+        return await self._try_each(d, lambda c: c.get_metainfo(namespace, d))
 
     async def download_to_file(
         self, namespace: str, d: Digest, dest_path: str
     ) -> int:
-        last: Exception | None = None
-        for c in self.clients_for(d):
-            try:
-                out = await c.download_to_file(namespace, d, dest_path)
-            except Exception as e:
-                self._report(c, False)
-                last = e
-                continue
-            self._report(c, True)
-            return out
-        raise last or KeyError(str(d))
+        return await self._try_each(
+            d, lambda c: c.download_to_file(namespace, d, dest_path)
+        )
 
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
-        """Upload to every replica; success if at least one accepted (the
-        origins replicate among themselves on the repair path)."""
-        errs = []
-        for c in self.clients_for(d):
-            try:
-                await c.upload(namespace, d, data)
-                self._report(c, True)
-            except Exception as e:
-                self._report(c, False)
-                errs.append(e)
-        if len(errs) == len(self.clients_for(d)):
-            raise errs[0]
+        await self._fan_out(d, lambda c: c.upload(namespace, d, data))
 
     async def upload_from_file(
         self, namespace: str, d: Digest, path: str
     ) -> None:
-        """File-streamed :meth:`upload` -- same every-replica fan-out."""
-        errs = []
-        for c in self.clients_for(d):
-            try:
-                await c.upload_from_file(namespace, d, path)
-                self._report(c, True)
-            except Exception as e:
-                self._report(c, False)
-                errs.append(e)
-        if len(errs) == len(self.clients_for(d)):
-            raise errs[0]
+        await self._fan_out(
+            d, lambda c: c.upload_from_file(namespace, d, path)
+        )
 
     async def close(self) -> None:
         for c in self._clients.values():

@@ -177,13 +177,69 @@ class OriginServer:
             return
         for addr in self.ring.locations(d):
             if addr != self.self_addr:
-                self.retry.add(
-                    Task(
-                        kind=REPLICATE_KIND,
-                        key=f"{addr}:{ns}:{d.hex}",
-                        payload={"addr": addr, "namespace": ns, "digest": d.hex},
-                    )
-                )
+                self._add_replication_task(addr, ns, d)
+
+    def _add_replication_task(self, addr: str, ns: str, d: Digest) -> bool:
+        assert self.retry is not None
+        return self.retry.add(
+            Task(
+                kind=REPLICATE_KIND,
+                key=f"{addr}:{ns}:{d.hex}",
+                payload={"addr": addr, "namespace": ns, "digest": d.hex},
+            )
+        )
+
+    def _namespace_for(self, d: Digest) -> str:
+        """The namespace a blob was committed under (NamespaceMetadata
+        sidecar, written at commit) -- the repair path runs long after the
+        upload request is gone."""
+        md = self.store.get_metadata(d, NamespaceMetadata)
+        return md.namespace if md is not None else "default"
+
+    async def repair(self) -> int:
+        """Re-replicate every local blob to its *current* ring owners.
+
+        Called on ring membership change (SURVEY.md SS5 failure detection:
+        an origin death must re-place its blobs onto survivors; a revival
+        must re-fill the returning host). Idempotent and cheap to re-run:
+        tasks dedup on (kind, key) and the executor stats the peer before
+        sending bytes. Returns the number of tasks enqueued.
+
+        The disk scan runs off-loop and the enqueue is batched (one sqlite
+        transaction per slice) so a ring change on a 100k-blob origin does
+        not stall request handling."""
+        if self.ring is None or self.retry is None or not self.self_addr:
+            return 0
+
+        def _plan() -> list[Task]:
+            tasks: list[Task] = []
+            for d in self.store.list_cache_digests():
+                try:
+                    locations = self.ring.locations(d)
+                except RuntimeError:
+                    break  # empty ring: nothing sane to do
+                ns = self._namespace_for(d)
+                # If we still own the blob, fill the other owners; if
+                # ownership moved entirely (we shrank out of the replica
+                # set), hand off to all of them -- cleanup evicts our copy
+                # later.
+                for addr in locations:
+                    if addr != self.self_addr:
+                        tasks.append(Task(
+                            kind=REPLICATE_KIND,
+                            key=f"{addr}:{ns}:{d.hex}",
+                            payload={
+                                "addr": addr, "namespace": ns, "digest": d.hex,
+                            },
+                        ))
+            return tasks
+
+        tasks = await asyncio.to_thread(_plan)
+        enqueued = 0
+        for i in range(0, len(tasks), 500):
+            enqueued += self.retry.add_many(tasks[i : i + 500])
+            await asyncio.sleep(0)  # yield between transactions
+        return enqueued
 
     async def _execute_replication(self, task: Task) -> None:
         d = Digest.from_hex(task.payload["digest"])

@@ -64,6 +64,24 @@ class TaskStore:
         except sqlite3.IntegrityError:
             return False
 
+    def add_many(self, tasks: list[Task]) -> int:
+        """Bulk insert in ONE transaction (one fsync, not len(tasks));
+        existing (kind, key) rows are skipped. Returns rows inserted.
+        Bulk enqueuers (the repair path) would otherwise stall the caller
+        on a commit per task."""
+        before = self._db.total_changes
+        self._db.executemany(
+            "INSERT OR IGNORE INTO tasks"
+            " (kind, key, payload, attempts, not_before)"
+            " VALUES (?, ?, ?, ?, ?)",
+            [
+                (t.kind, t.key, json.dumps(t.payload), t.attempts, t.not_before)
+                for t in tasks
+            ],
+        )
+        self._db.commit()
+        return self._db.total_changes - before
+
     def ready(self, now: float, limit: int = 100) -> list[Task]:
         rows = self._db.execute(
             "SELECT id, kind, key, payload, attempts, not_before FROM tasks"
@@ -121,6 +139,9 @@ class Manager:
 
     def add(self, task: Task) -> bool:
         return self.store.add(task)
+
+    def add_many(self, tasks: list[Task]) -> int:
+        return self.store.add_many(tasks)
 
     async def run_once(self, now: float | None = None) -> int:
         """One poll cycle; returns number of tasks that succeeded."""

@@ -50,6 +50,17 @@ class Ring:
     def on_change(self, fn: Callable[[list[str]], None]) -> None:
         self._listeners.append(fn)
 
+    def set_health_filter(
+        self, fn: Callable[[Iterable[str]], list[str]] | None
+    ) -> None:
+        """Attach/replace the health filter (nodes that own a monitor wire
+        it here after construction)."""
+        self._health_filter = fn
+
+    @property
+    def has_health_filter(self) -> bool:
+        return self._health_filter is not None
+
     def refresh(self) -> bool:
         """Re-resolve + re-filter membership; returns True if it changed."""
         hosts = self._hosts.resolve()

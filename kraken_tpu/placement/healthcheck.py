@@ -9,6 +9,7 @@ their blobs re-place onto the survivors.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Awaitable, Callable, Iterable
 
@@ -65,11 +66,19 @@ class ActiveMonitor:
         self._state: dict[str, tuple[bool, int]] = {}
 
     async def check_all(self, hosts: Iterable[str]) -> None:
-        for h in hosts:
+        hosts = list(hosts)
+
+        async def probe(h: str) -> bool:
             try:
-                ok = await self._probe(h)
+                return await self._probe(h)
             except Exception:
-                ok = False
+                return False
+
+        # Concurrent probes: detection latency is one probe timeout, not
+        # cluster_size timeouts (serial probing of a large ring with dead
+        # peers would exceed the check interval itself).
+        results = await asyncio.gather(*(probe(h) for h in hosts))
+        for h, ok in zip(hosts, results):
             healthy, contrary = self._state.get(h, (True, 0))
             if ok == healthy:
                 contrary = 0
