@@ -27,10 +27,12 @@ from kraken_tpu.store import CAStore
 
 class AgentServer:
     def __init__(self, store: CAStore, scheduler: Scheduler,
-                 download_timeout_seconds: float = 300.0):
+                 download_timeout_seconds: float = 300.0,
+                 cleanup=None):  # store.cleanup.CleanupManager (optional)
         self.store = store
         self.scheduler = scheduler
         self.download_timeout = download_timeout_seconds
+        self.cleanup = cleanup
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -60,6 +62,8 @@ class AgentServer:
                 raise web.HTTPGatewayTimeout(text="download timed out")
             except Exception as e:
                 raise web.HTTPInternalServerError(text=f"download failed: {e}")
+        if self.cleanup is not None:
+            self.cleanup.touch(d)  # feed the eviction clock (throttled)
         # sendfile from the cache: O(1) request memory for any blob size.
         return web.FileResponse(
             self.store.cache_path(d),

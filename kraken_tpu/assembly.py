@@ -45,6 +45,16 @@ from kraken_tpu.tracker.peerstore import InMemoryPeerStore
 from kraken_tpu.tracker.server import TrackerServer
 
 
+async def _cleanup_loop(manager: CleanupManager) -> None:
+    """Periodic eviction sweep for a node's CAStore."""
+    while True:
+        await asyncio.sleep(manager.config.interval_seconds)
+        try:
+            await asyncio.to_thread(manager.run_once)
+        except Exception:
+            pass
+
+
 async def _serve(app: web.Application, host: str, port: int):
     runner = web.AppRunner(app)
     await runner.setup()
@@ -169,6 +179,7 @@ class OriginNode:
         self._tracker_client: Optional[TrackerClient] = None
         self._health_http: Optional[HTTPClient] = None
         self._health_task: Optional[asyncio.Task] = None
+        self._cleanup_task: Optional[asyncio.Task] = None
         self._repair_tasks: set[asyncio.Task] = set()
 
     @property
@@ -215,6 +226,7 @@ class OriginNode:
             self_addr=self.self_addr,
             scheduler=self.scheduler,
             dedup=self.dedup,
+            cleanup=self.cleanup,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port
@@ -231,6 +243,12 @@ class OriginNode:
         # Rebuild the dedup index from persisted sketch sidecars.
         if self.dedup is not None:
             await asyncio.to_thread(self.dedup.load_existing)
+        # Eviction: periodic TTI + watermark sweeps (lib/store/cleanup.go
+        # behavior -- upstream path, unverified; SURVEY.md SS2.3).
+        if self.cleanup is not None:
+            self._cleanup_task = asyncio.create_task(
+                _cleanup_loop(self.cleanup)
+            )
         # Failure plane (SURVEY.md SS5): probe ring peers, refresh
         # membership, and repair (re-replicate) on every change.
         if self.ring is not None:
@@ -279,6 +297,8 @@ class OriginNode:
     async def stop(self) -> None:
         if self._health_task:
             self._health_task.cancel()
+        if self._cleanup_task:
+            self._cleanup_task.cancel()
         for t in list(self._repair_tasks):
             t.cancel()
         self.retry.stop()
@@ -407,6 +427,7 @@ class AgentNode:
         self._registry_runner: Optional[web.AppRunner] = None
         self._tracker_client: Optional[TrackerClient] = None
         self._tag_client = None
+        self._cleanup_task: Optional[asyncio.Task] = None
 
     @property
     def addr(self) -> str:
@@ -431,10 +452,16 @@ class AgentNode:
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port
-        self.server = AgentServer(self.store, self.scheduler)
+        self.server = AgentServer(
+            self.store, self.scheduler, cleanup=self.cleanup
+        )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port
         )
+        if self.cleanup is not None:
+            self._cleanup_task = asyncio.create_task(
+                _cleanup_loop(self.cleanup)
+            )
         if self.build_index_addr:
             from kraken_tpu.buildindex.server import TagClient
             from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -450,6 +477,8 @@ class AgentNode:
             )
 
     async def stop(self) -> None:
+        if self._cleanup_task:
+            self._cleanup_task.cancel()
         if self.scheduler:
             await self.scheduler.stop()
         if self._runner:

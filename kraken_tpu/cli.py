@@ -25,6 +25,7 @@ from kraken_tpu.configutil import load_config
 from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import PassiveFilter
+from kraken_tpu.store.cleanup import CleanupConfig
 
 
 async def _run_until_signal(node, describe: dict) -> None:
@@ -63,6 +64,10 @@ def main(argv: list[str] | None = None) -> None:
     p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
     p_origin.add_argument("--cluster", default=None,
                           help="comma-separated origin http addrs (incl. self)")
+    p_origin.add_argument("--self-addr", default=None,
+                          help="this origin's address AS IT APPEARS in"
+                               " --cluster (required with --cluster; health"
+                               " probes and repair must exclude self)")
 
     p_agent = sub.add_parser("agent")
     _common(p_agent)
@@ -76,6 +81,11 @@ def main(argv: list[str] | None = None) -> None:
 
     def pick(flag, key, default=None):
         return flag if flag is not None else cfg.get(key, default)
+
+    # YAML: cleanup: {tti_seconds, high_watermark_bytes,
+    # low_watermark_bytes, interval_seconds} -- absent = eviction off.
+    cleanup_cfg = cfg.get("cleanup")
+    cleanup = CleanupConfig(**cleanup_cfg) if cleanup_cfg else None
 
     host = pick(args.host, "host", "127.0.0.1")
     port = pick(args.port, "port", 0)
@@ -113,6 +123,17 @@ def main(argv: list[str] | None = None) -> None:
             if cluster_addrs
             else None
         )
+        self_addr = pick(args.self_addr, "self_addr", "")
+        if cluster_addrs and not self_addr:
+            # Fall back to host:port, which matches --cluster only when the
+            # port is fixed and the host spelling agrees.
+            self_addr = f"{host}:{port}" if port else ""
+            if self_addr not in cluster_addrs:
+                parser.error(
+                    "--cluster requires --self-addr (or a fixed --port whose"
+                    " host:port appears verbatim in --cluster): without it"
+                    " the origin would probe and replicate to itself"
+                )
         node = OriginNode(
             store_root=pick(args.store, "store", "./origin-store"),
             tracker_addr=pick(args.tracker, "tracker", ""),
@@ -122,6 +143,8 @@ def main(argv: list[str] | None = None) -> None:
             hasher=pick(args.hasher, "hasher", "cpu"),
             backends=backends,
             ring=ring,
+            self_addr=self_addr,
+            cleanup=cleanup,
         )
         asyncio.run(_run_until_signal(node, {"component": "origin"}))
 
@@ -133,6 +156,7 @@ def main(argv: list[str] | None = None) -> None:
             http_port=port,
             p2p_port=pick(args.p2p_port, "p2p_port", 0),
             hasher=pick(args.hasher, "hasher", "cpu"),
+            cleanup=cleanup,
         )
         asyncio.run(_run_until_signal(node, {"component": "agent"}))
 

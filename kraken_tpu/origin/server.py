@@ -46,6 +46,17 @@ from kraken_tpu.store.metadata import NamespaceMetadata
 REPLICATE_KIND = "replicate"
 
 
+def _replication_task(addr: str, ns: str, d: Digest) -> Task:
+    """The one replication Task shape. The upload path and the repair path
+    MUST build identical (kind, key) pairs or the dedup that makes repair
+    idempotent silently breaks."""
+    return Task(
+        kind=REPLICATE_KIND,
+        key=f"{addr}:{ns}:{d.hex}",
+        payload={"addr": addr, "namespace": ns, "digest": d.hex},
+    )
+
+
 class OriginServer:
     """HTTP facade over the origin's storage plane."""
 
@@ -60,6 +71,7 @@ class OriginServer:
         self_addr: str = "",
         scheduler=None,  # p2p Scheduler seeding our blobs (optional)
         dedup=None,  # origin.dedup.DedupIndex (optional)
+        cleanup=None,  # store.cleanup.CleanupManager (optional)
     ):
         self.store = store
         self.generator = generator
@@ -70,6 +82,7 @@ class OriginServer:
         self.self_addr = self_addr
         self.scheduler = scheduler
         self.dedup = dedup
+        self.cleanup = cleanup
         self._dedup_tasks: set[asyncio.Task] = set()
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
@@ -181,13 +194,7 @@ class OriginServer:
 
     def _add_replication_task(self, addr: str, ns: str, d: Digest) -> bool:
         assert self.retry is not None
-        return self.retry.add(
-            Task(
-                kind=REPLICATE_KIND,
-                key=f"{addr}:{ns}:{d.hex}",
-                payload={"addr": addr, "namespace": ns, "digest": d.hex},
-            )
-        )
+        return self.retry.add(_replication_task(addr, ns, d))
 
     def _namespace_for(self, d: Digest) -> str:
         """The namespace a blob was committed under (NamespaceMetadata
@@ -225,13 +232,7 @@ class OriginServer:
                 # later.
                 for addr in locations:
                     if addr != self.self_addr:
-                        tasks.append(Task(
-                            kind=REPLICATE_KIND,
-                            key=f"{addr}:{ns}:{d.hex}",
-                            payload={
-                                "addr": addr, "namespace": ns, "digest": d.hex,
-                            },
-                        ))
+                        tasks.append(_replication_task(addr, ns, d))
             return tasks
 
         tasks = await asyncio.to_thread(_plan)
@@ -245,6 +246,11 @@ class OriginServer:
         d = Digest.from_hex(task.payload["digest"])
         ns = task.payload["namespace"]
         addr = task.payload["addr"]
+        if not self.store.in_cache(d):
+            # Local copy evicted (cleanup runs concurrently with repair
+            # hand-offs): nothing to send; treating it as done keeps the
+            # forever-retrying queue from accumulating dead tasks.
+            return
         peer = BlobClient(addr)
         try:
             if await peer.stat(ns, d) is not None:
@@ -276,10 +282,16 @@ class OriginServer:
             raise web.HTTPNotFound(text="blob not found")
         return web.json_response({"size": size})
 
+    def _touch(self, d: Digest) -> None:
+        """Feed the eviction clock on every read (throttled internally)."""
+        if self.cleanup is not None:
+            self.cleanup.touch(d)
+
     async def _download(self, req: web.Request) -> web.StreamResponse:
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
+        self._touch(d)
         # sendfile from the cache: O(1) request memory for any blob size.
         return web.FileResponse(
             self.store.cache_path(d),
@@ -290,6 +302,7 @@ class OriginServer:
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
+        self._touch(d)  # metainfo fetch = imminent swarm read
         metainfo = await self.generator.generate(d)
         if self.scheduler is not None:
             # Metainfo fetch precedes a swarm download: make sure we seed.

@@ -45,6 +45,13 @@ class CleanupManager:
         # e.g. DedupIndex.remove_sync, so eviction doesn't leave ghost
         # entries in the similarity index. Failures don't block eviction.
         self.on_evict = on_evict
+        # Access times are recorded in memory on every read (free for the
+        # request path) and flushed to TTIMetadata sidecars by the sweep;
+        # the sweep always consults the in-memory map too, so a hot blob is
+        # never evicted on a stale persisted timestamp. Restart loses at
+        # most one sweep interval of recency.
+        self._touched: dict[str, float] = {}
+        self._flushed: dict[str, float] = {}
 
     def _evict(self, d: Digest) -> None:
         if self.on_evict is not None:
@@ -52,20 +59,36 @@ class CleanupManager:
                 self.on_evict(d)
             except Exception:
                 pass
+        self._touched.pop(d.hex, None)
+        self._flushed.pop(d.hex, None)
         self.store.delete_cache_file(d)
 
-    def touch(self, d: Digest) -> None:
-        """Record an access (callers: every blob read path)."""
-        self.store.set_metadata(d, TTIMetadata())
+    def touch(self, d: Digest, now: float | None = None) -> None:
+        """Record an access (callers: every blob read path). Memory-only --
+        no disk write on the request path; :meth:`run_once` persists."""
+        self._touched[d.hex] = time.time() if now is None else now
+
+    def _flush_touches(self) -> None:
+        """Persist in-memory access times that moved since the last sweep."""
+        for hex_, t in list(self._touched.items()):
+            if t > self._flushed.get(hex_, 0.0):
+                try:
+                    self.store.set_metadata(Digest.from_hex(hex_), TTIMetadata(t))
+                    self._flushed[hex_] = t
+                except OSError:
+                    pass  # blob raced away; eviction handles the rest
 
     def _last_access(self, d: Digest) -> float:
+        persisted = 0.0
         md = self.store.get_metadata(d, TTIMetadata)
         if md is not None:
-            return md.last_access
-        try:
-            return os.path.getmtime(self.store.cache_path(d))
-        except FileNotFoundError:
-            return 0.0
+            persisted = md.last_access
+        else:
+            try:
+                persisted = os.path.getmtime(self.store.cache_path(d))
+            except FileNotFoundError:
+                pass
+        return max(persisted, self._touched.get(d.hex, 0.0))
 
     def _evictable(self, d: Digest) -> bool:
         md = self.store.get_metadata(d, PersistMetadata)
@@ -75,6 +98,7 @@ class CleanupManager:
         """One eviction sweep; returns evicted digests."""
         now = time.time() if now is None else now
         cfg = self.config
+        self._flush_touches()
         evicted: list[Digest] = []
 
         entries = [
