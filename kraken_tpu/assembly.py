@@ -14,6 +14,7 @@ star specifies.
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Optional
 
 from aiohttp import web
@@ -32,6 +33,7 @@ from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
 from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.metrics import instrument_app
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -44,18 +46,32 @@ from kraken_tpu.tracker.client import TrackerClient
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore
 from kraken_tpu.tracker.server import TrackerServer
 
+_log = logging.getLogger("kraken.assembly")
+
 
 async def _cleanup_loop(manager: CleanupManager) -> None:
     """Periodic eviction sweep for a node's CAStore."""
     while True:
         await asyncio.sleep(manager.config.interval_seconds)
         try:
-            await asyncio.to_thread(manager.run_once)
+            evicted = await asyncio.to_thread(manager.run_once)
+            if evicted:
+                _log.info(
+                    "evicted blobs",
+                    extra={"count": len(evicted),
+                           "store": manager.store.root},
+                )
         except Exception:
-            pass
+            _log.exception("cleanup sweep failed")
 
 
-async def _serve(app: web.Application, host: str, port: int):
+async def _serve(app: web.Application, host: str, port: int,
+                 component: str = ""):
+    if component:
+        # Per-endpoint latency/status metrics + GET /metrics on every
+        # component app (lib/middleware + tally in the reference --
+        # upstream path, unverified; SURVEY.md SS2.4/SS5).
+        instrument_app(app, component)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
@@ -87,7 +103,7 @@ class TrackerNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port
+            self.server.make_app(), self.host, self.port, "tracker"
         )
         # The cluster's passive health filter only takes effect when the
         # ring re-resolves; refresh it periodically (resolved each tick:
@@ -229,7 +245,7 @@ class OriginNode:
             cleanup=self.cleanup,
         )
         self._runner, self.http_port = await _serve(
-            self.server.make_app(), self.host, self.http_port
+            self.server.make_app(), self.host, self.http_port, "origin"
         )
         if not self.self_addr:
             self.self_addr = self.addr
@@ -283,14 +299,22 @@ class OriginNode:
             except Exception:
                 pass
 
-    def _on_ring_change(self, _hosts: list[str]) -> None:
+    def _on_ring_change(self, hosts: list[str]) -> None:
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             return  # construction-time refresh: no loop, nothing to repair yet
         if self.server is None:
             return
-        t = loop.create_task(self.server.repair())
+
+        async def repair_and_log():
+            n = await self.server.repair()
+            _log.info(
+                "ring changed; repair enqueued",
+                extra={"node": self.self_addr, "members": hosts, "tasks": n},
+            )
+
+        t = loop.create_task(repair_and_log())
         self._repair_tasks.add(t)
         t.add_done_callback(self._repair_tasks.discard)
 
@@ -347,7 +371,7 @@ class BuildIndexNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port
+            self.server.make_app(), self.host, self.port, "build-index"
         )
         self.retry.start()
 
@@ -385,7 +409,7 @@ class ProxyNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port
+            self.server.make_app(), self.host, self.port, "proxy"
         )
 
     async def stop(self) -> None:
@@ -456,7 +480,7 @@ class AgentNode:
             self.store, self.scheduler, cleanup=self.cleanup
         )
         self._runner, self.http_port = await _serve(
-            self.server.make_app(), self.host, self.http_port
+            self.server.make_app(), self.host, self.http_port, "agent"
         )
         if self.cleanup is not None:
             self._cleanup_task = asyncio.create_task(
@@ -473,7 +497,8 @@ class AgentNode:
                 read_only=True,
             )
             self._registry_runner, self.registry_port = await _serve(
-                registry.make_app(), self.host, self.registry_port
+                registry.make_app(), self.host, self.registry_port,
+                "agent-registry",
             )
 
     async def stop(self) -> None:

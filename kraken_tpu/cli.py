@@ -26,6 +26,7 @@ from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import PassiveFilter
 from kraken_tpu.store.cleanup import CleanupConfig
+from kraken_tpu.utils.structlog import setup_json_logging
 
 
 async def _run_until_signal(node, describe: dict) -> None:
@@ -78,6 +79,7 @@ def main(argv: list[str] | None = None) -> None:
 
     args = parser.parse_args(argv)
     cfg = load_config(args.config) if args.config else {}
+    setup_json_logging(args.component)
 
     def pick(flag, key, default=None):
         return flag if flag is not None else cfg.get(key, default)
@@ -124,6 +126,12 @@ def main(argv: list[str] | None = None) -> None:
             else None
         )
         self_addr = pick(args.self_addr, "self_addr", "")
+        if cluster_addrs and self_addr and self_addr not in cluster_addrs:
+            parser.error(
+                f"--self-addr {self_addr!r} does not appear in --cluster"
+                " (must match one entry verbatim, or the origin will probe"
+                " and replicate to itself)"
+            )
         if cluster_addrs and not self_addr:
             # Fall back to host:port, which matches --cluster only when the
             # port is fixed and the host spelling agrees.

@@ -21,11 +21,36 @@ of pieces. A per-piece call would hide the batch axis the hardware needs.
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Callable, Dict
 
 import numpy as np
 
 DIGEST_SIZE = 32
+
+
+def record_hash_metrics(
+    hasher: str, nbytes: int, pieces: int, seconds: float,
+    occupancy: float = 1.0,
+) -> None:
+    """North-star gauges (SURVEY.md SS6): per-dispatch GB/s and batch
+    occupancy, plus cumulative byte/piece counters, labeled by hasher."""
+    from kraken_tpu.utils.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hasher_bytes_total", "Bytes hashed through the piece-hash plane"
+    ).inc(nbytes, hasher=hasher)
+    REGISTRY.counter(
+        "hasher_pieces_total", "Pieces hashed through the piece-hash plane"
+    ).inc(pieces, hasher=hasher)
+    if seconds > 0:
+        REGISTRY.gauge(
+            "hasher_last_gbps", "Throughput of the last hash_pieces call"
+        ).set(nbytes / seconds / 1e9, hasher=hasher)
+    REGISTRY.gauge(
+        "hasher_batch_occupancy",
+        "Useful rows / dispatched rows in the last hash_pieces call",
+    ).set(occupancy, hasher=hasher)
 
 
 class PieceHasher:
@@ -60,12 +85,17 @@ class CPUPieceHasher(PieceHasher):
     def hash_pieces(self, data: bytes | memoryview, piece_length: int) -> np.ndarray:
         if piece_length <= 0:
             raise ValueError(f"piece_length must be positive: {piece_length}")
+        start = time.perf_counter()
         view = memoryview(data)
         n = (len(view) + piece_length - 1) // piece_length
         out = np.empty((n, DIGEST_SIZE), dtype=np.uint8)
         for i in range(n):
             piece = view[i * piece_length : (i + 1) * piece_length]
             out[i] = np.frombuffer(hashlib.sha256(piece).digest(), dtype=np.uint8)
+        if n:
+            record_hash_metrics(
+                self.name, len(view), n, time.perf_counter() - start
+            )
         return out
 
     def hash_batch(self, pieces: list[bytes | memoryview]) -> np.ndarray:

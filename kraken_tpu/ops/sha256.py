@@ -25,12 +25,14 @@ stays small across varying blob sizes.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kraken_tpu.core.hasher import DIGEST_SIZE, PieceHasher, register_hasher
+from kraken_tpu.core.hasher import record_hash_metrics as _record_hash_metrics
 from kraken_tpu.ops import next_pow2 as _next_pow2
 
 # fmt: off
@@ -224,6 +226,8 @@ class JaxPieceHasher(PieceHasher):
         total = len(view)
         if total == 0:
             return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        start = time.perf_counter()
+        dispatched_rows = 0  # padded rows actually sent to the device
         n = (total + piece_length - 1) // piece_length
         n_full = total // piece_length
 
@@ -241,6 +245,7 @@ class JaxPieceHasher(PieceHasher):
                 # final sub-batch doesn't trigger a fresh compile per blob
                 # size.
                 gb = min(per_batch, _next_pow2(g))
+                dispatched_rows += gb
                 if gb != g:
                     chunk = np.concatenate(
                         [chunk, np.zeros((gb - g, piece_length), dtype=np.uint8)]
@@ -266,9 +271,20 @@ class JaxPieceHasher(PieceHasher):
         if tail:
             tail_digests = self.hash_batch(tail)
             if outs:
-                return np.concatenate([_digest_bytes(jnp.concatenate(outs)), tail_digests])
-            return tail_digests
-        return _digest_bytes(jnp.concatenate(outs) if len(outs) > 1 else outs[0])
+                out = np.concatenate(
+                    [_digest_bytes(jnp.concatenate(outs)), tail_digests]
+                )
+            else:
+                out = tail_digests
+        else:
+            out = _digest_bytes(
+                jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+            )
+        _record_hash_metrics(
+            "tpu", total, n, time.perf_counter() - start,
+            occupancy=(n_full / dispatched_rows) if dispatched_rows else 1.0,
+        )
+        return out
 
     # -- arbitrary piece batch (agent verify hot loop) ---------------------
 

@@ -25,6 +25,7 @@ The origin seeds every cached blob over the P2P plane via its scheduler.
 from __future__ import annotations
 
 import asyncio
+import logging
 import urllib.parse
 from typing import Optional
 
@@ -41,7 +42,10 @@ from kraken_tpu.persistedretry import Manager as RetryManager, Task
 from kraken_tpu.placement.hashring import Ring
 from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
-from kraken_tpu.store.metadata import NamespaceMetadata
+from kraken_tpu.store.metadata import NamespaceMetadata, pin, unpin
+from kraken_tpu.utils.metrics import REGISTRY
+
+_log = logging.getLogger("kraken.origin")
 
 REPLICATE_KIND = "replicate"
 
@@ -49,10 +53,11 @@ REPLICATE_KIND = "replicate"
 def _replication_task(addr: str, ns: str, d: Digest) -> Task:
     """The one replication Task shape. The upload path and the repair path
     MUST build identical (kind, key) pairs or the dedup that makes repair
-    idempotent silently breaks."""
+    idempotent silently breaks. Digest-first key: the unpin logic prefix-
+    scans pending tasks by blob."""
     return Task(
         kind=REPLICATE_KIND,
-        key=f"{addr}:{ns}:{d.hex}",
+        key=f"{d.hex}:{ns}:{addr}",
         payload={"addr": addr, "namespace": ns, "digest": d.hex},
     )
 
@@ -194,7 +199,13 @@ class OriginServer:
 
     def _add_replication_task(self, addr: str, ns: str, d: Digest) -> bool:
         assert self.retry is not None
-        return self.retry.add(_replication_task(addr, ns, d))
+        added = self.retry.add(_replication_task(addr, ns, d))
+        if added:
+            # Pin against eviction until the blob lands on every target
+            # (otherwise a cleanup sweep can erase the cluster's only copy
+            # while the peer is down). Unpinned in _execute_replication.
+            pin(self.store, d, REPLICATE_KIND)
+        return added
 
     def _namespace_for(self, d: Digest) -> str:
         """The namespace a blob was committed under (NamespaceMetadata
@@ -240,6 +251,12 @@ class OriginServer:
         for i in range(0, len(tasks), 500):
             enqueued += self.retry.add_many(tasks[i : i + 500])
             await asyncio.sleep(0)  # yield between transactions
+        # Pin every planned blob (idempotent; pin bookkeeping stays on the
+        # event loop -- see PersistMetadata).
+        for i, hex_ in enumerate({t.payload["digest"] for t in tasks}):
+            pin(self.store, Digest.from_hex(hex_), REPLICATE_KIND)
+            if i % 200 == 199:
+                await asyncio.sleep(0)
         return enqueued
 
     async def _execute_replication(self, task: Task) -> None:
@@ -247,19 +264,58 @@ class OriginServer:
         ns = task.payload["namespace"]
         addr = task.payload["addr"]
         if not self.store.in_cache(d):
-            # Local copy evicted (cleanup runs concurrently with repair
-            # hand-offs): nothing to send; treating it as done keeps the
-            # forever-retrying queue from accumulating dead tasks.
+            await self._handle_replication_without_local(task, d, ns, addr)
             return
         peer = BlobClient(addr)
         try:
-            if await peer.stat(ns, d) is not None:
-                return  # replica already has it
-            # Stream from disk: replication of a 10 GiB layer must not
-            # hold the layer in RAM.
-            await peer.upload_from_file(ns, d, self.store.cache_path(d))
+            if await peer.stat(ns, d) is None:
+                # Stream from disk: replication of a 10 GiB layer must not
+                # hold the layer in RAM.
+                await peer.upload_from_file(ns, d, self.store.cache_path(d))
         finally:
             await peer.close()
+        self._unpin_if_last_replication(d)
+
+    async def _handle_replication_without_local(
+        self, task: Task, d: Digest, ns: str, addr: str
+    ) -> None:
+        """The local copy is gone (explicit DELETE, or eviction despite the
+        pin -- e.g. a pre-pin record). Done if ANY current owner holds the
+        blob (they replicate onward); otherwise record the loss loudly and
+        retire the task -- retrying cannot resurrect bytes that exist
+        nowhere."""
+        owners = [a for a in ([] if self.ring is None else self.ring.locations(d))
+                  if a != self.self_addr]
+        for owner in dict.fromkeys([addr, *owners]):
+            peer = BlobClient(owner)
+            try:
+                if await peer.stat(ns, d) is not None:
+                    self._unpin_if_last_replication(d)
+                    return
+            except Exception:
+                pass
+            finally:
+                await peer.close()
+        REGISTRY.counter(
+            "replication_lost_total",
+            "Replication tasks whose blob exists on no reachable owner",
+        ).inc(component="origin")
+        _log.error(
+            "replication source lost: blob held by no reachable owner",
+            extra={"digest": d.hex, "namespace": ns, "target": addr},
+        )
+        self._unpin_if_last_replication(d)
+
+    def _unpin_if_last_replication(self, d: Digest) -> None:
+        """Drop the replication pin once no OTHER pending replicate task
+        references this blob (the current task is still counted until the
+        retry manager marks it done)."""
+        if self.retry is None:
+            return
+        if self.retry.store.count_pending(
+            REPLICATE_KIND, f"{d.hex}:"
+        ) <= 1 and self.store.in_cache(d):
+            unpin(self.store, d, REPLICATE_KIND)
 
     # -- reads -------------------------------------------------------------
 

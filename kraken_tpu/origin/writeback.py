@@ -14,7 +14,7 @@ from kraken_tpu.backend import Manager as BackendManager
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
 from kraken_tpu.store import CAStore
-from kraken_tpu.store.metadata import PersistMetadata
+from kraken_tpu.store.metadata import pin, unpin
 
 KIND = "writeback"
 
@@ -37,7 +37,7 @@ class WritebackExecutor:
         """Queue a blob for backend upload; pin it against eviction."""
         if self.backends.try_get_client(namespace) is None:
             return  # namespace has no durable backend configured
-        self.store.set_metadata(d, PersistMetadata(True))
+        pin(self.store, d, KIND)
         self.retry.add(
             Task(kind=KIND, key=f"{namespace}:{d.hex}",
                  payload={"namespace": namespace, "digest": d.hex})
@@ -49,5 +49,5 @@ class WritebackExecutor:
         client = self.backends.get_client(namespace)
         data = await asyncio.to_thread(self.store.read_cache_file, d)
         await client.upload(namespace, d.hex, data)  # backend owns pathing
-        # Landed durably: unpin.
-        self.store.set_metadata(d, PersistMetadata(False))
+        # Landed durably: drop the writeback pin (other pins may remain).
+        unpin(self.store, d, KIND)

@@ -68,6 +68,15 @@ class BatchedVerifier:
         batch, self._queue = self._queue, []
         if not batch:
             return
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "verify_pieces_total", "Pieces through batched verification"
+        ).inc(len(batch))
+        REGISTRY.gauge(
+            "verify_batch_occupancy",
+            "Batch fill of the last verify flush (batched / max_batch)",
+        ).set(len(batch) / self._max_batch)
         try:
             digests = self._hasher.hash_batch([d for d, _e, _f in batch])
         except Exception as e:

@@ -97,6 +97,15 @@ class TaskStore:
     def all_pending(self) -> list[Task]:
         return self.ready(now=float("inf"), limit=1_000_000)
 
+    def count_pending(self, kind: str, key_prefix: str = "") -> int:
+        """Pending tasks of ``kind`` whose key starts with ``key_prefix``
+        (the replication unpin logic asks "any other task for this blob?")."""
+        row = self._db.execute(
+            "SELECT COUNT(*) FROM tasks WHERE kind = ? AND key GLOB ?",
+            (kind, key_prefix.replace("*", "[*]") + "*"),
+        ).fetchone()
+        return int(row[0])
+
     def done(self, task: Task) -> None:
         self._db.execute("DELETE FROM tasks WHERE id = ?", (task.id,))
         self._db.commit()

@@ -69,11 +69,18 @@ class CleanupManager:
         self._touched[d.hex] = time.time() if now is None else now
 
     def _flush_touches(self) -> None:
-        """Persist in-memory access times that moved since the last sweep."""
+        """Persist in-memory access times that moved since the last sweep;
+        entries for blobs deleted outside eviction (DELETE endpoint) are
+        pruned -- writing their sidecar would orphan a ._md_tti file."""
         for hex_, t in list(self._touched.items()):
+            d = Digest.from_hex(hex_)
+            if not self.store.in_cache(d):
+                self._touched.pop(hex_, None)
+                self._flushed.pop(hex_, None)
+                continue
             if t > self._flushed.get(hex_, 0.0):
                 try:
-                    self.store.set_metadata(Digest.from_hex(hex_), TTIMetadata(t))
+                    self.store.set_metadata(d, TTIMetadata(t))
                     self._flushed[hex_] = t
                 except OSError:
                     pass  # blob raced away; eviction handles the rest

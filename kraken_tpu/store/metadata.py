@@ -118,16 +118,51 @@ class NamespaceMetadata(Metadata):
 
 @register_metadata
 class PersistMetadata(Metadata):
-    """Marks a cache file as exempt from eviction (e.g. pending writeback)."""
+    """Marks a cache file as exempt from eviction while any pin reason is
+    outstanding (pending writeback, pending replication, ...).
+
+    Multiple subsystems pin independently; a boolean would let one
+    subsystem's unpin release another's pin (writeback landing must not
+    unpin a blob whose replication is still retrying). Pin bookkeeping is
+    not concurrency-safe across threads -- callers run on the event loop.
+    """
 
     name = "persist"
 
-    def __init__(self, persist: bool = True):
-        self.persist = persist
+    def __init__(self, persist: bool | set[str] = True):
+        if isinstance(persist, bool):
+            self.reasons: set[str] = {"legacy"} if persist else set()
+        else:
+            self.reasons = set(persist)
+
+    @property
+    def persist(self) -> bool:
+        return bool(self.reasons)
 
     def serialize(self) -> bytes:
-        return b"1" if self.persist else b"0"
+        return ",".join(sorted(self.reasons)).encode()
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "PersistMetadata":
-        return cls(raw == b"1")
+        text = raw.decode()
+        if text == "1":  # legacy boolean record
+            return cls(True)
+        if text in ("", "0"):
+            return cls(False)
+        return cls(set(text.split(",")))
+
+
+def pin(store, d, reason: str) -> None:
+    """Add an eviction-exemption reason to a blob."""
+    md = store.get_metadata(d, PersistMetadata) or PersistMetadata(set())
+    md.reasons.add(reason)
+    store.set_metadata(d, md)
+
+
+def unpin(store, d, reason: str) -> None:
+    """Drop one reason; the blob stays pinned while others remain."""
+    md = store.get_metadata(d, PersistMetadata)
+    if md is None:
+        return
+    md.reasons.discard(reason)
+    store.set_metadata(d, md)

@@ -1,0 +1,51 @@
+"""Structured JSON logs on stdlib logging.
+
+Mirrors the reference's zap-based structured logging (uber/kraken uses
+uber-go/zap everywhere -- upstream convention, unverified; SURVEY.md SS5),
+stdlib-only: one line of JSON per record with timestamp, level, logger,
+component, message, and any ``extra={...}`` fields.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+_RESERVED = frozenset(logging.LogRecord(
+    "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
+class JSONFormatter(logging.Formatter):
+    def __init__(self, component: str = ""):
+        super().__init__()
+        self.component = component
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(record.created, 3),
+            "iso": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if self.component:
+            doc["component"] = self.component
+        for k, v in record.__dict__.items():
+            if k not in _RESERVED and not k.startswith("_"):
+                doc[k] = v
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, default=str)
+
+
+def setup_json_logging(
+    component: str = "", level: int = logging.INFO
+) -> None:
+    """Route the root logger to one JSON line per record on stderr."""
+    handler = logging.StreamHandler()
+    handler.setFormatter(JSONFormatter(component))
+    root = logging.getLogger()
+    root.handlers = [handler]
+    root.setLevel(level)

@@ -186,3 +186,86 @@ def test_cleanup_respects_persist(store):
     # Unmark -> evictable.
     store.set_metadata(d, PersistMetadata(False))
     assert mgr.run_once(now=1e9) == [d]
+
+
+def test_persist_pins_are_independent(tmp_path):
+    """Two subsystems pin the same blob; one unpin must not release the
+    other's (writeback landing while replication still retries)."""
+    from kraken_tpu.store.metadata import PersistMetadata, pin, unpin
+
+    store = CAStore(str(tmp_path))
+    data = b"pinned blob"
+    d = Digest.from_bytes(data)
+    uid = store.create_upload()
+    store.write_upload_chunk(uid, 0, data)
+    store.commit_upload(uid, d)
+
+    pin(store, d, "writeback")
+    pin(store, d, "replicate")
+    assert store.get_metadata(d, PersistMetadata).persist
+    unpin(store, d, "writeback")
+    assert store.get_metadata(d, PersistMetadata).persist  # replicate holds
+    unpin(store, d, "replicate")
+    assert not store.get_metadata(d, PersistMetadata).persist
+
+    # Legacy boolean records still deserialize.
+    assert PersistMetadata.deserialize(b"1").persist
+    assert not PersistMetadata.deserialize(b"0").persist
+    back = PersistMetadata.deserialize(
+        PersistMetadata({"a", "b"}).serialize()
+    )
+    assert back.reasons == {"a", "b"}
+
+
+def test_pending_replication_pins_until_done(tmp_path):
+    """Upload with an unreachable ring peer: the blob must be pinned (a
+    cleanup sweep cannot evict the cluster's only copy) until replication
+    lands."""
+    import asyncio
+
+    from kraken_tpu.assembly import OriginNode
+    from kraken_tpu.origin.client import BlobClient
+    from kraken_tpu.placement import HostList, Ring
+    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+    from kraken_tpu.store.metadata import PersistMetadata
+
+    async def main():
+        import socket
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        ports = [free_port(), free_port()]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        node = OriginNode(
+            store_root=str(tmp_path / "o"),
+            http_port=ports[0],
+            ring=Ring(HostList(static=addrs), max_replica=2),
+            self_addr=addrs[0],
+            dedup=False,
+            health_interval_seconds=3600,  # keep the dead peer in the ring
+        )
+        await node.start()
+        oc = BlobClient(node.addr)
+        try:
+            data = b"x" * 50_000
+            d = Digest.from_bytes(data)
+            await oc.upload("ns", d, data)
+            md = node.store.get_metadata(d, PersistMetadata)
+            assert md is not None and md.persist, (
+                "blob not pinned while replication to the dead peer pends"
+            )
+            # Aggressive TTI sweep must spare it.
+            mgr = CleanupManager(
+                node.store, CleanupConfig(tti_seconds=0.000001)
+            )
+            await asyncio.sleep(0.01)
+            assert mgr.run_once() == []
+            assert node.store.in_cache(d)
+        finally:
+            await oc.close()
+            await node.stop()
+
+    asyncio.run(main())
