@@ -249,14 +249,17 @@ class OriginServer:
         tasks = await asyncio.to_thread(_plan)
         enqueued = 0
         for i in range(0, len(tasks), 500):
-            enqueued += self.retry.add_many(tasks[i : i + 500])
+            batch = tasks[i : i + 500]
+            # Pin BEFORE enqueue, same loop iteration (no awaits between):
+            # a fast-completing task must find its pin already set, or its
+            # unpin runs first and the late pin leaks forever. Skip blobs
+            # DELETEd since _plan (pinning would orphan a sidecar).
+            for hex_ in {t.payload["digest"] for t in batch}:
+                d2 = Digest.from_hex(hex_)
+                if self.store.in_cache(d2):
+                    pin(self.store, d2, REPLICATE_KIND)
+            enqueued += self.retry.add_many(batch)
             await asyncio.sleep(0)  # yield between transactions
-        # Pin every planned blob (idempotent; pin bookkeeping stays on the
-        # event loop -- see PersistMetadata).
-        for i, hex_ in enumerate({t.payload["digest"] for t in tasks}):
-            pin(self.store, Digest.from_hex(hex_), REPLICATE_KIND)
-            if i % 200 == 199:
-                await asyncio.sleep(0)
         return enqueued
 
     async def _execute_replication(self, task: Task) -> None:
@@ -281,27 +284,31 @@ class OriginServer:
     ) -> None:
         """The local copy is gone (explicit DELETE, or eviction despite the
         pin -- e.g. a pre-pin record). Done if ANY current owner holds the
-        blob (they replicate onward); otherwise record the loss loudly and
-        retire the task -- retrying cannot resurrect bytes that exist
-        nowhere."""
+        blob (they replicate onward). The task retires as LOST only when
+        every owner positively confirmed a miss; an unreachable owner is
+        no evidence -- raise so the retry manager reschedules and re-probes
+        after the owner recovers."""
         owners = [a for a in ([] if self.ring is None else self.ring.locations(d))
                   if a != self.self_addr]
+        unreachable: Exception | None = None
         for owner in dict.fromkeys([addr, *owners]):
             peer = BlobClient(owner)
             try:
                 if await peer.stat(ns, d) is not None:
                     self._unpin_if_last_replication(d)
                     return
-            except Exception:
-                pass
+            except Exception as e:
+                unreachable = e
             finally:
                 await peer.close()
+        if unreachable is not None:
+            raise unreachable
         REGISTRY.counter(
             "replication_lost_total",
-            "Replication tasks whose blob exists on no reachable owner",
+            "Replication tasks whose blob was confirmed missing on every owner",
         ).inc(component="origin")
         _log.error(
-            "replication source lost: blob held by no reachable owner",
+            "replication source lost: every owner confirmed missing",
             extra={"digest": d.hex, "namespace": ns, "target": addr},
         )
         self._unpin_if_last_replication(d)

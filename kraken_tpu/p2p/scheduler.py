@@ -29,6 +29,7 @@ from kraken_tpu.p2p.conn import (
     handshake_inbound,
     handshake_outbound,
 )
+from kraken_tpu.p2p.announcequeue import AnnounceQueue
 from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
 from kraken_tpu.p2p.dispatch import Dispatcher
 from kraken_tpu.p2p.networkevent import NoopProducer, Producer
@@ -60,12 +61,27 @@ class SchedulerConfig:
         retry_tick_seconds: float = 2.0,
         conn_state: ConnStateConfig | None = None,
         seed_on_complete: bool = True,
+        max_announce_rate: float = 100.0,
+        announce_tick_seconds: float = 0.2,
+        seed_announce_interval_seconds: float | None = None,
     ):
         self.announce_interval = announce_interval_seconds
         self.dial_timeout = dial_timeout_seconds
         self.retry_tick = retry_tick_seconds
         self.conn_state = conn_state or ConnStateConfig()
         self.seed_on_complete = seed_on_complete
+        # Announce pacing (announcequeue): the global cap keeps announce
+        # load O(rate) however many torrents seed; complete torrents
+        # re-announce on the longer seed interval.
+        self.max_announce_rate = max_announce_rate
+        self.announce_tick = announce_tick_seconds
+        # 3x, not more: seeders must re-announce inside the tracker's peer
+        # TTL (default 30 s vs 9 s here) or they vanish from handouts.
+        self.seed_announce_interval = (
+            seed_announce_interval_seconds
+            if seed_announce_interval_seconds is not None
+            else announce_interval_seconds * 3
+        )
 
 
 class _TorrentControl:
@@ -126,6 +142,9 @@ class Scheduler:
         self._controls: dict[InfoHash, _TorrentControl] = {}
         self._coalescer: RequestCoalescer = RequestCoalescer()
         self._server: Optional[asyncio.base_events.Server] = None
+        self._announce_queue = AnnounceQueue()
+        self._announce_pump_task: Optional[asyncio.Task] = None
+        self._announce_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -135,8 +154,13 @@ class Scheduler:
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
+        self._announce_pump_task = asyncio.create_task(self._announce_pump())
 
     async def stop(self) -> None:
+        if self._announce_pump_task is not None:
+            self._announce_pump_task.cancel()
+        for t in list(self._announce_tasks):
+            t.cancel()
         for ctl in list(self._controls.values()):
             ctl.cancel_tasks()
             ctl.dispatcher.close()
@@ -160,6 +184,8 @@ class Scheduler:
         metainfo = await self.metainfo_client.get(namespace, d)
         ctl = self._get_or_create_control(metainfo, namespace)
         await asyncio.shield(ctl.dispatcher.done)
+        # Become discoverable as a seeder immediately (still rate-paced).
+        self._announce_queue.schedule(metainfo.info_hash, 0.0)
         if not self.config.seed_on_complete:
             # Download-only mode: tear the torrent down instead of
             # lazily seeding it (e.g. bandwidth-constrained edge agents).
@@ -169,6 +195,7 @@ class Scheduler:
         ctl = self._controls.pop(h, None)
         if ctl is None:
             return
+        self._announce_queue.remove(h)
         ctl.cancel_tasks()
         ctl.dispatcher.close()
         self.conn_state.clear_torrent(h)
@@ -195,7 +222,9 @@ class Scheduler:
         )
         ctl = _TorrentControl(torrent, namespace, dispatcher)
         self._controls[h] = ctl
-        ctl.spawn(self._announce_loop(ctl))
+        # First announce ASAP (downloads need peers now); re-announces are
+        # paced by the queue pump under the global rate cap.
+        self._announce_queue.schedule(h, 0.0)
         ctl.spawn(self._retry_loop(ctl))
         self.events.emit(
             "add_torrent", h.hex, blob=metainfo.name, complete=torrent.complete()
@@ -209,23 +238,54 @@ class Scheduler:
 
     # -- announce / dial ---------------------------------------------------
 
-    async def _announce_loop(self, ctl: _TorrentControl) -> None:
-        h = ctl.torrent.info_hash
-        interval = self.config.announce_interval
+    async def _announce_pump(self) -> None:
+        """ONE task paces every torrent's announces (announcequeue): each
+        tick drains at most rate*tick due torrents, oldest-due first, so
+        tracker load is bounded by config however many torrents exist."""
+        cfg = self.config
+        carry = 0.0  # fractional budget: caps below 1/tick must still hold
         while True:
-            try:
-                peers, interval_r = await self.announce_client.announce(
-                    ctl.torrent.digest, h, ctl.namespace, ctl.torrent.complete()
-                )
-                interval = interval_r or self.config.announce_interval
-                self.events.emit("announce", h.hex, returned=len(peers))
-                for peer in peers:
-                    self._maybe_dial(ctl, peer)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                pass  # tracker hiccup: retry next tick
-            await asyncio.sleep(interval)
+            carry = min(
+                carry + cfg.max_announce_rate * cfg.announce_tick,
+                max(1.0, cfg.max_announce_rate),  # burst at most 1 s of budget
+            )
+            budget = int(carry)
+            carry -= budget
+            now = asyncio.get_running_loop().time()
+            for h in self._announce_queue.pop_ready(now, budget):
+                ctl = self._controls.get(h)
+                if ctl is None:
+                    continue
+                t = asyncio.create_task(self._announce_once(ctl))
+                self._announce_tasks.add(t)
+                t.add_done_callback(self._announce_tasks.discard)
+            await asyncio.sleep(cfg.announce_tick)
+
+    async def _announce_once(self, ctl: _TorrentControl) -> None:
+        h = ctl.torrent.info_hash
+        complete = ctl.torrent.complete()
+        interval = (
+            self.config.seed_announce_interval
+            if complete
+            else self.config.announce_interval
+        )
+        try:
+            peers, interval_r = await self.announce_client.announce(
+                ctl.torrent.digest, h, ctl.namespace, complete
+            )
+            if not complete and interval_r:
+                interval = interval_r
+            self.events.emit("announce", h.hex, returned=len(peers))
+            for peer in peers:
+                self._maybe_dial(ctl, peer)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # tracker hiccup: retry next interval
+        if h in self._controls:
+            self._announce_queue.schedule(
+                h, asyncio.get_running_loop().time() + interval
+            )
 
     def _maybe_dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
         if peer.peer_id == self.peer_id:

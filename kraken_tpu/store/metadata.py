@@ -131,7 +131,7 @@ class PersistMetadata(Metadata):
 
     def __init__(self, persist: bool | set[str] = True):
         if isinstance(persist, bool):
-            self.reasons: set[str] = {"legacy"} if persist else set()
+            self.reasons: set[str] = {"writeback"} if persist else set()
         else:
             self.reasons = set(persist)
 
@@ -145,8 +145,11 @@ class PersistMetadata(Metadata):
     @classmethod
     def deserialize(cls, raw: bytes) -> "PersistMetadata":
         text = raw.decode()
-        if text == "1":  # legacy boolean record
-            return cls(True)
+        if text == "1":
+            # Legacy boolean record: writeback was the only writer of
+            # PersistMetadata(True), so map it to the reason writeback
+            # releases -- an unreleasable reason would pin forever.
+            return cls({"writeback"})
         if text in ("", "0"):
             return cls(False)
         return cls(set(text.split(",")))

@@ -150,9 +150,10 @@ class Registry:
         return self._get(Histogram, name, help_, buckets=buckets)
 
     def render(self) -> str:
+        with self._lock:  # registration happens from worker threads too
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: list[str] = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
+        for m in metrics:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")

@@ -9,6 +9,7 @@ with no containers.
 import asyncio
 import os
 
+import numpy as np
 import pytest
 
 from kraken_tpu.core.digest import Digest
@@ -237,5 +238,59 @@ def test_corrupt_seeder_blacklisted(tmp_path):
             )
         finally:
             await stop_all(evil, honest, leecher)
+
+    asyncio.run(main())
+
+
+def test_announce_rate_bounded_at_scale(tmp_path):
+    """1k seeding torrents on one scheduler: announce calls/sec stays at
+    the configured cap, not O(torrents) (announcequeue pacing)."""
+
+    async def main():
+        calls = []
+
+        class CountingClient:
+            async def get(self, namespace, d):
+                raise AssertionError("not used")
+
+            async def announce(self, d, h, namespace, complete):
+                calls.append(asyncio.get_running_loop().time())
+                return [], 0.05  # tracker asks for very eager re-announce
+
+        store = CAStore(str(tmp_path / "s"))
+        client = CountingClient()
+        sched = Scheduler(
+            peer_id=PeerID(os.urandom(20).hex()),
+            ip="127.0.0.1",
+            port=0,
+            archive=OriginTorrentArchive(store, BatchedVerifier()),
+            metainfo_client=client,
+            announce_client=client,
+            config=SchedulerConfig(
+                announce_interval_seconds=0.05,
+                max_announce_rate=50.0,
+                announce_tick_seconds=0.05,
+            ),
+        )
+        await sched.start()
+        try:
+            rng = np.random.default_rng(3)
+            for i in range(1000):
+                blob = rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
+                d = Digest.from_bytes(blob + i.to_bytes(4, "big"))
+                mi = MetaInfo(d, 64, 4096, b"\x00" * 32)
+                store.create_cache_file(d, iter([blob]), verify=False)
+                sched.seed(mi, NS)
+            assert len(sched._controls) == 1000
+            t0 = asyncio.get_running_loop().time()
+            await asyncio.sleep(2.0)
+            window = [t for t in calls if t >= t0]
+            rate = len(window) / 2.0
+            # Unpaced this would be ~1000 first announces immediately and
+            # ~20k/s steady-state at the 0.05 s tracker interval.
+            assert rate <= 50.0 * 1.5, f"announce rate {rate}/s exceeds cap"
+            assert rate >= 50.0 * 0.5, f"announce rate {rate}/s: pump stalled?"
+        finally:
+            await sched.stop()
 
     asyncio.run(main())
