@@ -1,0 +1,205 @@
+"""Swarm-scale benchmark: flash-crowd pull latency over the P2P plane.
+
+BASELINE.md rows 2/6 ("agent piece-verify p99 pull latency", "p99 @ 10k
+agents, simulated swarm"): N agent schedulers + 1 origin seeder in one
+process, REAL TCP piece traffic (each peer owns a listening socket and
+dials over loopback), in-memory tracker (announce/metainfo RPC faked so
+the benchmark measures the data plane, not aiohttp routing). All N agents
+request the blob at t=0 -- the worst-case flash crowd; completed agents
+keep seeding, so late finishers pull mostly from other agents, which is
+the swarm effect being measured.
+
+Extrapolation toward 10k agents: p99 growth with N is dominated by swarm
+depth (how many hops from the origin the last agent sits), which grows
+logarithmically with N once per-peer conn caps bind. Run with --agents at
+several N to see the trend.
+
+Usage:
+    python bench_swarm.py [--agents 100] [--blob-mb 32] [--piece-kb 256]
+
+Prints one JSON line per metric (driver format:
+{"metric", "value", "unit", "vs_baseline"}), p99 last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
+from kraken_tpu.p2p.storage import (
+    AgentTorrentArchive,
+    BatchedVerifier,
+    OriginTorrentArchive,
+)
+from kraken_tpu.store import CAStore
+
+NS = "bench"
+
+
+class InMemoryTracker:
+    """Announce + metainfo, shared by every peer in-process."""
+
+    def __init__(self, interval: float = 0.5):
+        self.metainfos: dict[str, MetaInfo] = {}
+        self.peers: dict[str, dict[str, PeerInfo]] = {}
+        self.interval = interval
+        self.announces = 0
+
+    def client_for(self, ref: dict):
+        tracker = self
+
+        class _Client:
+            async def get(self, namespace, d):
+                return tracker.metainfos[d.hex]
+
+            async def announce(self, d, h, namespace, complete):
+                tracker.announces += 1
+                sched = ref["s"]
+                me = PeerInfo(
+                    peer_id=sched.peer_id, ip=sched.ip, port=sched.port,
+                    complete=complete,
+                )
+                swarm = tracker.peers.setdefault(h.hex, {})
+                swarm[me.peer_id.hex] = me
+                others = [
+                    p for pid, p in swarm.items() if pid != me.peer_id.hex
+                ]
+                # Tracker handout policy caps the returned set; mirror that
+                # so a 1k swarm does not hand every peer every other peer.
+                if len(others) > 20:
+                    idx = np.random.default_rng(tracker.announces)
+                    others = [others[i] for i in
+                              idx.choice(len(others), 20, replace=False)]
+                return others, tracker.interval
+
+        return _Client()
+
+
+def make_peer(root, name, tracker, *, seed_blob=None, piece_kb=256):
+    from kraken_tpu.p2p.connstate import ConnStateConfig
+
+    store = CAStore(os.path.join(root, name))
+    ref: dict = {}
+    if seed_blob is not None:
+        d = Digest.from_bytes(seed_blob)
+        store.create_cache_file(d, iter([seed_blob]))
+        archive = OriginTorrentArchive(store, BatchedVerifier())
+    else:
+        archive = AgentTorrentArchive(store, BatchedVerifier())
+    client = tracker.client_for(ref)
+    sched = Scheduler(
+        peer_id=PeerID(os.urandom(20).hex()),
+        ip="127.0.0.1",
+        port=0,
+        archive=archive,
+        metainfo_client=client,
+        announce_client=client,
+        is_origin=seed_blob is not None,
+        config=SchedulerConfig(
+            announce_interval_seconds=0.5,
+            retry_tick_seconds=0.5,
+            max_announce_rate=2000.0,
+            # Origins are servers: a 10-conn cap on the only initial seeder
+            # strangles the flash crowd's first wave.
+            conn_state=ConnStateConfig(
+                max_open_conns_per_torrent=64 if seed_blob is not None else 10
+            ),
+        ),
+    )
+    ref["s"] = sched
+    return sched
+
+
+async def run_bench(n_agents: int, blob_mb: int, piece_kb: int, root: str):
+    rng = np.random.default_rng(0)
+    blob = rng.integers(0, 256, size=blob_mb << 20, dtype=np.uint8).tobytes()
+    d = Digest.from_bytes(blob)
+    piece_len = piece_kb << 10
+    hashes = get_hasher("cpu").hash_pieces(blob, piece_len)
+    metainfo = MetaInfo(d, len(blob), piece_len, hashes.tobytes())
+
+    tracker = InMemoryTracker()
+    tracker.metainfos[d.hex] = metainfo
+
+    origin = make_peer(root, "origin", tracker, seed_blob=blob)
+    agents = [
+        make_peer(root, f"agent{i}", tracker) for i in range(n_agents)
+    ]
+    await origin.start()
+    origin.seed(metainfo, NS)
+    for a in agents:
+        await a.start()
+
+    t0 = time.perf_counter()
+    latencies: list[float] = []
+
+    async def pull(a):
+        start = time.perf_counter()
+        await a.download(NS, d)
+        latencies.append(time.perf_counter() - start)
+
+    await asyncio.gather(*(pull(a) for a in agents))
+    wall = time.perf_counter() - t0
+
+    for s in (origin, *agents):
+        await s.stop()
+
+    lat = np.sort(np.asarray(latencies))
+    n_pieces = metainfo.num_pieces
+    total_bytes = len(blob) * n_agents
+    return {
+        "agents": n_agents,
+        "blob_mb": blob_mb,
+        "pieces_per_blob": n_pieces,
+        "p50_s": float(lat[int(0.50 * (len(lat) - 1))]),
+        "p99_s": float(lat[int(0.99 * (len(lat) - 1))]),
+        "wall_s": wall,
+        "swarm_pieces_per_s": n_pieces * n_agents / wall,
+        "swarm_gbps": total_bytes / wall / 1e9,
+        "announces": tracker.announces,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", type=int, default=100)
+    ap.add_argument("--blob-mb", type=int, default=32)
+    ap.add_argument("--piece-kb", type=int, default=256)
+    args = ap.parse_args()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="kt-bench-swarm-") as root:
+        out = asyncio.run(
+            run_bench(args.agents, args.blob_mb, args.piece_kb, root)
+        )
+    for metric, unit in (
+        ("p50_s", "s"),
+        ("swarm_pieces_per_s", "pieces/s"),
+        ("swarm_gbps", "GB/s"),
+        ("p99_s", "s"),
+    ):
+        print(json.dumps({
+            "metric": f"swarm_pull_{metric}" if not metric.startswith("swarm")
+            else metric,
+            "value": round(out[metric], 4),
+            "unit": unit,
+            "vs_baseline": None,
+            "detail": {k: v for k, v in out.items()
+                       if k in ("agents", "blob_mb", "pieces_per_blob",
+                                "wall_s", "announces")},
+        }))
+
+
+if __name__ == "__main__":
+    main()

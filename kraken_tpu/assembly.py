@@ -34,6 +34,7 @@ from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
 from kraken_tpu.utils.httputil import HTTPClient
 from kraken_tpu.utils.metrics import instrument_app
+from kraken_tpu.p2p.connstate import ConnStateConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -229,6 +230,14 @@ class OriginNode:
             announce_client=self._tracker_client,
             is_origin=True,
             metainfo_resolver=self._resolve_metainfo,
+            # Origins serve swarms: far higher per-torrent conn budget than
+            # agents (a 10-conn cap on the sole initial seeder strangles
+            # flash crowds -- measured in bench_swarm).
+            config=SchedulerConfig(
+                conn_state=ConnStateConfig(
+                    max_open_conns_per_torrent=64, max_global_conns=4000
+                )
+            ),
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port

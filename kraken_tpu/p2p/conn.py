@@ -188,8 +188,16 @@ async def handshake_inbound(
     return theirs
 
 
+class PeerBusyError(WireError):
+    """The remote rejected the conn for CAPACITY, not misbehavior: callers
+    soft-blacklist (short, non-escalating) instead of the exponential
+    backoff a garbage handshake earns."""
+
+
 async def _read_handshake(reader: asyncio.StreamReader, timeout: float) -> HandshakeResult:
     msg = await asyncio.wait_for(recv_message(reader), timeout)
+    if msg.type == MsgType.ERROR and msg.header.get("code") == "busy":
+        raise PeerBusyError("peer at connection capacity")
     if msg.type != MsgType.HANDSHAKE:
         raise WireError(f"expected HANDSHAKE, got {msg.type.name}")
     h = msg.header

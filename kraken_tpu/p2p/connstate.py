@@ -21,6 +21,7 @@ class ConnStateConfig:
     max_open_conns_per_torrent: int = 10
     max_global_conns: int = 1000
     blacklist_expiry_seconds: float = 30.0
+    soft_blacklist_seconds: float = 2.0  # connectivity cool-off (no escalation)
     blacklist_backoff: Backoff = dataclasses.field(
         default_factory=lambda: Backoff(
             base_seconds=30.0, factor=2.0, max_seconds=600.0, jitter=0.1
@@ -37,11 +38,22 @@ class Blacklist:
         # (peer, info_hash) -> (until_ts, offense_count)
         self._entries: dict[tuple[PeerID, InfoHash], tuple[float, int]] = {}
 
-    def add(self, peer: PeerID, h: InfoHash, now: float | None = None) -> None:
+    def add(
+        self, peer: PeerID, h: InfoHash, now: float | None = None,
+        soft: bool = False,
+    ) -> None:
+        """``soft`` = connectivity failure (dial refused, peer at capacity):
+        short fixed cool-off, no offense escalation. A flash crowd that hits
+        a full seeder must retry within seconds, not back off for minutes
+        like a peer that served corrupt pieces."""
         now = time.monotonic() if now is None else now
         _until, count = self._entries.get((peer, h), (0.0, 0))
-        delay = self._config.blacklist_backoff.delay(count)
-        self._entries[(peer, h)] = (now + delay, count + 1)
+        if soft:
+            delay = self._config.soft_blacklist_seconds
+            self._entries[(peer, h)] = (max(_until, now + delay), count)
+        else:
+            delay = self._config.blacklist_backoff.delay(count)
+            self._entries[(peer, h)] = (now + delay, count + 1)
 
     def blocked(self, peer: PeerID, h: InfoHash, now: float | None = None) -> bool:
         now = time.monotonic() if now is None else now
@@ -78,6 +90,16 @@ class ConnState:
         if per_torrent >= self.config.max_open_conns_per_torrent:
             return False
         return self._count_global() < self.config.max_global_conns
+
+    def at_capacity(self, h: InfoHash) -> bool:
+        """Inbound-side check: no slot for another conn on this torrent
+        (the accept path rejects POLITELY with a busy frame so the dialer
+        soft-blacklists instead of escalating)."""
+        per_torrent = len(self._pending.get(h, ())) + len(self._active.get(h, ()))
+        return (
+            per_torrent >= self.config.max_open_conns_per_torrent
+            or self._count_global() >= self.config.max_global_conns
+        )
 
     def add_pending(self, peer: PeerID, h: InfoHash) -> bool:
         if not self.can_dial(peer, h):

@@ -33,13 +33,19 @@ def _bits_to_set(bits: bytes, num_pieces: int) -> set[int]:
 
 
 class _Peer:
-    __slots__ = ("conn", "has", "pump", "complete")
+    __slots__ = ("conn", "has", "pump", "complete", "last_useful", "serving")
 
-    def __init__(self, conn: Conn, has: set[int]):
+    def __init__(self, conn: Conn, has: set[int], now: float):
         self.conn = conn
         self.has = has
         self.pump: Optional[asyncio.Task] = None
         self.complete = False
+        # Last time this conn carried anything of value (payload, request,
+        # progress announce). Drives churn: a conn slot is a scarce
+        # resource and an idle-useless conn on a full seeder wedges flash
+        # crowds (everyone else is soft-blacklisted waiting for a slot).
+        self.last_useful = now
+        self.serving = 0  # concurrent _serve_piece tasks (flood bound)
 
 
 class Dispatcher:
@@ -54,11 +60,14 @@ class Dispatcher:
         torrent: Torrent,
         requests: RequestManager | None = None,
         on_peer_failure: Callable[[PeerID, str], None] | None = None,
+        churn_idle_seconds: float = 4.0,
     ):
         self.torrent = torrent
         self.requests = requests or RequestManager()
+        self.churn_idle = churn_idle_seconds
         self._on_peer_failure = on_peer_failure or (lambda p, r: None)
         self._peers: dict[PeerID, _Peer] = {}
+        self._io_tasks: set[asyncio.Task] = set()
         self.done: asyncio.Future[None] = asyncio.get_event_loop().create_future()
         if torrent.complete():
             self.done.set_result(None)
@@ -87,7 +96,7 @@ class Dispatcher:
             conn.close()
             self._on_peer_failure(conn.peer_id, str(e))
             return False
-        peer = _Peer(conn, has)
+        peer = _Peer(conn, has, asyncio.get_running_loop().time())
         self._peers[conn.peer_id] = peer
         peer.pump = asyncio.create_task(self._pump(peer))
         return True
@@ -113,26 +122,28 @@ class Dispatcher:
     def close(self) -> None:
         for pid in list(self._peers):
             self._drop_peer(pid)
+        for t in list(self._io_tasks):
+            t.cancel()
         if not self.done.done():
             self.done.cancel()
 
     # -- the pump ----------------------------------------------------------
 
     async def _pump(self, peer: _Peer) -> None:
+        """Recv pump. INVARIANT: never awaits a send -- a pump blocked on a
+        full send queue stops draining its recv queue, and under a swarm-
+        wide burst those stalls form a cycle (distributed send/recv
+        gridlock). All sending happens in _spawn_io tasks."""
         pid = peer.conn.peer_id
         try:
-            await self._request_more(peer)
+            self._spawn_io(peer, self._request_more(peer))
             while True:
                 msg = await peer.conn.recv()
                 await self._handle(peer, msg)
-        except ConnClosedError:
-            self._drop_peer(pid)
         except asyncio.CancelledError:
             raise
-        except PieceError as e:
-            self._drop_peer(pid, f"bad piece: {e}")
         except Exception as e:  # defensive: one peer must not kill the loop
-            self._drop_peer(pid, f"peer error: {e}")
+            self._fail_peer(pid, e)
 
     def _check_index(self, msg: Message) -> int:
         """Piece indices from the wire are untrusted: an out-of-range index
@@ -143,24 +154,79 @@ class Dispatcher:
             raise PieceError(f"piece index out of range: {idx!r}")
         return idx
 
+    def _spawn_io(self, peer: _Peer, coro) -> None:
+        """Run a storage-touching handler CONCURRENTLY with the recv pump.
+
+        Serializing verify->write->next-request per piece makes every piece
+        pay the full verifier batching delay (a batch of one) and blocks
+        payload N+1 behind payload N's disk write; with pipeline_limit
+        pieces in flight per conn the concurrency here is what lets the
+        batched verifier actually batch. Failures map to the same
+        drop-peer handling the pump applies (in a done callback: the task
+        must wrap ``coro`` directly, or cancellation before the first step
+        leaks a never-awaited coroutine)."""
+        t = asyncio.create_task(coro)
+
+        def done(task: asyncio.Task) -> None:
+            self._io_tasks.discard(task)
+            if task.cancelled():
+                return
+            exc = task.exception()
+            if exc is not None:
+                self._fail_peer(peer.conn.peer_id, exc)
+
+        self._io_tasks.add(t)
+        t.add_done_callback(done)
+
+    def _fail_peer(self, pid: PeerID, exc: BaseException) -> None:
+        """One exception->drop policy for the pump AND the io tasks."""
+        if isinstance(exc, ConnClosedError):
+            self._drop_peer(pid)
+        elif isinstance(exc, PieceError):
+            self._drop_peer(pid, f"bad piece: {exc}")
+        else:
+            self._drop_peer(pid, f"peer error: {exc}")
+
+    _MAX_SERVING_PER_PEER = 32  # concurrent serve tasks; a request flood
+    # beyond this is dropped (honest peers pipeline far less) -- without a
+    # bound, each pending serve holds a piece-sized buffer and a hostile
+    # leecher could drive a seeder to OOM.
+
+    async def _serve_piece(self, peer: _Peer, idx: int) -> None:
+        peer.serving += 1
+        try:
+            data = await self.torrent.read_piece_async(idx)
+            await peer.conn.send(Message.piece_payload(idx, data))
+        finally:
+            peer.serving -= 1
+
     async def _handle(self, peer: _Peer, msg: Message) -> None:
+        if msg.type in (
+            MsgType.PIECE_REQUEST, MsgType.PIECE_PAYLOAD,
+            MsgType.ANNOUNCE_PIECE, MsgType.COMPLETE,
+        ):
+            peer.last_useful = asyncio.get_running_loop().time()
         if msg.type == MsgType.PIECE_REQUEST:
             idx = self._check_index(msg)
-            if self.torrent.has_piece(idx):
-                data = await self.torrent.read_piece_async(idx)
-                await peer.conn.send(Message.piece_payload(idx, data))
+            if (
+                self.torrent.has_piece(idx)
+                and peer.serving < self._MAX_SERVING_PER_PEER
+            ):
+                self._spawn_io(peer, self._serve_piece(peer, idx))
         elif msg.type == MsgType.PIECE_PAYLOAD:
-            await self._on_payload(peer, self._check_index(msg), msg.payload)
+            self._spawn_io(
+                peer, self._on_payload(peer, self._check_index(msg), msg.payload)
+            )
         elif msg.type == MsgType.ANNOUNCE_PIECE:
             peer.has.add(self._check_index(msg))
-            await self._request_more(peer)
+            self._spawn_io(peer, self._request_more(peer))
         elif msg.type == MsgType.BITFIELD:
             peer.has = _bits_to_set(msg.payload, self.torrent.num_pieces)
-            await self._request_more(peer)
+            self._spawn_io(peer, self._request_more(peer))
         elif msg.type == MsgType.COMPLETE:
             peer.complete = True
             peer.has = set(range(self.torrent.num_pieces))
-            await self._request_more(peer)
+            self._spawn_io(peer, self._request_more(peer))
         elif msg.type == MsgType.CANCEL_PIECE:
             pass  # best-effort: payload may already be in flight
         elif msg.type == MsgType.ERROR:
@@ -194,6 +260,11 @@ class Dispatcher:
     async def _request_more(self, peer: _Peer) -> None:
         if self.torrent.complete():
             return
+        if self._peers.get(peer.conn.peer_id) is not peer:
+            # Dropped while this task was queued: selecting now would
+            # re-mark requests for a dead peer AFTER clear_peer ran,
+            # ghost-blocking those pieces until the hard expiry.
+            return
         chosen = self.requests.select(
             peer.conn.peer_id,
             peer.has,
@@ -206,7 +277,14 @@ class Dispatcher:
     # -- timers (driven by the scheduler) ----------------------------------
 
     async def tick(self) -> None:
-        """Periodic retry: re-request timed-out pieces across peers."""
+        """Periodic retry + churn: re-request timed-out pieces, and close
+        conns that have carried nothing useful for ``churn_idle`` seconds
+        (reference conn churn: frees scarce conn slots -- on a seeder, for
+        waiting leechers; on a leecher, for peers that actually have data)."""
+        now = asyncio.get_running_loop().time()
+        for pid, peer in list(self._peers.items()):
+            if now - peer.last_useful > self.churn_idle:
+                self._drop_peer(pid)  # no blacklist: idle, not misbehaving
         if self.torrent.complete():
             return
         for peer in list(self._peers.values()):

@@ -26,6 +26,7 @@ from kraken_tpu.core.peer import PeerID, PeerInfo
 from kraken_tpu.p2p.conn import (
     Conn,
     HandshakeResult,
+    PeerBusyError,
     handshake_inbound,
     handshake_outbound,
 )
@@ -33,8 +34,13 @@ from kraken_tpu.p2p.announcequeue import AnnounceQueue
 from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
 from kraken_tpu.p2p.dispatch import Dispatcher
 from kraken_tpu.p2p.networkevent import NoopProducer, Producer
+from kraken_tpu.p2p.piecerequest import RequestManager
 from kraken_tpu.p2p.storage import Torrent
-from kraken_tpu.p2p.wire import WireError
+from kraken_tpu.p2p.wire import Message, WireError, send_message
+
+
+class _AtCapacity(Exception):
+    """Inbound conn rejected for capacity (accept path sends a busy frame)."""
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.dedup import RequestCoalescer
 
@@ -64,6 +70,9 @@ class SchedulerConfig:
         max_announce_rate: float = 100.0,
         announce_tick_seconds: float = 0.2,
         seed_announce_interval_seconds: float | None = None,
+        piece_pipeline_limit: int = 16,
+        piece_timeout_seconds: float = 8.0,
+        conn_churn_idle_seconds: float = 4.0,
     ):
         self.announce_interval = announce_interval_seconds
         self.dial_timeout = dial_timeout_seconds
@@ -82,6 +91,12 @@ class SchedulerConfig:
             if seed_announce_interval_seconds is not None
             else announce_interval_seconds * 3
         )
+        # In-flight piece requests per conn. Measured (bench_swarm, loopback
+        # pair): 4 -> 71 MB/s, 16 -> 82, 64 -> 82 -- 16 saturates the
+        # request-response turnaround without deep per-peer buffering.
+        self.piece_pipeline_limit = piece_pipeline_limit
+        self.piece_timeout = piece_timeout_seconds
+        self.conn_churn_idle = conn_churn_idle_seconds
 
 
 class _TorrentControl:
@@ -218,7 +233,12 @@ class Scheduler:
         torrent = self.archive.create_torrent(metainfo)
         dispatcher = Dispatcher(
             torrent,
+            requests=RequestManager(
+                pipeline_limit=self.config.piece_pipeline_limit,
+                timeout_seconds=self.config.piece_timeout,
+            ),
             on_peer_failure=lambda pid, reason: self._peer_failed(pid, h, reason),
+            churn_idle_seconds=self.config.conn_churn_idle,
         )
         ctl = _TorrentControl(torrent, namespace, dispatcher)
         self._controls[h] = ctl
@@ -317,8 +337,16 @@ class Scheduler:
                 ctl.torrent.num_pieces,
                 timeout=self.config.dial_timeout,
             )
-        except (OSError, WireError, asyncio.TimeoutError):
+        except (PeerBusyError, OSError, asyncio.TimeoutError):
             self.conn_state.remove_pending(peer.peer_id, h)
+            # Connectivity failure (refused / at-capacity / timeout), not
+            # misbehavior: short soft cool-off so a flash crowd retries the
+            # seeder within seconds once churn frees its slots.
+            self.conn_state.blacklist.add(peer.peer_id, h, soft=True)
+            return
+        except WireError:
+            self.conn_state.remove_pending(peer.peer_id, h)
+            # Garbage handshake = misbehavior: exponential backoff.
             self.conn_state.blacklist.add(peer.peer_id, h)
             return
         # The handshaked identity wins over the (possibly stale) announced
@@ -339,6 +367,13 @@ class Scheduler:
             theirs = await handshake_inbound(
                 reader, writer, self.peer_id, self._bitfield_for
             )
+        except _AtCapacity:
+            # Polite rejection: the dialer must learn this is capacity,
+            # not misbehavior, so it soft-blacklists and retries soon.
+            with contextlib.suppress(Exception):
+                await send_message(writer, Message.error("busy"))
+            writer.close()
+            return
         except (OSError, WireError, KeyError, asyncio.TimeoutError):
             writer.close()
             return
@@ -356,6 +391,8 @@ class Scheduler:
         resolver loads its metainfo); agents only serve torrents they have
         live controls for. Raising KeyError rejects the conn.
         """
+        if self.conn_state.at_capacity(hs.info_hash):
+            raise _AtCapacity(hs.info_hash.hex)
         ctl = self._controls.get(hs.info_hash)
         if ctl is None:
             if self._metainfo_resolver is None:

@@ -161,20 +161,27 @@ def test_request_manager_pipeline_and_dedup():
 def test_request_manager_timeout_requeues():
     rm = RequestManager(pipeline_limit=4, timeout_seconds=5)
     rm.select(pid(1), {0}, [0], {}, now=0.0)
-    # before timeout: endgame duplicate to another peer allowed, same piece
-    assert rm.select(pid(2), {0}, [0], {}, now=1.0) == [0]
+    # A FRESH in-flight request is not duplicated (deep pipelines make
+    # "everything in flight" the normal state, not endgame).
+    assert rm.select(pid(2), {0}, [0], {}, now=1.0) == []
+    # Once the request goes stale (> timeout/4), a bounded rescue
+    # duplicate to another peer is allowed.
+    assert rm.select(pid(2), {0}, [0], {}, now=2.0) == [0]
     # after timeout both expire; fresh request allowed again
     assert rm.select(pid(1), {0}, [0], {}, now=20.0) == [0]
 
 
 def test_request_manager_endgame_duplicates():
-    rm = RequestManager(pipeline_limit=4)
+    rm = RequestManager(pipeline_limit=4)  # timeout 8 -> stale after 2
     assert rm.select(pid(1), {0, 1}, [0, 1], {}, now=0.0) == [0, 1] or True
-    got = rm.select(pid(2), {0, 1}, [0, 1], {}, now=0.0)
-    assert set(got) <= {0, 1} and got  # endgame: duplicates allowed
+    assert rm.select(pid(2), {0, 1}, [0, 1], {}, now=0.0) == []  # fresh
+    got = rm.select(pid(2), {0, 1}, [0, 1], {}, now=3.0)
+    assert set(got) <= {0, 1} and got  # stale: rescue duplicates allowed
+    # Duplication is bounded per piece: a third peer gets nothing.
+    assert rm.select(pid(3), {0, 1}, [0, 1], {}, now=3.5) == []
 
     rm.clear_piece(0)
-    assert 0 in rm.select(pid(3), {0}, [0], {}, now=0.0)
+    assert 0 in rm.select(pid(3), {0}, [0], {}, now=3.5)
 
 
 # -- batched verifier -------------------------------------------------------
