@@ -32,7 +32,7 @@ from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
-from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import instrument_app
 from kraken_tpu.p2p.connstate import ConnStateConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
@@ -44,7 +44,7 @@ from kraken_tpu.p2p.storage import (
 from kraken_tpu.store import CAStore
 from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 from kraken_tpu.tracker.client import TrackerClient
-from kraken_tpu.tracker.peerstore import InMemoryPeerStore
+from kraken_tpu.tracker.peerstore import InMemoryPeerStore, RedisPeerStore
 from kraken_tpu.tracker.server import TrackerServer
 
 _log = logging.getLogger("kraken.assembly")
@@ -67,7 +67,7 @@ async def _cleanup_loop(manager: CleanupManager) -> None:
 
 
 async def _serve(app: web.Application, host: str, port: int,
-                 component: str = ""):
+                 component: str = "", ssl_context=None):
     if component:
         # Per-endpoint latency/status metrics + GET /metrics on every
         # component app (lib/middleware + tally in the reference --
@@ -75,7 +75,7 @@ async def _serve(app: web.Application, host: str, port: int,
         instrument_app(app, component)
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
     actual = site._server.sockets[0].getsockname()[1]
     return runner, actual
@@ -86,15 +86,26 @@ class TrackerNode:
                  origin_cluster: ClusterClient | None = None,
                  announce_interval_seconds: float = 3.0,
                  peer_ttl_seconds: float = 30.0,
-                 ring_refresh_seconds: float = 5.0):
+                 ring_refresh_seconds: float = 5.0,
+                 redis_addr: str = "",
+                 ssl_context=None):
         self.host = host
         self.port = port
+        # Redis-protocol store: swarm survives tracker restarts and can be
+        # shared by several trackers; default in-memory store re-heals via
+        # TTL instead.
+        peer_store = (
+            RedisPeerStore(redis_addr, ttl_seconds=peer_ttl_seconds)
+            if redis_addr
+            else InMemoryPeerStore(ttl_seconds=peer_ttl_seconds)
+        )
         self.server = TrackerServer(
-            peer_store=InMemoryPeerStore(ttl_seconds=peer_ttl_seconds),
+            peer_store=peer_store,
             origin_cluster=origin_cluster,
             announce_interval_seconds=announce_interval_seconds,
         )
         self.ring_refresh = ring_refresh_seconds
+        self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None
         self._refresh_task: Optional[asyncio.Task] = None
 
@@ -104,7 +115,8 @@ class TrackerNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port, "tracker"
+            self.server.make_app(), self.host, self.port, "tracker",
+            ssl_context=self.ssl_context,
         )
         # The cluster's passive health filter only takes effect when the
         # ring re-resolves; refresh it periodically (resolved each tick:
@@ -117,7 +129,7 @@ class TrackerNode:
             cluster = self.server.origin_cluster
             try:
                 if cluster is not None:
-                    cluster.ring.refresh()
+                    await cluster.ring.refresh_async()
             except Exception:
                 pass
 
@@ -126,6 +138,7 @@ class TrackerNode:
             self._refresh_task.cancel()
         if self._runner:
             await self._runner.cleanup()
+        await self.server.peers.close()
 
 
 class OriginNode:
@@ -149,6 +162,7 @@ class OriginNode:
         hash_window_bytes: int = 256 * 1024 * 1024,
         health_interval_seconds: float = 5.0,
         health_fail_threshold: int = 3,
+        ssl_context=None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -189,6 +203,7 @@ class OriginNode:
         )
         self.health_interval = health_interval_seconds
         self.health_fail_threshold = health_fail_threshold
+        self.ssl_context = ssl_context
         self.monitor: Optional[ActiveMonitor] = None
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[OriginServer] = None
@@ -254,7 +269,8 @@ class OriginNode:
             cleanup=self.cleanup,
         )
         self._runner, self.http_port = await _serve(
-            self.server.make_app(), self.host, self.http_port, "origin"
+            self.server.make_app(), self.host, self.http_port, "origin",
+            ssl_context=self.ssl_context,
         )
         if not self.self_addr:
             self.self_addr = self.addr
@@ -290,7 +306,7 @@ class OriginNode:
     async def _probe_origin(self, host: str) -> bool:
         try:
             await self._health_http.get(
-                f"http://{host}/health", retry_5xx=False
+                f"{base_url(host)}/health", retry_5xx=False
             )
             return True
         except Exception:
@@ -300,11 +316,16 @@ class OriginNode:
         while True:
             await asyncio.sleep(self.health_interval)
             try:
+                # One resolve per tick: probe last refresh's membership,
+                # then refresh (which re-resolves off-loop -- DNS stalls
+                # must not freeze the node -- and fires _on_ring_change on
+                # membership change).
                 peers = [
-                    h for h in self.ring.all_hosts() if h != self.self_addr
+                    h for h in self.ring.resolved_hosts
+                    if h != self.self_addr
                 ]
                 await self.monitor.check_all(peers)
-                self.ring.refresh()  # fires _on_ring_change on membership change
+                await self.ring.refresh_async()
             except Exception:
                 pass
 
@@ -443,6 +464,7 @@ class AgentNode:
         hasher: str = "cpu",
         cleanup: CleanupConfig | None = None,
         scheduler_config: SchedulerConfig | None = None,
+        ssl_context=None,
     ):
         self.host = host
         self.http_port = http_port
@@ -454,6 +476,7 @@ class AgentNode:
         self.verifier = BatchedVerifier(hasher=get_hasher(hasher))
         self.cleanup = CleanupManager(self.store, cleanup) if cleanup else None
         self.scheduler_config = scheduler_config
+        self.ssl_context = ssl_context
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[AgentServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -489,7 +512,8 @@ class AgentNode:
             self.store, self.scheduler, cleanup=self.cleanup
         )
         self._runner, self.http_port = await _serve(
-            self.server.make_app(), self.host, self.http_port, "agent"
+            self.server.make_app(), self.host, self.http_port, "agent",
+            ssl_context=self.ssl_context,
         )
         if self.cleanup is not None:
             self._cleanup_task = asyncio.create_task(
@@ -507,7 +531,7 @@ class AgentNode:
             )
             self._registry_runner, self.registry_port = await _serve(
                 registry.make_app(), self.host, self.registry_port,
-                "agent-registry",
+                "agent-registry", ssl_context=self.ssl_context,
             )
 
     async def stop(self) -> None:

@@ -16,7 +16,7 @@ from kraken_tpu.backend.base import (
     BlobNotFoundError,
     register_backend,
 )
-from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
 
 
 @register_backend("testfs")
@@ -26,7 +26,7 @@ class TestFSClient(BackendClient):
         self._http = HTTPClient(retries=config.get("retries", 3))
 
     def _url(self, name: str) -> str:
-        return f"http://{self.addr}/files/{name}"
+        return f"{base_url(self.addr)}/files/{name}"
 
     async def stat(self, namespace: str, name: str) -> BlobInfo:
         try:
@@ -49,7 +49,7 @@ class TestFSClient(BackendClient):
         await self._http.put(self._url(name), data=data)
 
     async def list(self, prefix: str) -> list[str]:
-        body = await self._http.get(f"http://{self.addr}/list/{prefix}")
+        body = await self._http.get(f"{base_url(self.addr)}/list/{prefix}")
         return [l for l in body.decode().splitlines() if l]
 
     async def close(self) -> None:

@@ -27,7 +27,7 @@ from kraken_tpu.buildindex.tagstore import TagStore
 from kraken_tpu.buildindex.tagtype import DependencyResolver
 from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
-from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.httputil import HTTPClient, base_url
 
 REPLICATE_KIND = "tag_replicate"
 
@@ -98,7 +98,7 @@ class TagServer:
         remote = task.payload["remote"]
         tag = task.payload["tag"]
         await self._http.post(
-            f"http://{remote}/internal/replicate",
+            f"{base_url(remote)}/internal/replicate",
             data=json.dumps(
                 {
                     "tag": tag,
@@ -160,22 +160,22 @@ class TagClient:
     async def put(self, tag: str, d: Digest, replicate: bool = False) -> None:
         suffix = "/replicate" if replicate else ""
         await self._http.put(
-            f"http://{self.addr}/tags/{quote(tag, safe='')}/digest/{d.hex}{suffix}",
+            f"{base_url(self.addr)}/tags/{quote(tag, safe='')}/digest/{d.hex}{suffix}",
             ok_statuses=(200,),
         )
 
     async def get(self, tag: str) -> Digest:
-        body = await self._http.get(f"http://{self.addr}/tags/{quote(tag, safe='')}")
+        body = await self._http.get(f"{base_url(self.addr)}/tags/{quote(tag, safe='')}")
         return Digest.parse(body.decode())
 
     async def list_repo(self, repo: str) -> list[str]:
         body = await self._http.get(
-            f"http://{self.addr}/repositories/{quote(repo, safe='')}/tags"
+            f"{base_url(self.addr)}/repositories/{quote(repo, safe='')}/tags"
         )
         return json.loads(body)
 
     async def list_all(self) -> list[str]:
-        body = await self._http.get(f"http://{self.addr}/internal/tags")
+        body = await self._http.get(f"{base_url(self.addr)}/internal/tags")
         return json.loads(body)
 
     async def close(self) -> None:

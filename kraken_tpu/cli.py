@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import signal
 import sys
 
@@ -89,6 +90,19 @@ def main(argv: list[str] | None = None) -> None:
     cleanup_cfg = cfg.get("cleanup")
     cleanup = CleanupConfig(**cleanup_cfg) if cleanup_cfg else None
 
+    # YAML: tls: {cert: path, key: path} -- terminate TLS on the HTTP
+    # listener (the reference fronts components with nginx; here the
+    # listener itself terminates). Outbound trust of a private CA comes
+    # from SSL_CERT_FILE (honored by aiohttp's default verification);
+    # TLS-fronted peers are addressed as https://host:port.
+    tls_cfg = cfg.get("tls")
+    ssl_context = None
+    if tls_cfg:
+        import ssl
+
+        ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(tls_cfg["cert"], tls_cfg["key"])
+
     host = pick(args.host, "host", "127.0.0.1")
     port = pick(args.port, "port", 0)
 
@@ -110,6 +124,8 @@ def main(argv: list[str] | None = None) -> None:
             host=host, port=port, origin_cluster=cluster,
             announce_interval_seconds=cfg.get("announce_interval_seconds", 3.0),
             peer_ttl_seconds=cfg.get("peer_ttl_seconds", 30.0),
+            redis_addr=cfg.get("peerstore_redis", ""),
+            ssl_context=ssl_context,
         )
         asyncio.run(_run_until_signal(node, {"component": "tracker"}))
 
@@ -119,13 +135,43 @@ def main(argv: list[str] | None = None) -> None:
         cluster_addrs = [
             a for a in (pick(args.cluster, "cluster", "") or "").split(",") if a
         ]
+        # YAML: cluster_dns: "origins.example.com:80" -- membership from
+        # DNS A/AAAA records instead of a static list.
+        cluster_dns = cfg.get("cluster_dns", "")
+        if cluster_addrs and cluster_dns:
+            parser.error(
+                "--cluster and cluster_dns are mutually exclusive -- a"
+                " static list would silently shadow DNS-driven membership"
+            )
+        if cluster_addrs:
+            hosts = HostList(static=cluster_addrs)
+        elif cluster_dns:
+            # Homogeneous-cluster assumption: when this origin terminates
+            # TLS, its DNS-resolved peers do too.
+            hosts = HostList.from_dns(
+                cluster_dns, scheme="https" if ssl_context else ""
+            )
+        else:
+            hosts = None
         ring = (
-            Ring(HostList(static=cluster_addrs),
-                 max_replica=cfg.get("max_replica", 3))
-            if cluster_addrs
+            Ring(hosts, max_replica=cfg.get("max_replica", 3))
+            if hosts is not None
             else None
         )
         self_addr = pick(args.self_addr, "self_addr", "")
+        if cluster_dns and not self_addr:
+            parser.error("cluster_dns requires --self-addr")
+        if cluster_dns and ring is not None and self_addr not in ring.members:
+            # Not fatal (DNS may not have propagated this node yet), but a
+            # format mismatch -- e.g. a hostname self-addr vs resolved
+            # ip:port members -- means ownership checks never match and the
+            # node would probe and re-replicate to itself forever.
+            logging.getLogger("kraken.cli").warning(
+                "--self-addr %r is not among the DNS-resolved members %s; "
+                "it must match the resolver's output format (ip:port%s)",
+                self_addr, ring.members,
+                ", https://ip:port with tls" if ssl_context else "",
+            )
         if cluster_addrs and self_addr and self_addr not in cluster_addrs:
             parser.error(
                 f"--self-addr {self_addr!r} does not appear in --cluster"
@@ -153,6 +199,7 @@ def main(argv: list[str] | None = None) -> None:
             ring=ring,
             self_addr=self_addr,
             cleanup=cleanup,
+            ssl_context=ssl_context,
         )
         asyncio.run(_run_until_signal(node, {"component": "origin"}))
 
@@ -165,6 +212,7 @@ def main(argv: list[str] | None = None) -> None:
             p2p_port=pick(args.p2p_port, "p2p_port", 0),
             hasher=pick(args.hasher, "hasher", "cpu"),
             cleanup=cleanup,
+            ssl_context=ssl_context,
         )
         asyncio.run(_run_until_signal(node, {"component": "agent"}))
 

@@ -234,6 +234,11 @@ def _candidate_indices(
         padded = next_pow2(n)
         if padded != n:
             arr = np.concatenate([arr[:n], np.zeros(padded - n, dtype=np.uint8)])
+        else:
+            # Copy: jnp.asarray on CPU may alias the numpy buffer and
+            # release it asynchronously; callers hand us mmap-backed views
+            # whose close() must not race a device transfer (BufferError).
+            arr = np.array(arr[:n], copy=True)
         strict, loose = _gear_candidates(
             jnp.asarray(arr), params.mask_strict, params.mask_loose
         )

@@ -17,7 +17,7 @@ from kraken_tpu.core.peer import BlobInfo
 from kraken_tpu.placement.hashring import Ring
 from urllib.parse import quote
 
-from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
 
 _RAISE = object()  # _try_each sentinel: no default, raise on exhaustion
 
@@ -30,7 +30,7 @@ class BlobClient:
         self._http = http or HTTPClient()
 
     def _url(self, path: str) -> str:
-        return f"http://{self.addr}{path}"
+        return f"{base_url(self.addr)}{path}"
 
     async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
         try:

@@ -99,6 +99,7 @@ class DedupIndex:
         params: CDCParams | None = None,
         num_hashes: int = 128,
         num_bands: int = 32,
+        max_blobs: int = 200_000,
     ):
         self.store = store
         self.hasher = hasher or get_hasher("cpu")
@@ -106,7 +107,13 @@ class DedupIndex:
         self.minhasher = MinHasher(num_hashes=num_hashes)
         self._index = LSHIndex(self.minhasher, num_bands=num_bands)
         self._lock = threading.Lock()
-        self._indexed: set[str] = set()
+        # Insertion-ordered (dict keys): beyond max_blobs the OLDEST
+        # indexed blob leaves the in-memory index (its sidecar stays on
+        # disk, so it re-admits on next touch) -- the ledger and LSH
+        # tables are otherwise unbounded at the survey's 1M-chunk-set
+        # scale. ~O(1 KB)/blob in-memory => default caps near 200 MB.
+        self.max_blobs = max_blobs
+        self._indexed: dict[str, None] = {}
         # Chunk ledger: 64-bit fp -> refcount across indexed blobs. Drives
         # the exact corpus dedup accounting (duplicate bytes / total bytes)
         # and supports removal: invariant is
@@ -185,13 +192,28 @@ class DedupIndex:
                         record = self._compute_record(memoryview(mm))
             self.store.set_metadata(d, record)
         self._admit(d, record)
+        self._evict_over_cap(keep=d.hex)
         return record
+
+    def _evict_over_cap(self, keep: str) -> None:
+        """Bound the in-memory index: oldest admitted leaves first (its
+        sidecar persists; a later touch re-admits it)."""
+        while True:
+            # Pick the victim under the lock (remove_sync re-acquires it;
+            # concurrent _admit/remove otherwise race the dict iteration).
+            with self._lock:
+                if len(self._indexed) <= self.max_blobs:
+                    return
+                oldest = next(iter(self._indexed))
+            if oldest == keep:
+                return
+            self.remove_sync(Digest.from_hex(oldest))
 
     def _admit(self, d: Digest, record: ChunkSketchMetadata) -> None:
         with self._lock:
             if d.hex in self._indexed:
                 return
-            self._indexed.add(d.hex)
+            self._indexed[d.hex] = None
             self._index.add(d.hex, record.sketch)
             for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):
                 self.total_bytes += size
@@ -213,7 +235,7 @@ class DedupIndex:
         with self._lock:
             if d.hex not in self._indexed:
                 return False
-            self._indexed.discard(d.hex)
+            self._indexed.pop(d.hex, None)
             self._index.remove(d.hex)
             if record is None:
                 return True
@@ -237,6 +259,8 @@ class DedupIndex:
         startup); returns the number admitted."""
         n = 0
         for d in self.store.list_cache_digests():
+            if n >= self.max_blobs:
+                break  # cap applies at startup too; the rest re-admit on touch
             record = self._load_record(d)
             if record is not None:
                 self._admit(d, record)

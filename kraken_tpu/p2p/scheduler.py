@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 from typing import Optional, Protocol
 
 from kraken_tpu.core.digest import Digest
@@ -39,10 +40,14 @@ from kraken_tpu.p2p.storage import Torrent
 from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 
-class _AtCapacity(Exception):
-    """Inbound conn rejected for capacity (accept path sends a busy frame)."""
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.dedup import RequestCoalescer
+
+_log = logging.getLogger("kraken.p2p")
+
+
+class _AtCapacity(Exception):
+    """Inbound conn rejected for capacity (accept path sends a busy frame)."""
 
 
 class MetaInfoClient(Protocol):
@@ -196,9 +201,25 @@ class Scheduler:
         await self._coalescer.get(d.hex, lambda: self._download(namespace, d))
 
     async def _download(self, namespace: str, d: Digest) -> None:
+        start = asyncio.get_running_loop().time()
         metainfo = await self.metainfo_client.get(namespace, d)
         ctl = self._get_or_create_control(metainfo, namespace)
         await asyncio.shield(ctl.dispatcher.done)
+        # Per-torrent lifecycle summary (the reference's torrentlog):
+        # one line per completed download with the operative numbers.
+        _log.info(
+            "torrent complete",
+            extra={
+                "digest": d.hex,
+                "namespace": namespace,
+                "bytes": metainfo.length,
+                "pieces": metainfo.num_pieces,
+                "seconds": round(
+                    asyncio.get_running_loop().time() - start, 3
+                ),
+                "peers": ctl.dispatcher.num_peers,
+            },
+        )
         # Become discoverable as a seeder immediately (still rate-paced).
         self._announce_queue.schedule(metainfo.info_hash, 0.0)
         if not self.config.seed_on_complete:

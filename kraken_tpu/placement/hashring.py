@@ -35,6 +35,7 @@ class Ring:
         self.max_replica = max_replica
         self._health_filter = health_filter
         self._members: list[str] = []
+        self._resolved: list[str] = []
         self._listeners: list[Callable[[list[str]], None]] = []
         self.refresh()
 
@@ -46,6 +47,13 @@ class Ring:
         """Unfiltered membership -- what health monitors must keep probing
         (a host filtered out of ``members`` still needs probes to recover)."""
         return self._hosts.resolve()
+
+    @property
+    def resolved_hosts(self) -> list[str]:
+        """The unfiltered host list from the most recent refresh -- lets
+        periodic loops probe and refresh with ONE resolve per tick (DNS
+        resolution is not free)."""
+        return list(self._resolved)
 
     def on_change(self, fn: Callable[[list[str]], None]) -> None:
         self._listeners.append(fn)
@@ -63,7 +71,20 @@ class Ring:
 
     def refresh(self) -> bool:
         """Re-resolve + re-filter membership; returns True if it changed."""
-        hosts = self._hosts.resolve()
+        return self._apply(self._hosts.resolve())
+
+    async def refresh_async(self) -> bool:
+        """`refresh` with the resolve off-loop: a DNS-backed HostList can
+        block for a resolver timeout, which must not freeze the event loop
+        (the node would fail its own health probes). Filtering and change
+        notification still run on the loop, so ``on_change`` listeners may
+        schedule tasks."""
+        import asyncio
+
+        return self._apply(await asyncio.to_thread(self._hosts.resolve))
+
+    def _apply(self, hosts: list[str]) -> bool:
+        self._resolved = list(hosts)
         if self._health_filter is not None:
             hosts = self._health_filter(hosts)
         hosts = sorted(hosts)

@@ -14,7 +14,7 @@ from kraken_tpu.core.metainfo import InfoHash, MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
 from urllib.parse import quote
 
-from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.httputil import HTTPClient, base_url
 
 
 class TrackerClient:
@@ -47,7 +47,7 @@ class TrackerClient:
             complete=complete,
         )
         body = await self._http.post(
-            f"http://{self.addr}/announce",
+            f"{base_url(self.addr)}/announce",
             data=json.dumps({"info_hash": h.hex, "peer": me.to_dict()}),
         )
         doc = json.loads(body)
@@ -55,7 +55,7 @@ class TrackerClient:
 
     async def get(self, namespace: str, d: Digest) -> MetaInfo:
         raw = await self._http.get(
-            f"http://{self.addr}/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
+            f"{base_url(self.addr)}/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
         )
         return MetaInfo.deserialize(raw)
 

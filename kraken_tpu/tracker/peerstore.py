@@ -2,27 +2,42 @@
 
 Mirrors uber/kraken ``tracker/peerstore`` (Redis SETEX-style TTL records;
 dead agents vanish from handouts when their announces stop) -- upstream
-path, unverified; SURVEY.md SS2.4/SS5. The production reference needs an
-external Redis; here the default is an in-process TTL dict behind the same
-interface (this environment has no Redis server; the seam stays so a
-redis-protocol store can drop in).
+path, unverified; SURVEY.md SS2.4/SS5. Two implementations behind one
+async interface:
+
+- :class:`InMemoryPeerStore` -- per-process TTL dict (default; tracker
+  state dies with the process, TTL re-heals the swarm on restart).
+- :class:`RedisPeerStore` -- speaks RESP to a real Redis (or compatible)
+  server, stdlib-only, so tracker restarts keep the swarm and multiple
+  trackers can share one store. One HASH per swarm (``swarm:<info_hash>``,
+  field = peer id, value = peer json with an embedded absolute expiry), so
+  reads are O(swarm size), never O(keyspace); the whole key gets EXPIREd
+  on every announce so idle swarms vanish from Redis wholesale, and
+  per-peer expiry is enforced on read from the embedded timestamp (with
+  lazy HDEL of the dead fields).
 """
 
 from __future__ import annotations
 
+import asyncio
+import json
 import time
+from typing import Optional
 
 from kraken_tpu.core.peer import PeerInfo
 
 
 class PeerStore:
-    """Interface: update a peer's announce record, list live peers."""
+    """Interface: record a peer's announce, list live peers."""
 
-    def update(self, info_hash: str, peer: PeerInfo) -> None:
+    async def update(self, info_hash: str, peer: PeerInfo) -> None:
         raise NotImplementedError
 
-    def get_peers(self, info_hash: str, limit: int = 50) -> list[PeerInfo]:
+    async def get_peers(self, info_hash: str, limit: int = 50) -> list[PeerInfo]:
         raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
 
 
 class InMemoryPeerStore(PeerStore):
@@ -31,12 +46,14 @@ class InMemoryPeerStore(PeerStore):
         # info_hash -> peer_id hex -> (expiry, PeerInfo)
         self._swarms: dict[str, dict[str, tuple[float, PeerInfo]]] = {}
 
-    def update(self, info_hash: str, peer: PeerInfo, now: float | None = None) -> None:
+    async def update(
+        self, info_hash: str, peer: PeerInfo, now: float | None = None
+    ) -> None:
         now = time.monotonic() if now is None else now
         swarm = self._swarms.setdefault(info_hash, {})
         swarm[peer.peer_id.hex] = (now + self.ttl, peer)
 
-    def get_peers(
+    async def get_peers(
         self, info_hash: str, limit: int = 50, now: float | None = None
     ) -> list[PeerInfo]:
         now = time.monotonic() if now is None else now
@@ -47,3 +64,167 @@ class InMemoryPeerStore(PeerStore):
             if expiry <= now:
                 del swarm[pid]
         return [p for _e, p in swarm.values()][:limit]
+
+
+class RespError(Exception):
+    """Server-side RESP error reply."""
+
+
+class _RespConn:
+    """One RESP connection: encode commands, decode replies."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+
+    @staticmethod
+    def _encode(args) -> bytes:
+        out = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            if isinstance(a, int):
+                a = str(a)
+            if isinstance(a, str):
+                a = a.encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        return b"".join(out)
+
+    async def command(self, *args: str | bytes | int):
+        self.writer.write(self._encode(args))
+        await self.writer.drain()
+        return await self._read_reply()
+
+    async def pipeline(self, *commands):
+        """Send several commands in one write, read all replies -- one RTT
+        instead of len(commands)."""
+        self.writer.write(b"".join(self._encode(c) for c in commands))
+        await self.writer.drain()
+        return [await self._read_reply() for _ in commands]
+
+    async def _read_reply(self):
+        line = (await self.reader.readline()).rstrip(b"\r\n")
+        if not line:
+            raise ConnectionError("redis connection closed")
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await self.reader.readexactly(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [await self._read_reply() for _ in range(n)]
+        raise RespError(f"unknown RESP type {kind!r}")
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class RedisPeerStore(PeerStore):
+    """Swarm records in a Redis-protocol server (one conn, serialized by a
+    lock -- announce volume is paced by the announce queue upstream)."""
+
+    def __init__(
+        self,
+        addr: str,
+        ttl_seconds: float = 30.0,
+        timeout_seconds: float = 5.0,
+    ):
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host, int(port)
+        self.ttl = max(1, int(ttl_seconds))
+        # Per-command deadline: a blackholed Redis must fail announces
+        # fast (500s the swarm can retry), not wedge every handler behind
+        # the connection lock forever.
+        self.timeout = timeout_seconds
+        self._conn: Optional[_RespConn] = None
+        self._lock = asyncio.Lock()
+
+    async def _get_conn(self) -> _RespConn:
+        if self._conn is None:
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            self._conn = _RespConn(reader, writer)
+        return self._conn
+
+    async def _run(self, op):
+        """Run ``op(conn)`` with a deadline and a single reconnect retry.
+        ANY failed attempt -- including the retry -- invalidates the
+        connection: a timed-out command leaves the stream mid-frame, and
+        reusing it would desync every later reply by one."""
+        async with self._lock:
+            for attempt in (0, 1):
+                try:
+                    conn = await self._get_conn()
+                    return await asyncio.wait_for(op(conn), self.timeout)
+                except (ConnectionError, OSError,
+                        asyncio.IncompleteReadError, asyncio.TimeoutError):
+                    # IncompleteReadError is an EOFError, not a
+                    # ConnectionError: the server died mid-reply.
+                    if self._conn is not None:
+                        self._conn.close()
+                    self._conn = None
+                    if attempt:
+                        raise
+
+    async def _cmd(self, *args):
+        return await self._run(lambda conn: conn.command(*args))
+
+    @staticmethod
+    def _key(info_hash: str) -> str:
+        return f"swarm:{info_hash}"
+
+    async def update(self, info_hash: str, peer: PeerInfo) -> None:
+        doc = peer.to_dict()
+        # Absolute wall-clock expiry: trackers sharing the store are
+        # NTP-synced in any deployment where they share a Redis.
+        doc["_expiry"] = time.time() + self.ttl
+        key = self._key(info_hash)
+        # One pipelined round trip; the commands land in Redis's input
+        # buffer together, so there is no window where the HSET executed
+        # but the EXPIRE (which keeps the swarm key from outliving its
+        # announcers) is lost.
+        await self._run(lambda conn: conn.pipeline(
+            ("HSET", key, peer.peer_id.hex, json.dumps(doc)),
+            ("EXPIRE", key, self.ttl),
+        ))
+
+    async def get_peers(self, info_hash: str, limit: int = 50) -> list[PeerInfo]:
+        reply = await self._cmd("HGETALL", self._key(info_hash))
+        if not reply:
+            return []
+        now = time.time()
+        out: list[PeerInfo] = []
+        dead: list[bytes] = []
+        for field, value in zip(reply[0::2], reply[1::2]):
+            try:
+                doc = json.loads(value)
+                expiry = float(doc.pop("_expiry", 0))
+                if expiry <= now:
+                    # Lazy reap, with one full TTL of grace: HDEL is not
+                    # atomic with the HGETALL snapshot, so a freshly-expired
+                    # field might have been re-HSET by a concurrent
+                    # announce -- deleting it would drop a live peer until
+                    # its next announce. A field dead for a whole extra TTL
+                    # has no concurrent announcer in practice.
+                    if expiry <= now - self.ttl:
+                        dead.append(field)
+                    continue
+                out.append(PeerInfo.from_dict(doc))
+            except (ValueError, KeyError):
+                dead.append(field)
+        if dead:
+            await self._cmd("HDEL", self._key(info_hash), *dead)
+        return out[:limit]
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None

@@ -57,14 +57,19 @@ class TrackerServer:
             peer = PeerInfo.from_dict(doc["peer"])
         except (json.JSONDecodeError, KeyError, ValueError) as e:
             raise web.HTTPBadRequest(text=f"malformed announce: {e}")
-        # Hand out peers BEFORE recording the announcer so a first announce
-        # never returns the announcer itself.
+        # Record BEFORE reading: the store calls suspend the handler, so a
+        # flash crowd of first announces handled read-first would all
+        # snapshot the swarm before any write landed and every one would
+        # get an empty handout. Writing first makes concurrent announcers
+        # visible to each other; the announcer itself is filtered out of
+        # its own handout below (hence the +1 overfetch).
+        await self.peers.update(info_hash, peer)
+        candidates = await self.peers.get_peers(
+            info_hash, limit=self.handout_limit + 1
+        )
         others = [
-            p
-            for p in self.peers.get_peers(info_hash, limit=self.handout_limit + 1)
-            if p.peer_id != peer.peer_id
+            p for p in candidates if p.peer_id != peer.peer_id
         ][: self.handout_limit]
-        self.peers.update(info_hash, peer)
         return web.json_response(
             {
                 "peers": [p.to_dict() for p in self.policy(others)],

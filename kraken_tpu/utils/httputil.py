@@ -32,6 +32,15 @@ class StatusError(HTTPError):
     pass
 
 
+def base_url(addr: str) -> str:
+    """Cluster addresses are ``host:port`` by default; an explicit
+    ``http://`` / ``https://`` prefix selects the scheme, so TLS-fronted
+    components are reachable by listing them as ``https://host:port``."""
+    if addr.startswith(("http://", "https://")):
+        return addr
+    return f"http://{addr}"
+
+
 def is_status(err: Exception, status: int) -> bool:
     return isinstance(err, HTTPError) and err.status == status
 
@@ -57,15 +66,26 @@ class HTTPClient:
         timeout_seconds: float = 60.0,
         retries: int = 3,
         backoff: Backoff | None = None,
+        ssl=None,
     ):
         self._timeout = aiohttp.ClientTimeout(total=timeout_seconds)
         self._retries = retries
         self._backoff = backoff or Backoff()
+        # ssl.SSLContext for https:// peers signed by a private CA; None
+        # uses aiohttp's default verification against the system store.
+        self._ssl = ssl
         self._session: aiohttp.ClientSession | None = None
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(timeout=self._timeout)
+            connector = (
+                aiohttp.TCPConnector(ssl=self._ssl)
+                if self._ssl is not None
+                else None
+            )
+            self._session = aiohttp.ClientSession(
+                timeout=self._timeout, connector=connector
+            )
         return self._session
 
     async def close(self) -> None:

@@ -1,0 +1,363 @@
+"""Parity nibbles: Redis-protocol peerstore, DNS hostlist, TLS listener,
+bounded dedup index (VERDICT r2 next #10 + weak #6/#7).
+"""
+
+import asyncio
+import os
+import ssl
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.placement.hostlist import HostList
+from kraken_tpu.tracker.peerstore import RedisPeerStore
+
+
+# -- fake Redis (RESP server; HSET/EXPIRE/HGETALL/HDEL surface) --------------
+
+
+class FakeRedis:
+    """In-memory RESP server covering what RedisPeerStore uses (HSET /
+    EXPIRE / HGETALL / HDEL). Verifies the client's protocol encoding
+    byte-for-byte by parsing it for real."""
+
+    __test__ = False
+
+    def __init__(self):
+        self.hashes: dict[bytes, dict[bytes, bytes]] = {}
+        self.expiry: dict[bytes, float] = {}  # key -> absolute deadline
+        self.addr = ""
+        self._server = None
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                line = (await reader.readline()).rstrip(b"\r\n")
+                if not line:
+                    return
+                assert line[:1] == b"*", f"expected array, got {line!r}"
+                args = []
+                for _ in range(int(line[1:])):
+                    lenline = (await reader.readline()).rstrip(b"\r\n")
+                    assert lenline[:1] == b"$"
+                    n = int(lenline[1:])
+                    args.append((await reader.readexactly(n + 2))[:-2])
+                reply = self._dispatch(args)
+                writer.write(reply)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        finally:
+            # 3.12's Server.wait_closed() waits for every handler's
+            # transport to close; an unclosed writer hangs teardown.
+            writer.close()
+
+    def _live(self, key: bytes, now: float) -> dict[bytes, bytes] | None:
+        if self.expiry.get(key, float("inf")) <= now:
+            self.hashes.pop(key, None)
+            self.expiry.pop(key, None)
+            return None
+        return self.hashes.get(key)
+
+    def _dispatch(self, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        now = time.monotonic()
+        if cmd == b"HSET":
+            key, field, val = args[1], args[2], args[3]
+            h = self._live(key, now)
+            if h is None:
+                h = self.hashes.setdefault(key, {})
+                self.expiry.pop(key, None)
+            created = 0 if field in h else 1
+            h[field] = val
+            return b":%d\r\n" % created
+        if cmd == b"EXPIRE":
+            key, ttl = args[1], int(args[2])
+            if self._live(key, now) is None:
+                return b":0\r\n"
+            self.expiry[key] = now + ttl
+            return b":1\r\n"
+        if cmd == b"HGETALL":
+            h = self._live(args[1], now) or {}
+            out = b"*%d\r\n" % (2 * len(h))
+            for f, v in h.items():
+                out += b"$%d\r\n%s\r\n" % (len(f), f)
+                out += b"$%d\r\n%s\r\n" % (len(v), v)
+            return out
+        if cmd == b"HDEL":
+            h = self._live(args[1], now) or {}
+            removed = 0
+            for f in args[2:]:
+                if h.pop(f, None) is not None:
+                    removed += 1
+            return b":%d\r\n" % removed
+        return b"-ERR unknown command\r\n"
+
+    async def __aenter__(self):
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        port = self._server.sockets[0].getsockname()[1]
+        self.addr = f"127.0.0.1:{port}"
+        return self
+
+    async def __aexit__(self, *exc):
+        self._server.close()
+        await self._server.wait_closed()
+
+
+def _peer(i: int, complete=False) -> PeerInfo:
+    return PeerInfo(
+        peer_id=PeerID(bytes([i]).hex() * 20), ip="10.0.0.%d" % i,
+        port=7000 + i, complete=complete,
+    )
+
+
+def test_redis_peerstore_roundtrip_and_ttl():
+    async def main():
+        async with FakeRedis() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=1)
+            await store.update("hash1", _peer(1))
+            await store.update("hash1", _peer(2, complete=True))
+            await store.update("hash2", _peer(3))
+
+            got = await store.get_peers("hash1")
+            assert {p.ip for p in got} == {"10.0.0.1", "10.0.0.2"}
+            assert any(p.complete for p in got)
+            assert len(await store.get_peers("hash2")) == 1
+            assert await store.get_peers("nope") == []
+
+            # TTL: rewrite each record's embedded expiry into the past --
+            # the read path must treat those peers as gone (and reap them).
+            import json as _json
+
+            for key, h in srv.hashes.items():
+                for f, v in list(h.items()):
+                    doc = _json.loads(v)
+                    doc["_expiry"] = 0
+                    h[f] = _json.dumps(doc).encode()
+            assert await store.get_peers("hash1") == []
+            assert srv.hashes[b"swarm:hash1"] == {}  # lazily reaped
+            await store.close()
+
+    asyncio.run(main())
+
+
+def test_redis_peerstore_glob_metachars_stay_literal():
+    """Info hashes are opaque strings: ones containing glob/driver
+    metacharacters address exactly their own swarm hash key."""
+    async def main():
+        async with FakeRedis() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30)
+            await store.update("a*", _peer(1))
+            await store.update("aZ", _peer(2))
+            got = await store.get_peers("a*")
+            assert [p.ip for p in got] == ["10.0.0.1"]
+            assert len(await store.get_peers("aZ")) == 1
+            await store.close()
+
+    asyncio.run(main())
+
+
+def test_redis_peerstore_survives_server_restart():
+    async def main():
+        async with FakeRedis() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30)
+            await store.update("h", _peer(1))
+            # Kill the conn under the client; next call must reconnect.
+            store._conn.close()
+            got = await store.get_peers("h")
+            assert len(got) == 1
+            await store.close()
+
+    asyncio.run(main())
+
+
+def test_tracker_uses_redis_store(tmp_path):
+    """End to end: a TrackerNode backed by the Redis-protocol store hands
+    out peers recorded by other announcers."""
+    from aiohttp import ClientSession
+
+    from kraken_tpu.assembly import TrackerNode
+
+    async def main():
+        async with FakeRedis() as srv:
+            tracker = TrackerNode(redis_addr=srv.addr)
+            await tracker.start()
+            try:
+                async with ClientSession() as http:
+                    async def announce(peer):
+                        async with http.post(
+                            f"http://{tracker.addr}/announce",
+                            json={"info_hash": "abc",
+                                  "peer": peer.to_dict()},
+                        ) as r:
+                            assert r.status == 200
+                            return (await r.json())["peers"]
+
+                    assert await announce(_peer(1)) == []
+                    got = await announce(_peer(2))
+                    assert [p["ip"] for p in got] == ["10.0.0.1"]
+            finally:
+                await tracker.stop()
+
+    asyncio.run(main())
+
+
+# -- DNS hostlist ------------------------------------------------------------
+
+
+def test_hostlist_from_dns(monkeypatch):
+    import socket as socket_mod
+
+    answers = [[("10.0.0.1",), ("10.0.0.2",)]]
+
+    def fake_getaddrinfo(name, port, family=0, proto=0):
+        assert name == "origins.internal" and port == 8080
+        assert family == socket_mod.AF_INET
+        if answers[0] is None:
+            raise OSError("dns down")
+        return [
+            (socket_mod.AF_INET, socket_mod.SOCK_STREAM, 6, "", (a[0], port))
+            for a in answers[0]
+        ]
+
+    monkeypatch.setattr(
+        "kraken_tpu.placement.hostlist.socket.getaddrinfo", fake_getaddrinfo
+    )
+    hl = HostList.from_dns("origins.internal:8080")
+    assert hl.resolve() == ["10.0.0.1:8080", "10.0.0.2:8080"]
+
+    answers[0] = [("10.0.0.2",), ("10.0.0.3",)]
+    assert hl.resolve() == ["10.0.0.2:8080", "10.0.0.3:8080"]
+
+    # DNS blip: last good answer survives (no mass re-replication).
+    answers[0] = None
+    assert hl.resolve() == ["10.0.0.2:8080", "10.0.0.3:8080"]
+
+    # TLS-fronted clusters resolve with an https scheme prefix.
+    answers[0] = [("10.0.0.9",)]
+    hl_tls = HostList.from_dns("origins.internal:8080", scheme="https")
+    assert hl_tls.resolve() == ["https://10.0.0.9:8080"]
+
+    with pytest.raises(ValueError):
+        HostList.from_dns("no-port")
+
+
+# -- TLS listener ------------------------------------------------------------
+
+
+def test_origin_tls_listener(tmp_path):
+    from kraken_tpu.assembly import OriginNode
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(str(cert), str(key))
+
+    async def main():
+        from aiohttp import ClientSession, TCPConnector
+
+        node = OriginNode(
+            store_root=str(tmp_path / "o"), dedup=False,
+            ssl_context=server_ctx,
+        )
+        await node.start()
+        try:
+            client_ctx = ssl.create_default_context(cafile=str(cert))
+            client_ctx.check_hostname = False
+            async with ClientSession(
+                connector=TCPConnector(ssl=client_ctx)
+            ) as http:
+                async with http.get(f"https://{node.addr}/health") as r:
+                    assert r.status == 200
+                    assert await r.text() == "ok"
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_intra_cluster_tls_via_https_addr(tmp_path):
+    """Internal clients reach TLS-fronted components when the configured
+    address carries an https:// prefix (base_url) and the HTTPClient is
+    given the cluster CA."""
+    from kraken_tpu.assembly import TrackerNode
+    from kraken_tpu.tracker.client import TrackerClient
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(str(cert), str(key))
+
+    async def main():
+        tracker = TrackerNode(ssl_context=server_ctx)
+        await tracker.start()
+        try:
+            client_ctx = ssl.create_default_context(cafile=str(cert))
+            client = TrackerClient(
+                f"https://{tracker.addr}",
+                peer_id=_peer(1).peer_id,
+                ip="127.0.0.1", port=7001,
+                http=HTTPClient(ssl=client_ctx),
+            )
+            from kraken_tpu.core.metainfo import InfoHash
+
+            peers, interval = await client.announce(
+                None, InfoHash("ab" * 32), "ns", complete=False
+            )
+            assert peers == [] and interval > 0
+            await client.close()
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())
+
+
+# -- bounded dedup index -----------------------------------------------------
+
+
+def test_dedup_index_bounded(tmp_path):
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.ops.cdc import CDCParams
+    from kraken_tpu.origin.dedup import DedupIndex
+    from kraken_tpu.store import CAStore
+
+    rng = np.random.default_rng(0)
+    store = CAStore(str(tmp_path))
+    index = DedupIndex(
+        store, params=CDCParams(min_size=256, avg_size=1024, max_size=4096),
+        max_blobs=5,
+    )
+    digests = []
+    for i in range(12):
+        blob = rng.integers(0, 256, size=8192, dtype=np.uint8).tobytes()
+        d = Digest.from_bytes(blob)
+        uid = store.create_upload()
+        store.write_upload_chunk(uid, 0, blob)
+        store.commit_upload(uid, d)
+        index.add_blob_sync(d)
+        digests.append(d)
+
+    assert index.stats()["blobs"] == 5  # bounded, oldest evicted
+    assert digests[0].hex not in index._indexed
+    assert digests[-1].hex in index._indexed
+    # Evicted blobs re-admit from their persisted sidecar on next touch.
+    index.add_blob_sync(digests[0])
+    assert digests[0].hex in index._indexed
+    assert index.stats()["blobs"] == 5
