@@ -212,6 +212,7 @@ class OriginNode:
         self._health_http: Optional[HTTPClient] = None
         self._health_task: Optional[asyncio.Task] = None
         self._cleanup_task: Optional[asyncio.Task] = None
+        self._reseed_task: Optional[asyncio.Task] = None
         self._repair_tasks: set[asyncio.Task] = set()
 
     @property
@@ -276,11 +277,21 @@ class OriginNode:
             self.self_addr = self.addr
             self.server.self_addr = self.addr
         self.retry.start()
-        # Seed everything already on disk (origin startup behavior).
+        # Seed everything already on disk (origin startup behavior). A blob
+        # whose metainfo sidecar was lost (partial disk restore, manual
+        # cleanup) gets its metainfo REGENERATED -- otherwise it would stay
+        # invisible to the swarm until explicitly touched. Regeneration
+        # hashes the blob, so it runs as a background task, seeding each
+        # blob as its metainfo lands.
+        missing: list[Digest] = []
         for d in self.store.list_cache_digests():
             metainfo = self.generator.get_cached(d)
             if metainfo is not None:
                 self.scheduler.seed(metainfo, "startup")
+            else:
+                missing.append(d)
+        if missing:
+            self._reseed_task = asyncio.create_task(self._reseed(missing))
         # Rebuild the dedup index from persisted sketch sidecars.
         if self.dedup is not None:
             await asyncio.to_thread(self.dedup.load_existing)
@@ -302,6 +313,52 @@ class OriginNode:
                 self.ring.set_health_filter(self.monitor.filter)
             self.ring.on_change(self._on_ring_change)
             self._health_task = asyncio.create_task(self._health_loop())
+
+    async def _reseed(self, missing: list[Digest]) -> None:
+        """Regenerate lost metainfo sidecars and seed the blobs (runs in
+        the background after startup; sequential so it never starves the
+        serving path of hasher batches)."""
+        for d in missing:
+            try:
+                # The motivating scenario -- a partial disk restore -- can
+                # corrupt the blob along with losing its sidecar. Verify
+                # the content hash BEFORE regenerating piece hashes from
+                # it, or the swarm would happily serve wrong bytes as d
+                # (agents verify pieces only against the regenerated
+                # metainfo, never the whole-blob digest).
+                if not await asyncio.to_thread(self._blob_matches, d):
+                    _log.warning(
+                        "reseed skipped: blob content does not match digest",
+                        extra={"digest": d.hex},
+                    )
+                    continue
+                if self.cleanup is not None:
+                    self.cleanup.touch(d)  # a reseed backlog must not TTI-evict
+                metainfo = await self.generator.generate(d)
+                if not self.store.in_cache(d):
+                    # Evicted mid-hash: drop the orphan sidecar generate()
+                    # just rewrote and do not advertise a bodyless torrent.
+                    from kraken_tpu.origin.metainfogen import (
+                        TorrentMetaMetadata,
+                    )
+
+                    self.store.delete_metadata(d, TorrentMetaMetadata)
+                    continue
+                self.scheduler.seed(metainfo, "startup")
+            except Exception:
+                _log.warning(
+                    "startup reseed failed", extra={"digest": d.hex},
+                    exc_info=True,
+                )
+
+    def _blob_matches(self, d: Digest) -> bool:
+        import hashlib
+
+        h = hashlib.sha256()
+        with self.store.open_cache_file(d) as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest() == d.hex
 
     async def _probe_origin(self, host: str) -> bool:
         try:
@@ -353,6 +410,8 @@ class OriginNode:
             self._health_task.cancel()
         if self._cleanup_task:
             self._cleanup_task.cancel()
+        if self._reseed_task:
+            self._reseed_task.cancel()
         for t in list(self._repair_tasks):
             t.cancel()
         self.retry.stop()

@@ -228,3 +228,93 @@ def test_origin_replication_to_ring_peer(tmp_path):
             await teardown(tracker, origins, agents, cluster)
 
     asyncio.run(main())
+
+
+def test_origin_restart_regenerates_lost_metainfo(tmp_path):
+    """A blob whose metainfo sidecar is lost (partial restore, manual
+    cleanup) is re-hashed and re-seeded at origin startup -- it must not
+    stay invisible to the swarm until explicitly touched."""
+
+    async def main():
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        tracker, origins, agents, cluster = await build_herd(tmp_path)
+        blob = os.urandom(300_000)
+        d = Digest.from_bytes(blob)
+        try:
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            await oc.close()
+
+            # Restart the origin with its sidecar gone. Same port: a
+            # production origin has a fixed address, and the herd's ring
+            # still lists it.
+            store_root = origins[0].store.root
+            old_port = origins[0].http_port
+            await origins[0].stop()
+            reborn = OriginNode(
+                store_root=store_root, tracker_addr=tracker.addr,
+                http_port=old_port,
+            )
+            reborn.store.delete_metadata(d, TorrentMetaMetadata)
+            await reborn.start()
+            origins[0] = reborn
+
+            # The background reseed must hash + seed it BEFORE any agent
+            # or tracker traffic could trigger on-demand regeneration
+            # (which would mask a broken reseed).
+            assert reborn._reseed_task is not None
+            await reborn._reseed_task
+            assert reborn.generator.get_cached(d) is not None
+            http = HTTPClient()
+            got = await http.get(
+                f"http://{agents[0].addr}/namespace/ns/blobs/{d.hex}"
+            )
+            assert got == blob
+            await http.close()
+        finally:
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
+
+
+def test_origin_restart_skips_corrupt_blob(tmp_path):
+    """Restore corruption: a cached blob whose bytes no longer match its
+    digest (and whose sidecar is lost) must NOT be reseeded -- regenerated
+    piece hashes would make every agent accept wrong bytes as d."""
+
+    async def main():
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, n_agents=0
+        )
+        blob = os.urandom(200_000)
+        d = Digest.from_bytes(blob)
+        try:
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            await oc.close()
+
+            store_root = origins[0].store.root
+            old_port = origins[0].http_port
+            await origins[0].stop()
+            reborn = OriginNode(
+                store_root=store_root, tracker_addr=tracker.addr,
+                http_port=old_port,
+            )
+            reborn.store.delete_metadata(d, TorrentMetaMetadata)
+            with open(reborn.store.cache_path(d), "r+b") as f:
+                f.seek(1000)
+                f.write(b"\x00" * 64)  # corrupt in place
+            await reborn.start()
+            origins[0] = reborn
+
+            assert reborn._reseed_task is not None
+            await reborn._reseed_task
+            # Skipped: no regenerated sidecar, not seeded.
+            assert reborn.generator.get_cached(d) is None
+        finally:
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
