@@ -66,6 +66,22 @@ async def _cleanup_loop(manager: CleanupManager) -> None:
             _log.exception("cleanup sweep failed")
 
 
+async def _ring_refresh_loop(get_cluster, interval: float) -> None:
+    """Periodic membership re-resolve for a node's origin cluster. The
+    passive health filter only takes effect when the ring re-resolves, so
+    every long-running holder of a ClusterClient needs this loop -- a dead
+    origin otherwise stays in the replica lists forever. ``get_cluster``
+    is a callable: herd harnesses attach the cluster after start."""
+    while True:
+        await asyncio.sleep(interval)
+        cluster = get_cluster()
+        try:
+            if cluster is not None:
+                await cluster.ring.refresh_async()
+        except Exception:
+            pass
+
+
 async def _serve(app: web.Application, host: str, port: int,
                  component: str = "", ssl_context=None):
     if component:
@@ -118,20 +134,9 @@ class TrackerNode:
             self.server.make_app(), self.host, self.port, "tracker",
             ssl_context=self.ssl_context,
         )
-        # The cluster's passive health filter only takes effect when the
-        # ring re-resolves; refresh it periodically (resolved each tick:
-        # herd harnesses attach origin_cluster after start).
-        self._refresh_task = asyncio.create_task(self._refresh_loop())
-
-    async def _refresh_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.ring_refresh)
-            cluster = self.server.origin_cluster
-            try:
-                if cluster is not None:
-                    await cluster.ring.refresh_async()
-            except Exception:
-                pass
+        self._refresh_task = asyncio.create_task(_ring_refresh_loop(
+            lambda: self.server.origin_cluster, self.ring_refresh
+        ))
 
     async def stop(self) -> None:
         if self._refresh_task:
@@ -436,6 +441,7 @@ class BuildIndexNode:
         backends: BackendManager | None = None,
         remotes: list[str] | None = None,
         origin_cluster: ClusterClient | None = None,
+        ssl_context=None,
     ):
         from kraken_tpu.buildindex.server import TagServer
         from kraken_tpu.buildindex.tagstore import TagStore
@@ -452,7 +458,9 @@ class BuildIndexNode:
             remotes=remotes,
             origin_cluster=origin_cluster,
         )
+        self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None
+        self._refresh_task: Optional[asyncio.Task] = None
 
     @property
     def addr(self) -> str:
@@ -460,11 +468,17 @@ class BuildIndexNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port, "build-index"
+            self.server.make_app(), self.host, self.port, "build-index",
+            ssl_context=self.ssl_context,
         )
         self.retry.start()
+        self._refresh_task = asyncio.create_task(_ring_refresh_loop(
+            lambda: self.server.origin_cluster, 5.0
+        ))
 
     async def stop(self) -> None:
+        if self._refresh_task:
+            self._refresh_task.cancel()
         self.retry.stop()
         if self._runner:
             await self._runner.cleanup()
@@ -479,6 +493,7 @@ class ProxyNode:
         build_index_addr: str,
         host: str = "127.0.0.1",
         port: int = 0,
+        ssl_context=None,
     ):
         from kraken_tpu.buildindex.server import TagClient
         from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -486,11 +501,14 @@ class ProxyNode:
 
         self.host = host
         self.port = port
+        self.origin_cluster = origin_cluster
         self._tag_client = TagClient(build_index_addr)
         self.server = RegistryServer(
             ProxyTransferer(origin_cluster, self._tag_client), read_only=False
         )
+        self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None
+        self._refresh_task: Optional[asyncio.Task] = None
 
     @property
     def addr(self) -> str:
@@ -498,10 +516,16 @@ class ProxyNode:
 
     async def start(self) -> None:
         self._runner, self.port = await _serve(
-            self.server.make_app(), self.host, self.port, "proxy"
+            self.server.make_app(), self.host, self.port, "proxy",
+            ssl_context=self.ssl_context,
         )
+        self._refresh_task = asyncio.create_task(_ring_refresh_loop(
+            lambda: self.origin_cluster, 5.0
+        ))
 
     async def stop(self) -> None:
+        if self._refresh_task:
+            self._refresh_task.cancel()
         if self._runner:
             await self._runner.cleanup()
         await self._tag_client.close()
@@ -547,6 +571,14 @@ class AgentNode:
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.http_port}"
+
+    @property
+    def registry_addr(self) -> str | None:
+        """Where the docker-registry read endpoint is served, or None when
+        it is not enabled (no build-index configured)."""
+        if self._registry_runner is None:
+            return None
+        return f"{self.host}:{self.registry_port}"
 
     async def start(self) -> None:
         factory = PeerIDFactory(

@@ -3,9 +3,11 @@
 Mirrors the reference's per-binary ``cmd`` mains (uber/kraken agent/cmd,
 origin/cmd, tracker/cmd -- upstream paths, unverified; SURVEY.md SS2.4).
 
-    python -m kraken_tpu.cli tracker --port 7602
-    python -m kraken_tpu.cli origin  --config origin.yaml
-    python -m kraken_tpu.cli agent   --config agent.yaml --tracker host:7602
+    python -m kraken_tpu.cli tracker     --port 7602
+    python -m kraken_tpu.cli origin      --config origin.yaml
+    python -m kraken_tpu.cli agent       --config agent.yaml --tracker host:7602
+    python -m kraken_tpu.cli build-index --store ./bi --origins host:7610
+    python -m kraken_tpu.cli proxy       --origins host:7610 --build-index host:7620
 
 Config YAML keys mirror the constructor arguments of the assembly nodes
 (kraken_tpu/assembly.py); flags override config values.
@@ -20,7 +22,13 @@ import logging
 import signal
 import sys
 
-from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.assembly import (
+    AgentNode,
+    BuildIndexNode,
+    OriginNode,
+    ProxyNode,
+    TrackerNode,
+)
 from kraken_tpu.backend import Manager as BackendManager
 from kraken_tpu.configutil import load_config
 from kraken_tpu.origin.client import ClusterClient
@@ -33,6 +41,10 @@ from kraken_tpu.utils.structlog import setup_json_logging
 async def _run_until_signal(node, describe: dict) -> None:
     await node.start()
     describe["addr"] = node.addr
+    # Agents with the docker-registry read endpoint enabled bind it on its
+    # own (possibly ephemeral) port; report it so harnesses can find it.
+    if getattr(node, "registry_addr", None):
+        describe["registry_addr"] = node.registry_addr
     # One machine-readable line so herd harnesses can scrape the bound ports.
     print("READY " + json.dumps(describe), flush=True)
     stop = asyncio.Event()
@@ -77,6 +89,28 @@ def main(argv: list[str] | None = None) -> None:
     p_agent.add_argument("--tracker", default=None)
     p_agent.add_argument("--p2p-port", type=int, default=None)
     p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
+    p_agent.add_argument("--registry-port", type=int, default=None,
+                         help="serve the docker-registry read API here"
+                              " (requires --build-index)")
+    p_agent.add_argument("--build-index", default=None,
+                         help="build-index addr for tag -> digest lookups")
+
+    p_bi = sub.add_parser("build-index")
+    _common(p_bi)
+    p_bi.add_argument("--store", default=None)
+    p_bi.add_argument("--origins", default=None,
+                      help="comma-separated origin http addrs (tag"
+                           " dependency resolution)")
+    p_bi.add_argument("--remotes", default=None,
+                      help="comma-separated remote build-index addrs"
+                           " (cross-cluster tag replication)")
+
+    p_proxy = sub.add_parser("proxy")
+    _common(p_proxy)
+    p_proxy.add_argument("--origins", default=None,
+                         help="comma-separated origin http addrs")
+    p_proxy.add_argument("--build-index", default=None,
+                         help="build-index addr for tag puts")
 
     args = parser.parse_args(argv)
     cfg = load_config(args.config) if args.config else {}
@@ -106,20 +140,23 @@ def main(argv: list[str] | None = None) -> None:
     host = pick(args.host, "host", "127.0.0.1")
     port = pick(args.port, "port", 0)
 
+    def origin_cluster(origins: str | None) -> ClusterClient | None:
+        """Ring-resolved origin cluster client with passive health:
+        request failures drop an origin from the ring on its next
+        refresh."""
+        addrs = [a for a in (origins or "").split(",") if a]
+        if not addrs:
+            return None
+        health = PassiveFilter()
+        return ClusterClient(
+            Ring(HostList(static=addrs),
+                 max_replica=cfg.get("max_replica", 3),
+                 health_filter=health.filter),
+            health=health,
+        )
+
     if args.component == "tracker":
-        origins = pick(args.origins, "origins", "")
-        origin_addrs = [a for a in (origins or "").split(",") if a]
-        cluster = None
-        if origin_addrs:
-            # Passive health: request failures drop an origin from the
-            # ring on the next refresh (tracker's periodic refresh loop).
-            health = PassiveFilter()
-            cluster = ClusterClient(
-                Ring(HostList(static=origin_addrs),
-                     max_replica=cfg.get("max_replica", 3),
-                     health_filter=health.filter),
-                health=health,
-            )
+        cluster = origin_cluster(pick(args.origins, "origins", ""))
         node = TrackerNode(
             host=host, port=port, origin_cluster=cluster,
             announce_interval_seconds=cfg.get("announce_interval_seconds", 3.0),
@@ -204,17 +241,58 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_run_until_signal(node, {"component": "origin"}))
 
     elif args.component == "agent":
+        # None = not requested; 0 = requested on an ephemeral port.
+        registry_port = pick(args.registry_port, "registry_port", None)
+        build_index = pick(args.build_index, "build_index", "")
+        if registry_port is not None and not build_index:
+            parser.error("--registry-port requires --build-index (tag"
+                         " lookups resolve through it)")
         node = AgentNode(
             store_root=pick(args.store, "store", "./agent-store"),
             tracker_addr=pick(args.tracker, "tracker", ""),
             host=host,
             http_port=port,
             p2p_port=pick(args.p2p_port, "p2p_port", 0),
+            registry_port=registry_port or 0,
+            build_index_addr=build_index,
             hasher=pick(args.hasher, "hasher", "cpu"),
             cleanup=cleanup,
             ssl_context=ssl_context,
         )
         asyncio.run(_run_until_signal(node, {"component": "agent"}))
+
+    elif args.component == "build-index":
+        backends_cfg = cfg.get("backends")
+        backends = BackendManager(backends_cfg) if backends_cfg else None
+        remotes = [
+            a for a in (pick(args.remotes, "remotes", "") or "").split(",") if a
+        ]
+        node = BuildIndexNode(
+            store_root=pick(args.store, "store", "./build-index-store"),
+            host=host,
+            port=port,
+            backends=backends,
+            remotes=remotes or None,
+            origin_cluster=origin_cluster(pick(args.origins, "origins", "")),
+            ssl_context=ssl_context,
+        )
+        asyncio.run(_run_until_signal(node, {"component": "build-index"}))
+
+    elif args.component == "proxy":
+        cluster = origin_cluster(pick(args.origins, "origins", ""))
+        if cluster is None:
+            parser.error("proxy requires --origins")
+        build_index = pick(args.build_index, "build_index", "")
+        if not build_index:
+            parser.error("proxy requires --build-index")
+        node = ProxyNode(
+            cluster,
+            build_index,
+            host=host,
+            port=port,
+            ssl_context=ssl_context,
+        )
+        asyncio.run(_run_until_signal(node, {"component": "proxy"}))
 
 
 if __name__ == "__main__":

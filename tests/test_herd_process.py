@@ -83,3 +83,71 @@ def test_process_herd_e2e(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_process_herd_full_five_components(tmp_path):
+    """All five reference binaries as CLI processes: push an image via the
+    proxy's docker-v2 API, pull it by tag via the agent's registry API."""
+    procs = []
+    try:
+        origin, oinfo = spawn(
+            ["origin", "--store", str(tmp_path / "origin")]
+        )
+        procs.append(origin)
+        tracker, tinfo = spawn(["tracker", "--origins", oinfo["addr"]])
+        procs.append(tracker)
+        # Restart the origin pointed at the tracker (fixed port known now).
+        origin.send_signal(signal.SIGTERM)
+        origin.wait(timeout=10)
+        procs.remove(origin)
+        origin, oinfo = spawn(
+            ["origin", "--store", str(tmp_path / "origin"),
+             "--port", oinfo["addr"].split(":")[1],
+             "--tracker", tinfo["addr"]]
+        )
+        procs.append(origin)
+        bi, binfo = spawn(
+            ["build-index", "--store", str(tmp_path / "bi"),
+             "--origins", oinfo["addr"]]
+        )
+        procs.append(bi)
+        proxy, pinfo = spawn(
+            ["proxy", "--origins", oinfo["addr"],
+             "--build-index", binfo["addr"]]
+        )
+        procs.append(proxy)
+        agent, ainfo = spawn(
+            ["agent", "--store", str(tmp_path / "agent"),
+             "--tracker", tinfo["addr"],
+             "--registry-port", "0", "--build-index", binfo["addr"]]
+        )
+        procs.append(agent)
+        registry_addr = ainfo.get("registry_addr")
+        assert registry_addr, "agent did not report a registry endpoint"
+
+        async def drive():
+            from kraken_tpu.utils.httputil import HTTPClient
+            from test_registry import make_image, push_image, pull_image
+
+            http = HTTPClient(timeout_seconds=60)
+            config, layers, manifest = make_image(nlayers=2)
+            await push_image(
+                http, pinfo["addr"], "library/app", "v1",
+                config, layers, manifest,
+            )
+            got_manifest, got_blobs = await pull_image(
+                http, registry_addr, "library/app", "v1"
+            )
+            assert got_manifest == manifest
+            assert set(got_blobs.values()) == {config, *layers}
+            await http.close()
+
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
