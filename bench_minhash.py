@@ -1,0 +1,131 @@
+"""MinHash/LSH index benchmark at survey scale.
+
+BASELINE.json config #5: "MinHash/SimHash index, 1M layer chunk-sets,
+top-k recall vs brute force -- measure". This drives the production index
+(kraken_tpu/ops/minhash.py: MinHasher 128 hashes, LSHIndex 32 bands) on a
+corpus of N synthetic layer chunk-fingerprint sets with planted
+near-duplicates across the Jaccard range, and reports:
+
+- recall@10 vs the brute-force oracle (restricted to true matches with
+  J >= 0.3, i.e. above the LSH S-curve knee at ~0.42 where retrieval is
+  the design intent);
+- planted-pair retrieval rate per Jaccard bucket (the operative number:
+  "if a layer J-similar to a stored one arrives, do we find it?");
+- sketch throughput (TPU-batched), index build rate, and query rate.
+
+Prints ONE JSON line. N defaults to 100k sets (~128 chunks each ~= a 8
+MiB layer at 64 KiB chunks -- so the default models a ~0.8 TiB corpus);
+override with MINHASH_N. Memory is O(N * 128) u32 for sketches.
+
+Run on TPU (default platform) or CPU (JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N = int(os.environ.get("MINHASH_N", 100_000))
+CHUNKS_PER_SET = int(os.environ.get("MINHASH_CHUNKS", 128))
+N_QUERIES = int(os.environ.get("MINHASH_QUERIES", 500))
+J_BUCKETS = (0.3, 0.5, 0.7, 0.9)
+
+
+def make_corpus(rng: np.random.Generator):
+    """N fingerprint sets; the last len(J_BUCKETS)*Q sets are planted
+    near-duplicates of base sets at controlled Jaccard levels."""
+    sets = [
+        rng.integers(1, 1 << 32, size=CHUNKS_PER_SET, dtype=np.uint64)
+        .astype(np.uint32)
+        for _ in range(N)
+    ]
+    planted = []  # (query_idx, target_idx, j_expected)
+    q_per_bucket = N_QUERIES // len(J_BUCKETS)
+    next_idx = N
+    for j in J_BUCKETS:
+        for _ in range(q_per_bucket):
+            base_idx = int(rng.integers(0, N))
+            base = sets[base_idx]
+            # |A n B| / |A u B| = j with |A| = |B| = m: share s = 2j/(1+j)
+            m = len(base)
+            shared = int(round(m * 2 * j / (1 + j)))
+            q = np.concatenate([
+                base[:shared],
+                rng.integers(1, 1 << 32, size=m - shared, dtype=np.uint64)
+                .astype(np.uint32),
+            ])
+            sets.append(q)
+            planted.append((next_idx, base_idx, j))
+            next_idx += 1
+    return sets, planted
+
+
+def main():
+    from kraken_tpu.ops.minhash import LSHIndex, MinHasher
+
+    rng = np.random.default_rng(7)
+    sets, planted = make_corpus(rng)
+    hasher = MinHasher(num_hashes=128)
+
+    # Sketch: TPU-batched in fixed groups.
+    t0 = time.perf_counter()
+    sketches = []
+    B = 2048
+    for s in range(0, len(sets), B):
+        sketches.append(hasher.sketch_batch(sets[s : s + B]))
+    sketches = np.concatenate(sketches)
+    sketch_s = time.perf_counter() - t0
+    sets_per_s = len(sets) / sketch_s
+
+    # Build the index over the N corpus sets (queries stay out).
+    index = LSHIndex(hasher, num_bands=32)
+    t0 = time.perf_counter()
+    for i in range(N):
+        index.add(i, sketches[i])
+    build_s = time.perf_counter() - t0
+
+    # Planted-pair retrieval + recall@10 vs brute force.
+    hits_by_j = {j: 0 for j in J_BUCKETS}
+    count_by_j = {j: 0 for j in J_BUCKETS}
+    recall_sum = 0.0
+    recall_n = 0
+    t0 = time.perf_counter()
+    results = [index.query(sketches[qi], k=10) for qi, _t, _j in planted]
+    query_s = time.perf_counter() - t0
+    for (qi, target, j), got in zip(planted, results):
+        count_by_j[j] += 1
+        if any(key == target for key, _score in got):
+            hits_by_j[j] += 1
+        oracle = [
+            key
+            for key, score in index.query_brute(sketches[qi], k=10)
+            if score >= 0.3
+        ]
+        if oracle:
+            found = {key for key, _ in got}
+            recall_sum += len(found & set(oracle)) / len(oracle)
+            recall_n += 1
+
+    recall10 = recall_sum / max(1, recall_n)
+    print(json.dumps({
+        "metric": "minhash_lsh_recall_at_10",
+        "value": round(recall10, 4),
+        "unit": "fraction (vs brute-force oracle, J>=0.3)",
+        "vs_baseline": round(recall10, 4),  # baseline target: measure
+        "n_sets": len(sets),
+        "planted_retrieval_by_jaccard": {
+            str(j): round(hits_by_j[j] / max(1, count_by_j[j]), 4)
+            for j in J_BUCKETS
+        },
+        "sketch_sets_per_s": round(sets_per_s),
+        "index_adds_per_s": round(N / build_s),
+        "queries_per_s": round(len(planted) / query_s),
+    }))
+
+
+if __name__ == "__main__":
+    main()

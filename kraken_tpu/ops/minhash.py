@@ -60,16 +60,34 @@ def _score_kernel(query: jax.Array, corpus: jax.Array):
     return jnp.mean((corpus == query[None, :]).astype(jnp.float32), axis=1)
 
 
+_SCORE_DEVICE_MIN = 4096
+
+
+def _pad_pow2_rows(arr: np.ndarray) -> np.ndarray:
+    """Zero-pad the row axis to a power of two (bounded jit cache)."""
+    n = arr.shape[0]
+    nb = _next_pow2(n)
+    if nb == n:
+        return arr
+    return np.concatenate(
+        [arr, np.zeros((nb - n, arr.shape[1]), dtype=arr.dtype)]
+    )
+
+
 def _score(query: np.ndarray, corpus: np.ndarray) -> np.ndarray:
-    """Shape-bucketed wrapper over :func:`_score_kernel` (pads N to a power
-    of two so candidate-count churn doesn't retrace)."""
+    """Estimated Jaccard of ``query`` vs each corpus row.
+
+    Small candidate sets (the LSH query path: typically tens of rows)
+    score on host -- a device round trip costs more than the compare
+    itself, and /similar latency is dominated by it. Large scans (the
+    brute-force oracle path) go to the device, padded to a power of two
+    so candidate-count churn doesn't retrace."""
     n = corpus.shape[0]
-    nb = _next_pow2(max(1, n))
-    if nb != n:
-        corpus = np.concatenate(
-            [corpus, np.zeros((nb - n, corpus.shape[1]), dtype=corpus.dtype)]
-        )
-    return np.asarray(_score_kernel(jnp.asarray(query), jnp.asarray(corpus)))[:n]
+    if n < _SCORE_DEVICE_MIN:
+        return np.mean(corpus == query[None, :], axis=1, dtype=np.float32)
+    return np.asarray(
+        _score_kernel(jnp.asarray(query), jnp.asarray(_pad_pow2_rows(corpus)))
+    )[:n]
 
 
 class MinHasher:
@@ -141,6 +159,13 @@ class LSHIndex:
         self._key_idx: dict[Hashable, int] = {}  # live key -> row (latest wins)
         self._removed: set[int] = set()  # tombstoned row indices
         self._corpus: np.ndarray | None = None  # rebuilt lazily on query
+        # Device-resident copy of the LIVE rows for brute scans: uploading
+        # the corpus per query costs more than the scan (it is O(N*K)
+        # bytes). Keyed by a mutation generation so consecutive queries
+        # share one upload even under churn (tombstones included).
+        self._gen = 0
+        self._corpus_dev = None
+        self._dev_gen = -1
 
     def __len__(self) -> int:
         return len(self._keys) - len(self._removed)
@@ -155,6 +180,7 @@ class LSHIndex:
         self._sketches.append(np.asarray(sketch, dtype=np.uint32))
         self._key_idx[key] = idx
         self._corpus = None
+        self._gen += 1
         for band, bucket in enumerate(self._buckets):
             sig = self._sketches[idx][band * self.rows : (band + 1) * self.rows].tobytes()
             bucket.setdefault(sig, []).append(idx)
@@ -169,6 +195,7 @@ class LSHIndex:
         if idx is None:
             return False
         self._removed.add(idx)
+        self._gen += 1  # live-row set changed: device cache is stale
         sketch = self._sketches[idx]
         for band, bucket in enumerate(self._buckets):
             sig = sketch[band * self.rows : (band + 1) * self.rows].tobytes()
@@ -193,6 +220,7 @@ class LSHIndex:
         self._removed = set()
         self._key_idx = {k: i for i, k in enumerate(keys)}
         self._corpus = None
+        self._gen += 1
         self._buckets = [{} for _ in range(self.num_bands)]
         for idx, sketch in enumerate(sketches):
             for band, bucket in enumerate(self._buckets):
@@ -238,6 +266,22 @@ class LSHIndex:
             return []
         if self._corpus is None:
             self._corpus = np.stack(self._sketches)
-        scores = _score(np.asarray(sketch, dtype=np.uint32), self._corpus[live])
+        query = np.asarray(sketch, dtype=np.uint32)
+        if len(live) >= _SCORE_DEVICE_MIN:
+            # Large corpus: scan the cached device copy of the live rows
+            # (rebuilt only when the index mutated since the last scan).
+            if self._corpus_dev is None or self._dev_gen != self._gen:
+                rows = (
+                    self._corpus
+                    if len(live) == len(self._keys)
+                    else self._corpus[live]
+                )
+                self._corpus_dev = jnp.asarray(_pad_pow2_rows(rows))
+                self._dev_gen = self._gen
+            scores = np.asarray(
+                _score_kernel(jnp.asarray(query), self._corpus_dev)
+            )[: len(live)]
+        else:
+            scores = _score(query, self._corpus[live])
         order = np.argsort(-scores)[:k]
         return [(self._keys[live[i]], float(scores[i])) for i in order]
