@@ -141,7 +141,20 @@ def main() -> None:
     cpu = None
     if os.environ.get("BENCH_SKIP_CPU") != "1":
         cpu = cpu_baseline_gbps()
-    natural, packed_rate, pack_gbps = tpu_rates()
+    # BENCH_PROFILE=<dir>: wrap the TPU section in a jax.profiler trace
+    # (XPlane + TensorBoard format) -- the SURVEY SS5 tracing plane for
+    # the TPU side, alongside the swarm's networkevent JSONL.
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    if profile_dir:
+        import jax
+
+        ctx = jax.profiler.trace(profile_dir)
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
+        natural, packed_rate, pack_gbps = tpu_rates()
     print(
         json.dumps(
             {

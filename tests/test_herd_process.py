@@ -6,8 +6,10 @@ isolation, no containers.
 """
 
 import asyncio
+import contextlib
 import json
 import os
+import pathlib
 import signal
 import subprocess
 import sys
@@ -15,6 +17,23 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@contextlib.contextmanager
+def herd():
+    """Owns spawned component processes; SIGTERM + wait (SIGKILL fallback)
+    on exit."""
+    procs: list[subprocess.Popen] = []
+    try:
+        yield procs
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def spawn(args: list[str]) -> tuple[subprocess.Popen, dict]:
@@ -34,8 +53,7 @@ def spawn(args: list[str]) -> tuple[subprocess.Popen, dict]:
 
 
 def test_process_herd_e2e(tmp_path):
-    procs = []
-    try:
+    with herd() as procs:
         tracker, tinfo = spawn(["tracker"])
         procs.append(tracker)
         origin, oinfo = spawn(
@@ -75,21 +93,12 @@ def test_process_herd_e2e(tmp_path):
             assert got == blob
 
         asyncio.run(drive())
-    finally:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 def test_process_herd_full_five_components(tmp_path):
     """All five reference binaries as CLI processes: push an image via the
     proxy's docker-v2 API, pull it by tag via the agent's registry API."""
-    procs = []
-    try:
+    with herd() as procs:
         origin, oinfo = spawn(
             ["origin", "--store", str(tmp_path / "origin")]
         )
@@ -143,11 +152,43 @@ def test_process_herd_full_five_components(tmp_path):
             await http.close()
 
         asyncio.run(drive())
-    finally:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+
+
+def test_shipped_development_configs_boot(tmp_path):
+    """The shipped config/ tree loads (extends-layering included) and the
+    development overlays boot real processes."""
+    from kraken_tpu.configutil import load_config
+
+    # Every shipped file parses and layers.
+    for path in sorted(pathlib.Path(REPO, "config").rglob("*.yaml")):
+        cfg = load_config(str(path))
+        # Layering proof: every file (transitively) extends config/base.yaml,
+        # so base-only keys must have merged in.
+        assert cfg.get("host"), f"{path}: base.yaml did not merge"
+        assert "cleanup" in cfg, f"{path}: base.yaml did not merge"
+
+    dev = load_config(os.path.join(REPO, "config/origin/development.yaml"))
+    # Overlay wins where set, base fills the rest (deep merge).
+    assert dev["hasher"] == "cpu" and dev["p2p_port"] == 7611
+    assert dev["cleanup"]["high_watermark_bytes"] == 1 << 30
+    assert dev["cleanup"]["interval_seconds"] == 300  # from config/base.yaml
+
+    with herd() as procs:
+        tracker, tinfo = spawn(
+            ["tracker", "--config", "config/tracker/development.yaml",
+             "--port", "0"]
+        )
+        procs.append(tracker)
+        origin, oinfo = spawn(
+            ["origin", "--config", "config/origin/development.yaml",
+             "--port", "0", "--p2p-port", "0",
+             "--store", str(tmp_path / "o"), "--tracker", tinfo["addr"]]
+        )
+        procs.append(origin)
+        agent, ainfo = spawn(
+            ["agent", "--config", "config/agent/development.yaml",
+             "--port", "0", "--p2p-port", "0",
+             "--store", str(tmp_path / "a"), "--tracker", tinfo["addr"]]
+        )
+        procs.append(agent)
+        assert oinfo["component"] == "origin" and ainfo["component"] == "agent"
