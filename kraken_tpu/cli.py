@@ -75,7 +75,7 @@ def main(argv: list[str] | None = None) -> None:
     p_origin.add_argument("--store", default=None)
     p_origin.add_argument("--tracker", default=None)
     p_origin.add_argument("--p2p-port", type=int, default=None)
-    p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
+    p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
     p_origin.add_argument("--cluster", default=None,
                           help="comma-separated origin http addrs (incl. self)")
     p_origin.add_argument("--self-addr", default=None,
@@ -88,7 +88,7 @@ def main(argv: list[str] | None = None) -> None:
     p_agent.add_argument("--store", default=None)
     p_agent.add_argument("--tracker", default=None)
     p_agent.add_argument("--p2p-port", type=int, default=None)
-    p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu"])
+    p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
     p_agent.add_argument("--registry-port", type=int, default=None,
                          help="serve the docker-registry read API here"
                               " (requires --build-index)")

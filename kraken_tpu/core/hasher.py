@@ -114,16 +114,21 @@ def register_hasher(name: str, factory: Callable[[], PieceHasher]) -> None:
 
 
 def get_hasher(name: str = "cpu") -> PieceHasher:
-    """Resolve a hasher by registry name (``cpu``, ``tpu``).
+    """Resolve a hasher by registry name (``cpu``, ``tpu``,
+    ``tpu-sharded`` -- the last fans the piece batch across every local
+    chip via shard_map).
 
     Instances are cached: TPU hasher construction compiles kernels, so the
     origin and agent share one instance per process.
     """
     if name not in _INSTANCES:
-        if name == "tpu" and name not in _REGISTRY:
-            # Importing the ops plane registers the TPU hasher; deferred so
-            # that pure-CPU components never pay the JAX import.
-            import kraken_tpu.ops.sha256  # noqa: F401
+        if name not in _REGISTRY:
+            # Importing the plane registers its hashers; deferred so that
+            # pure-CPU components never pay the JAX import.
+            if name == "tpu":
+                import kraken_tpu.ops.sha256  # noqa: F401
+            elif name == "tpu-sharded":
+                import kraken_tpu.parallel.hashplane  # noqa: F401
         try:
             factory = _REGISTRY[name]
         except KeyError:

@@ -16,13 +16,19 @@ stray default-device ``jnp.asarray`` would land there.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kraken_tpu.core.hasher import DIGEST_SIZE, PieceHasher, register_hasher
+from kraken_tpu.core.hasher import (
+    DIGEST_SIZE,
+    PieceHasher,
+    record_hash_metrics,
+    register_hasher,
+)
 from kraken_tpu.ops.sha256 import (
     _digest_bytes,
     _pad_block_for,
@@ -139,6 +145,7 @@ class ShardedPieceHasher(PieceHasher):
             return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
         if piece_length % 64:
             return self._fallback.hash_pieces(data, piece_length)
+        start = time.perf_counter()
         n_full = total // piece_length
         n = (total + piece_length - 1) // piece_length
         out = []
@@ -157,6 +164,12 @@ class ShardedPieceHasher(PieceHasher):
             )
         if n > n_full:  # ragged tail piece
             out.append(self._fallback.hash_batch([view[n_full * piece_length :]]))
+        # Same north-star gauges as the single-chip hashers (GB/s,
+        # occupancy) -- a sharded origin must not go dark on dashboards.
+        record_hash_metrics(
+            self.name, total, n, time.perf_counter() - start,
+            occupancy=1.0,
+        )
         return np.concatenate(out) if len(out) > 1 else out[0]
 
     def hash_batch(self, pieces) -> np.ndarray:
