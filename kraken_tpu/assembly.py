@@ -167,6 +167,7 @@ class OriginNode:
         hash_window_bytes: int = 256 * 1024 * 1024,
         health_interval_seconds: float = 5.0,
         health_fail_threshold: int = 3,
+        scheduler_config_doc: dict | None = None,
         ssl_context=None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
@@ -208,6 +209,7 @@ class OriginNode:
         )
         self.health_interval = health_interval_seconds
         self.health_fail_threshold = health_fail_threshold
+        self._scheduler_doc = scheduler_config_doc
         self.ssl_context = ssl_context
         self.monitor: Optional[ActiveMonitor] = None
         self.scheduler: Optional[Scheduler] = None
@@ -251,14 +253,7 @@ class OriginNode:
             announce_client=self._tracker_client,
             is_origin=True,
             metainfo_resolver=self._resolve_metainfo,
-            # Origins serve swarms: far higher per-torrent conn budget than
-            # agents (a 10-conn cap on the sole initial seeder strangles
-            # flash crowds -- measured in bench_swarm).
-            config=SchedulerConfig(
-                conn_state=ConnStateConfig(
-                    max_open_conns_per_torrent=64, max_global_conns=4000
-                )
-            ),
+            config=self.build_scheduler_config(self._scheduler_doc),
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port
@@ -318,6 +313,29 @@ class OriginNode:
                 self.ring.set_health_filter(self.monitor.filter)
             self.ring.on_change(self._on_ring_change)
             self._health_task = asyncio.create_task(self._health_loop())
+
+    @staticmethod
+    def build_scheduler_config(doc: dict | None) -> SchedulerConfig:
+        """The origin's scheduler config: YAML ``scheduler:`` section over
+        origin defaults. Origins serve swarms, so the per-torrent conn
+        budget is far higher than agents' (a 10-conn cap on the sole
+        initial seeder strangles flash crowds -- measured in bench_swarm).
+        One source for boot AND reload: the same file must mean the same
+        limits at both."""
+        doc = dict(doc or {})
+        conn = {
+            "max_open_conns_per_torrent": 64,
+            "max_global_conns": 4000,
+            **(doc.pop("conn_state", None) or {}),
+        }
+        return SchedulerConfig.from_dict({**doc, "conn_state": conn})
+
+    def reload(self, cfg: dict) -> None:
+        """Apply a re-read config's ``scheduler:`` section live (SIGHUP)."""
+        if self.scheduler is not None:
+            self.scheduler.reload(
+                self.build_scheduler_config(cfg.get("scheduler"))
+            )
 
     async def _reseed(self, missing: list[Digest]) -> None:
         """Regenerate lost metainfo sidecars and seed the blobs (runs in
@@ -624,6 +642,11 @@ class AgentNode:
                 registry.make_app(), self.host, self.registry_port,
                 "agent-registry", ssl_context=self.ssl_context,
             )
+
+    def reload(self, cfg: dict) -> None:
+        """Apply a re-read config's ``scheduler:`` section live (SIGHUP)."""
+        if self.scheduler is not None and cfg.get("scheduler") is not None:
+            self.scheduler.reload(SchedulerConfig.from_dict(cfg["scheduler"]))
 
     async def stop(self) -> None:
         if self._cleanup_task:

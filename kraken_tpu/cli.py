@@ -38,7 +38,31 @@ from kraken_tpu.store.cleanup import CleanupConfig
 from kraken_tpu.utils.structlog import setup_json_logging
 
 
-async def _run_until_signal(node, describe: dict) -> None:
+async def _run_until_signal(node, describe: dict,
+                            config_path: str | None = None) -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def reload_config() -> None:
+        # SIGHUP = re-read --config and apply what reloads live (the
+        # reference's ReloadableScheduler); components without reloadable
+        # state log and ignore.
+        log = logging.getLogger("kraken.cli")
+        if config_path is None or not hasattr(node, "reload"):
+            log.info("SIGHUP ignored (no --config or nothing reloadable)")
+            return
+        try:
+            node.reload(load_config(config_path))
+            log.info("config reloaded", extra={"path": config_path})
+        except Exception:
+            log.exception("config reload failed; keeping current config")
+
+    # Handlers BEFORE the READY line: herd managers signal as soon as they
+    # see it, and an unhandled SIGHUP's default action kills the process.
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(signal.SIGHUP, reload_config)
+
     await node.start()
     describe["addr"] = node.addr
     # Agents with the docker-registry read endpoint enabled bind it on its
@@ -47,10 +71,6 @@ async def _run_until_signal(node, describe: dict) -> None:
         describe["registry_addr"] = node.registry_addr
     # One machine-readable line so herd harnesses can scrape the bound ports.
     print("READY " + json.dumps(describe), flush=True)
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await node.stop()
 
@@ -236,12 +256,18 @@ def main(argv: list[str] | None = None) -> None:
             ring=ring,
             self_addr=self_addr,
             cleanup=cleanup,
+            scheduler_config_doc=cfg.get("scheduler"),
             ssl_context=ssl_context,
         )
-        asyncio.run(_run_until_signal(node, {"component": "origin"}))
+        asyncio.run(
+            _run_until_signal(node, {"component": "origin"}, args.config)
+        )
 
     elif args.component == "agent":
         # None = not requested; 0 = requested on an ephemeral port.
+        from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+        scheduler_cfg = cfg.get("scheduler")
         registry_port = pick(args.registry_port, "registry_port", None)
         build_index = pick(args.build_index, "build_index", "")
         if registry_port is not None and not build_index:
@@ -257,9 +283,15 @@ def main(argv: list[str] | None = None) -> None:
             build_index_addr=build_index,
             hasher=pick(args.hasher, "hasher", "cpu"),
             cleanup=cleanup,
+            scheduler_config=(
+                SchedulerConfig.from_dict(scheduler_cfg)
+                if scheduler_cfg else None
+            ),
             ssl_context=ssl_context,
         )
-        asyncio.run(_run_until_signal(node, {"component": "agent"}))
+        asyncio.run(
+            _run_until_signal(node, {"component": "agent"}, args.config)
+        )
 
     elif args.component == "build-index":
         backends_cfg = cfg.get("backends")

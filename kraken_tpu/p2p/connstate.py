@@ -28,6 +28,22 @@ class ConnStateConfig:
         )
     )
 
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ConnStateConfig":
+        """YAML shape: the dataclass fields by name (unknown keys
+        rejected); ``blacklist_backoff`` may be a nested dict of Backoff
+        fields -- coerced here so a bad value fails at config load, not at
+        the first blacklist add."""
+        doc = dict(doc)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ValueError(f"unknown conn_state config keys: {sorted(unknown)}")
+        backoff = doc.get("blacklist_backoff")
+        if isinstance(backoff, dict):
+            doc["blacklist_backoff"] = Backoff(**backoff)
+        return cls(**doc)
+
 
 class Blacklist:
     """Peers that misbehaved (bad pieces, handshake errors, conn churn);
@@ -60,6 +76,11 @@ class Blacklist:
         entry = self._entries.get((peer, h))
         return entry is not None and now < entry[0]
 
+    def reconfigure(self, config: ConnStateConfig) -> None:
+        """Live swap: existing entries keep their expiry; future offenses
+        use the new backoff/expiry values."""
+        self._config = config
+
 
 class ConnState:
     """Tracks pending (dialing/handshaking) and active conns per torrent."""
@@ -69,6 +90,13 @@ class ConnState:
         self.blacklist = Blacklist(self.config)
         self._pending: dict[InfoHash, set[PeerID]] = {}
         self._active: dict[InfoHash, set[PeerID]] = {}
+
+    def reconfigure(self, config: ConnStateConfig) -> None:
+        """Live limit swap: caps apply to the next admission decision;
+        existing conns are not torn down (churn/eviction shrinks toward
+        new caps naturally). Blacklist entries keep their current expiry."""
+        self.config = config
+        self.blacklist.reconfigure(config)
 
     def _count_global(self) -> int:
         return sum(len(s) for s in self._pending.values()) + sum(

@@ -103,6 +103,25 @@ class SchedulerConfig:
         self.piece_timeout = piece_timeout_seconds
         self.conn_churn_idle = conn_churn_idle_seconds
 
+    @classmethod
+    def from_dict(cls, doc: dict) -> "SchedulerConfig":
+        """Build from the YAML ``scheduler:`` section; ``conn_state`` is a
+        nested dict of ConnStateConfig fields."""
+        doc = dict(doc)
+        conn = doc.pop("conn_state", None)
+        import inspect
+
+        allowed = set(inspect.signature(cls.__init__).parameters) - {
+            "self", "conn_state"
+        }
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"unknown scheduler config keys: {sorted(unknown)}")
+        return cls(
+            conn_state=ConnStateConfig.from_dict(conn) if conn else None,
+            **doc,
+        )
+
 
 class _TorrentControl:
     def __init__(self, torrent: Torrent, namespace: str, dispatcher: Dispatcher):
@@ -167,6 +186,16 @@ class Scheduler:
         self._announce_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle ---------------------------------------------------------
+
+    def reload(self, config: SchedulerConfig) -> None:
+        """Live config swap (the reference's ReloadableScheduler). Pacing,
+        timeouts, and conn limits apply from the next tick or admission
+        decision; per-torrent dispatchers keep their pipeline settings
+        until their torrent is recreated (new torrents use the new
+        values). No torrent state is dropped."""
+        self.config = config
+        self.conn_state.reconfigure(config.conn_state)
+        _log.info("scheduler config reloaded")
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -283,9 +312,9 @@ class Scheduler:
         """ONE task paces every torrent's announces (announcequeue): each
         tick drains at most rate*tick due torrents, oldest-due first, so
         tracker load is bounded by config however many torrents exist."""
-        cfg = self.config
         carry = 0.0  # fractional budget: caps below 1/tick must still hold
         while True:
+            cfg = self.config  # re-read: reload() swaps the config live
             carry = min(
                 carry + cfg.max_announce_rate * cfg.announce_tick,
                 max(1.0, cfg.max_announce_rate),  # burst at most 1 s of budget

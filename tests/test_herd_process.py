@@ -13,6 +13,7 @@ import pathlib
 import signal
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -36,12 +37,12 @@ def herd():
                 p.kill()
 
 
-def spawn(args: list[str]) -> tuple[subprocess.Popen, dict]:
+def spawn(args: list[str], stderr=subprocess.DEVNULL) -> tuple[subprocess.Popen, dict]:
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, "-m", "kraken_tpu.cli", *args],
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=stderr,
         cwd=REPO,
         env=env,
         text=True,
@@ -192,3 +193,28 @@ def test_shipped_development_configs_boot(tmp_path):
         )
         procs.append(agent)
         assert oinfo["component"] == "origin" and ainfo["component"] == "agent"
+
+
+def test_sighup_reloads_scheduler_config(tmp_path):
+    """SIGHUP re-reads --config and applies the scheduler section live."""
+    cfg_path = tmp_path / "agent.yaml"
+    cfg_path.write_text("scheduler:\n  max_announce_rate: 50\n")
+    err_path = tmp_path / "agent.stderr"
+    with herd() as procs, open(err_path, "w") as err:
+        agent, _info = spawn(
+            ["agent", "--store", str(tmp_path / "a"),
+             "--config", str(cfg_path)],
+            stderr=err,
+        )
+        procs.append(agent)
+        cfg_path.write_text("scheduler:\n  max_announce_rate: 5\n")
+        agent.send_signal(signal.SIGHUP)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if "scheduler config reloaded" in err_path.read_text():
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                "reload log line never appeared: " + err_path.read_text()[-2000:]
+            )

@@ -250,3 +250,44 @@ def test_origin_archive_requires_blob(tmp_path):
     t = archive.create_torrent(mi)
     assert t.complete()
     assert t.bitfield() and t.read_piece(mi.num_pieces - 1)
+
+
+def test_scheduler_config_from_dict_and_reload():
+    """YAML `scheduler:` section builds a config (nested conn_state,
+    unknown keys rejected); Scheduler.reload applies limits live."""
+    import pytest
+
+    from kraken_tpu.p2p.connstate import ConnState
+    from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+    cfg = SchedulerConfig.from_dict({
+        "max_announce_rate": 7.0,
+        "piece_pipeline_limit": 4,
+        "conn_state": {"max_open_conns_per_torrent": 3, "max_global_conns": 9},
+    })
+    assert cfg.max_announce_rate == 7.0
+    assert cfg.conn_state.max_open_conns_per_torrent == 3
+
+    with pytest.raises(ValueError):
+        SchedulerConfig.from_dict({"nope": 1})
+    with pytest.raises(ValueError):
+        SchedulerConfig.from_dict({"conn_state": {"nope": 1}})
+
+    # reload swaps config + conn limits on a live ConnState.
+    state = ConnState(SchedulerConfig().conn_state)
+
+    from kraken_tpu.p2p.scheduler import Scheduler
+
+    sched = Scheduler.__new__(Scheduler)  # no IO: just the reload surface
+    sched.config = SchedulerConfig()
+    sched.conn_state = state
+    sched.reload(cfg)
+    assert sched.config.piece_pipeline_limit == 4
+    assert state.config.max_global_conns == 9
+    assert state.blacklist._config is cfg.conn_state
+
+    # Nested backoff dict coerces at load time, not first use.
+    c2 = SchedulerConfig.from_dict(
+        {"conn_state": {"blacklist_backoff": {"base_seconds": 10.0}}}
+    )
+    assert c2.conn_state.blacklist_backoff.delay(0) > 0
