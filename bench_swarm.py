@@ -85,14 +85,16 @@ class InMemoryTracker:
         return _Client()
 
 
-def make_peer(root, name, tracker, *, seed_blob=None, piece_kb=256):
+def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256):
     from kraken_tpu.p2p.connstate import ConnStateConfig
 
     store = CAStore(os.path.join(root, name))
     ref: dict = {}
-    if seed_blob is not None:
-        d = Digest.from_bytes(seed_blob)
-        store.create_cache_file(d, iter([seed_blob]))
+    is_origin = seed_blobs is not None
+    if is_origin:
+        for blob in seed_blobs:
+            d = Digest.from_bytes(blob)
+            store.create_cache_file(d, iter([blob]))
         archive = OriginTorrentArchive(store, BatchedVerifier())
     else:
         archive = AgentTorrentArchive(store, BatchedVerifier())
@@ -104,7 +106,7 @@ def make_peer(root, name, tracker, *, seed_blob=None, piece_kb=256):
         archive=archive,
         metainfo_client=client,
         announce_client=client,
-        is_origin=seed_blob is not None,
+        is_origin=is_origin,
         config=SchedulerConfig(
             announce_interval_seconds=0.5,
             retry_tick_seconds=0.5,
@@ -112,7 +114,7 @@ def make_peer(root, name, tracker, *, seed_blob=None, piece_kb=256):
             # Origins are servers: a 10-conn cap on the only initial seeder
             # strangles the flash crowd's first wave.
             conn_state=ConnStateConfig(
-                max_open_conns_per_torrent=64 if seed_blob is not None else 10
+                max_open_conns_per_torrent=64 if is_origin else 10
             ),
         ),
     )
@@ -131,7 +133,7 @@ async def run_bench(n_agents: int, blob_mb: int, piece_kb: int, root: str):
     tracker = InMemoryTracker()
     tracker.metainfos[d.hex] = metainfo
 
-    origin = make_peer(root, "origin", tracker, seed_blob=blob)
+    origin = make_peer(root, "origin", tracker, seed_blobs=[blob])
     agents = [
         make_peer(root, f"agent{i}", tracker) for i in range(n_agents)
     ]
@@ -170,19 +172,93 @@ async def run_bench(n_agents: int, blob_mb: int, piece_kb: int, root: str):
     }
 
 
+async def run_image_bench(
+    n_agents: int, layers_mb: list[int], piece_kb: int, root: str
+):
+    """BASELINE row 2 shape: a multi-layer image (sizes modeled on an
+    alpine+ubuntu layer set), N agents pull every layer concurrently; an
+    agent's pull latency is when its LAST layer lands (what `docker pull`
+    wall time means). One origin seeds all layers."""
+    rng = np.random.default_rng(1)
+    piece_len = piece_kb << 10
+    layers = []
+    tracker = InMemoryTracker()
+    for mb in layers_mb:
+        blob = rng.integers(0, 256, size=mb << 20, dtype=np.uint8).tobytes()
+        d = Digest.from_bytes(blob)
+        hashes = get_hasher("cpu").hash_pieces(blob, piece_len)
+        metainfo = MetaInfo(d, len(blob), piece_len, hashes.tobytes())
+        tracker.metainfos[d.hex] = metainfo
+        layers.append((blob, d, metainfo))
+
+    origin = make_peer(
+        root, "origin", tracker, seed_blobs=[b for b, _d, _m in layers]
+    )
+    agents = [make_peer(root, f"agent{i}", tracker) for i in range(n_agents)]
+    await origin.start()
+    for _blob, _d, mi in layers:
+        origin.seed(mi, NS)
+    for a in agents:
+        await a.start()
+
+    t0 = time.perf_counter()
+    latencies: list[float] = []
+
+    async def pull_image(a):
+        start = time.perf_counter()
+        await asyncio.gather(*(a.download(NS, d) for _b, d, _m in layers))
+        latencies.append(time.perf_counter() - start)
+
+    await asyncio.gather(*(pull_image(a) for a in agents))
+    wall = time.perf_counter() - t0
+    for sch in (origin, *agents):
+        await sch.stop()
+
+    lat = np.sort(np.asarray(latencies))
+    image_bytes = sum(len(b) for b, _d, _m in layers)
+    return {
+        "agents": n_agents,
+        "layers_mb": layers_mb,
+        "image_mb": image_bytes >> 20,
+        "p50_s": float(lat[int(0.50 * (len(lat) - 1))]),
+        "p99_s": float(lat[int(0.99 * (len(lat) - 1))]),
+        "wall_s": wall,
+        "swarm_gbps": image_bytes * n_agents / wall / 1e9,
+        "announces": tracker.announces,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--agents", type=int, default=100)
+    ap.add_argument("--agents", type=int, default=None,
+                    help="default: 100 (flash crowd) / 10 (--image)")
     ap.add_argument("--blob-mb", type=int, default=32)
     ap.add_argument("--piece-kb", type=int, default=256)
+    ap.add_argument("--image", action="store_true",
+                    help="BASELINE row 2: multi-layer alpine+ubuntu-shaped"
+                         " image pull (defaults --agents to 10)")
     args = ap.parse_args()
 
     import tempfile
 
     with tempfile.TemporaryDirectory(prefix="kt-bench-swarm-") as root:
-        out = asyncio.run(
-            run_bench(args.agents, args.blob_mb, args.piece_kb, root)
-        )
+        if args.image:
+            n = args.agents if args.agents is not None else 10
+            out = asyncio.run(run_image_bench(
+                n, [3, 29, 25, 5, 1], args.piece_kb, root
+            ))
+            print(json.dumps({
+                "metric": "image_pull_p99_latency",
+                "value": round(out["p99_s"], 4),
+                "unit": "s",
+                "vs_baseline": None,
+                "detail": out,
+            }))
+            return
+        out = asyncio.run(run_bench(
+            args.agents if args.agents is not None else 100,
+            args.blob_mb, args.piece_kb, root,
+        ))
     for metric, unit in (
         ("p50_s", "s"),
         ("swarm_pieces_per_s", "pieces/s"),

@@ -288,13 +288,40 @@ class RegistryServer:
 
     # -- listings ----------------------------------------------------------
 
+    @staticmethod
+    def _paginate(req: web.Request, items: list[str]):
+        """Registry v2 pagination: ?n=<max>&last=<exclusive start>. Adds
+        the RFC5988 Link header when a further page exists (docker clients
+        follow it for large repos). ``n`` must be positive -- n=0 would
+        return an empty page with no Link, which paging clients read as
+        "listing complete"."""
+        last = req.query.get("last", "")
+        if last:
+            items = [t for t in items if t > last]
+        n = req.query.get("n")
+        headers = {}
+        if n is not None:
+            try:
+                n = int(n)
+                if n <= 0:
+                    raise ValueError
+            except ValueError:
+                raise web.HTTPBadRequest(text="malformed n")
+            if len(items) > n:
+                items = items[:n]
+                headers["Link"] = (
+                    f'<{req.path}?n={n}&last={items[-1]}>; rel="next"'
+                )
+        return items, headers
+
     async def _tags_list(self, req: web.Request) -> web.Response:
         repo = req.match_info["repo"]
         try:
             tags = await self.transferer.list_repo_tags(repo)
         except Exception:
             tags = []
-        return web.json_response({"name": repo, "tags": tags})
+        tags, headers = self._paginate(req, sorted(tags))
+        return web.json_response({"name": repo, "tags": tags}, headers=headers)
 
     async def _catalog(self, req: web.Request) -> web.Response:
         # Backed by build-index listings (proxy/registryoverride in the
@@ -304,4 +331,5 @@ class RegistryServer:
         except Exception:
             tags = []
         repos = sorted({t.rpartition(":")[0] for t in tags if ":" in t})
-        return web.json_response({"repositories": repos})
+        repos, headers = self._paginate(req, repos)
+        return web.json_response({"repositories": repos}, headers=headers)

@@ -216,3 +216,44 @@ def test_cross_cluster_tag_replication(tmp_path):
             await stop_cluster(c2)
 
     asyncio.run(main())
+
+
+def test_tags_list_pagination(tmp_path):
+    """Registry v2 ?n=&last= pagination with the Link header (docker
+    clients page through large repos)."""
+
+    async def main():
+        c = await build_cluster(tmp_path, "a")
+        try:
+            http = HTTPClient()
+            config, layers, manifest = make_image(nlayers=1)
+            for tag in ["v1", "v2", "v3", "v4", "v5"]:
+                await push_image(
+                    http, c["proxy"].addr, "library/app", tag,
+                    config, layers, manifest,
+                )
+            url = f"http://{c['proxy'].addr}/v2/library/app/tags/list"
+            s = await http._get_session()
+
+            async with s.get(url, params={"n": "2"}) as r:
+                doc = await r.json()
+                assert doc["tags"] == ["v1", "v2"]
+                assert 'last=v2' in r.headers["Link"]
+            async with s.get(url, params={"n": "2", "last": "v2"}) as r:
+                doc = await r.json()
+                assert doc["tags"] == ["v3", "v4"]
+            async with s.get(url, params={"n": "2", "last": "v4"}) as r:
+                doc = await r.json()
+                assert doc["tags"] == ["v5"]
+                assert "Link" not in r.headers
+            async with s.get(url, params={"n": "bogus"}) as r:
+                assert r.status == 400
+            # n=0 would mean "empty page, no Link" = listing complete:
+            # rejected so paging clients can't mis-terminate.
+            async with s.get(url, params={"n": "0"}) as r:
+                assert r.status == 400
+            await http.close()
+        finally:
+            await stop_cluster(c)
+
+    asyncio.run(main())
