@@ -136,7 +136,14 @@ async def recv_message(reader: asyncio.StreamReader) -> Message:
         raw = await reader.readexactly(header_len + payload_len)
     except asyncio.IncompleteReadError as e:
         raise WireError("connection closed mid-frame") from e
-    header: Any = msgpack.unpackb(raw[:header_len]) if header_len else {}
+    try:
+        header: Any = msgpack.unpackb(raw[:header_len]) if header_len else {}
+    except Exception as e:
+        # msgpack surfaces corruption as several exception types (its own
+        # unpack errors, UnicodeDecodeError for non-utf8 raw strings,
+        # ValueError for depth/size) -- all of them are one thing to the
+        # conn plane: a malformed frame from a bad peer.
+        raise WireError(f"malformed header: {e}") from e
     if not isinstance(header, dict):
         raise WireError("malformed header")
     return Message(t, header, raw[header_len:])

@@ -291,3 +291,60 @@ def test_scheduler_config_from_dict_and_reload():
         {"conn_state": {"blacklist_backoff": {"base_seconds": 10.0}}}
     )
     assert c2.conn_state.blacklist_backoff.delay(0) > 0
+
+
+def test_wire_fuzz_corrupt_frames_raise_wireerror():
+    """Arbitrary bytes on the wire must surface as WireError (the conn
+    plane's one failure type), never as msgpack/struct internals escaping
+    to the dispatcher."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+
+    async def feed(raw: bytes):
+        reader = asyncio.StreamReader()
+        reader.feed_data(raw)
+        reader.feed_eof()
+        return await recv_message(reader)
+
+    async def main():
+        # 1) pure noise, many lengths
+        for n in (0, 1, 8, 9, 64, 4096):
+            for _ in range(50):
+                raw = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+                try:
+                    await feed(raw)
+                except WireError:
+                    pass  # the only acceptable failure
+        # 2) bit-flipped valid frames
+        valid = []
+
+        class Sink:
+            def __init__(self):
+                self.buf = bytearray()
+            def write(self, b):
+                self.buf += b
+            async def drain(self):
+                pass
+
+        for msg in (
+            Message.handshake("ab" * 20, "cd" * 32, "ef" * 32, "ns", b"\x01", 8),
+            Message.piece_payload(3, b"x" * 100),
+            Message.error("busy", "full"),
+        ):
+            sink = Sink()
+            await send_message(sink, msg)
+            valid.append(bytes(sink.buf))
+        for raw in valid:
+            got = await feed(raw)  # sanity: clean round trip
+            assert isinstance(got, Message)
+            for _ in range(200):
+                b = bytearray(raw)
+                i = int(rng.integers(0, len(b)))
+                b[i] ^= int(rng.integers(1, 256))
+                try:
+                    await feed(bytes(b))
+                except WireError:
+                    pass
+
+    asyncio.run(main())
