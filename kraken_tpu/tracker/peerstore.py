@@ -95,10 +95,23 @@ class _RespConn:
 
     async def pipeline(self, *commands):
         """Send several commands in one write, read all replies -- one RTT
-        instead of len(commands)."""
+        instead of len(commands). EVERY reply is consumed before a server
+        error is raised: bailing on the first -ERR would leave the later
+        replies in the stream and desync every subsequent command by one."""
         self.writer.write(b"".join(self._encode(c) for c in commands))
         await self.writer.drain()
-        return [await self._read_reply() for _ in commands]
+        replies = []
+        first_err: RespError | None = None
+        for _ in commands:
+            try:
+                replies.append(await self._read_reply())
+            except RespError as e:
+                if first_err is None:
+                    first_err = e
+                replies.append(e)
+        if first_err is not None:
+            raise first_err
+        return replies
 
     async def _read_reply(self):
         line = (await self.reader.readline()).rstrip(b"\r\n")
@@ -121,8 +134,22 @@ class _RespConn:
             n = int(rest)
             if n == -1:
                 return None
-            return [await self._read_reply() for _ in range(n)]
-        raise RespError(f"unknown RESP type {kind!r}")
+            # Same consume-everything rule for nested error elements.
+            items = []
+            first_err: RespError | None = None
+            for _ in range(n):
+                try:
+                    items.append(await self._read_reply())
+                except RespError as e:
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+            return items
+        # Unknown type byte = protocol garbage, not a server error reply:
+        # the stream position is unknowable (ValueError -> conn invalidated
+        # by the caller), unlike a clean "-ERR ..." RespError.
+        raise ValueError(f"unparseable RESP reply type {kind!r}")
 
     def close(self) -> None:
         self.writer.close()
@@ -165,9 +192,13 @@ class RedisPeerStore(PeerStore):
                     conn = await self._get_conn()
                     return await asyncio.wait_for(op(conn), self.timeout)
                 except (ConnectionError, OSError,
-                        asyncio.IncompleteReadError, asyncio.TimeoutError):
+                        asyncio.IncompleteReadError, asyncio.TimeoutError,
+                        ValueError):
                     # IncompleteReadError is an EOFError, not a
                     # ConnectionError: the server died mid-reply.
+                    # ValueError = unparseable reply bytes (protocol
+                    # garbage): the stream position is unknowable, so the
+                    # conn must not be reused either.
                     if self._conn is not None:
                         self._conn.close()
                     self._conn = None

@@ -361,3 +361,73 @@ def test_dedup_index_bounded(tmp_path):
     index.add_blob_sync(digests[0])
     assert digests[0].hex in index._indexed
     assert index.stats()["blobs"] == 5
+
+
+def test_redis_peerstore_survives_protocol_garbage():
+    """A reply the client cannot parse must invalidate the connection
+    (stream position unknowable) and recover on the next command -- never
+    leave a desynced stream that shifts every later reply."""
+    async def main():
+        class GarbageOnce(FakeRedis):
+            def __init__(self):
+                super().__init__()
+                self.garbage_next = False
+
+            def _dispatch(self, args):
+                if self.garbage_next:
+                    self.garbage_next = False
+                    return b"\xff\xfe not resp at all\r\n"
+                return super()._dispatch(args)
+
+        async with GarbageOnce() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30, timeout_seconds=2)
+            try:
+                await store.update("h", _peer(1))
+                srv.garbage_next = True
+                # First attempt hits the garbage reply -> conn invalidated
+                # -> retry reconnects onto a clean stream and succeeds.
+                got = await store.get_peers("h")
+                assert [p.ip for p in got] == ["10.0.0.1"]
+                # And the store keeps working on a clean stream.
+                await store.update("h", _peer(2))
+                assert len(await store.get_peers("h")) == 2
+            finally:
+                await store.close()
+
+    asyncio.run(main())
+
+
+def test_redis_peerstore_pipeline_error_keeps_stream_synced():
+    """A server error mid-pipeline (e.g. WRONGTYPE on HSET) must consume
+    the remaining replies: the NEXT command must read its own reply, not
+    the pipelined EXPIRE's leftover ':1'."""
+    async def main():
+        class WrongTypeOnce(FakeRedis):
+            def __init__(self):
+                super().__init__()
+                self.fail_hset_once = False
+
+            def _dispatch(self, args):
+                if self.fail_hset_once and args[0].upper() == b"HSET":
+                    self.fail_hset_once = False
+                    return b"-WRONGTYPE key holds another kind of value\r\n"
+                return super()._dispatch(args)
+
+        from kraken_tpu.tracker.peerstore import RespError
+
+        async with WrongTypeOnce() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30, timeout_seconds=2)
+            try:
+                await store.update("h", _peer(1))
+                srv.fail_hset_once = True
+                with pytest.raises(RespError):
+                    await store.update("h", _peer(2))
+                # Stream stayed synced: reads and writes keep working.
+                got = await store.get_peers("h")
+                assert [p.ip for p in got] == ["10.0.0.1"]
+                await store.update("h", _peer(3))
+                assert len(await store.get_peers("h")) == 2
+            finally:
+                await store.close()
+
+    asyncio.run(main())
