@@ -182,6 +182,8 @@ class MetaInfo:
     def deserialize(cls, raw: bytes) -> "MetaInfo":
         try:
             doc = json.loads(raw)
+            if not isinstance(doc, dict):
+                raise MetaInfoError("metainfo document is not an object")
             if doc.get("version") != 1:
                 raise MetaInfoError(f"unsupported metainfo version: {doc.get('version')}")
             info = doc["info"]
@@ -191,11 +193,15 @@ class MetaInfo:
                 piece_length=info["piece_length"],
                 piece_hashes=bytes.fromhex(info["piece_hashes"]),
             )
-        except (KeyError, TypeError, ValueError) as e:
+            name = info["name"]
+        # AttributeError: non-dict/str values where the shape expects one
+        # (e.g. an int digest reaching Digest.parse) -- this comes off the
+        # wire, so any shape error is one thing: malformed metainfo.
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
             if isinstance(e, MetaInfoError):
                 raise
             raise MetaInfoError(f"malformed metainfo: {e}") from e
-        if info["name"] != mi.name:
+        if name != mi.name:
             raise MetaInfoError("info name does not match digest")
         return mi
 

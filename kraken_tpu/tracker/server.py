@@ -54,8 +54,16 @@ class TrackerServer:
         try:
             doc = await req.json()
             info_hash = doc["info_hash"]
+            if not isinstance(info_hash, str):
+                # Opaque but must be a string: swarm keys are typed
+                # (and e.g. a list is unhashable only at store time).
+                raise ValueError("info_hash must be a string")
             peer = PeerInfo.from_dict(doc["peer"])
-        except (json.JSONDecodeError, KeyError, ValueError) as e:
+        except (json.JSONDecodeError, KeyError, ValueError,
+                TypeError, AttributeError) as e:
+            # TypeError/AttributeError: right keys, wrong shapes (a list
+            # where an object belongs) -- still a malformed announce, not
+            # a server error.
             raise web.HTTPBadRequest(text=f"malformed announce: {e}")
         # Record BEFORE reading: the store calls suspend the handler, so a
         # flash crowd of first announces handled read-first would all

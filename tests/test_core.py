@@ -176,3 +176,45 @@ class TestCPUPieceHasher:
         assert get_hasher("cpu") is get_hasher("cpu")
         with pytest.raises(KeyError):
             get_hasher("nope")
+
+
+def test_metainfo_deserialize_fuzz_only_metainfoerror():
+    """Metainfo comes off the wire (tracker proxy): any corruption --
+    structural or bit-level -- must surface as MetaInfoError, never a raw
+    KeyError/AttributeError escaping to the scheduler."""
+    import json
+
+    import numpy as np
+
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.core.metainfo import MetaInfo, MetaInfoError
+
+    rng = np.random.default_rng(5)
+    blob = b"x" * 1000
+    mi = MetaInfo(
+        Digest.from_bytes(blob), len(blob), 1024,
+        __import__("hashlib").sha256(blob).digest(),
+    )
+    raw = mi.serialize()
+    doc = json.loads(raw)
+    cases = [
+        b"", b"null", b"[]", b'"x"', b"{}", b'{"version":1}',
+        b'{"version":1,"info":[]}', b'{"version":1,"info":{}}',
+        json.dumps({**doc, "info": {
+            k: v for k, v in doc["info"].items() if k != "name"
+        }}).encode(),
+        json.dumps({**doc, "digest": 5}).encode(),
+        json.dumps({**doc, "info": {**doc["info"], "piece_hashes": "zz"}}).encode(),
+        json.dumps({**doc, "info": {**doc["info"], "length": "big"}}).encode(),
+    ]
+    for _ in range(300):
+        b = bytearray(raw)
+        i = int(rng.integers(0, len(b)))
+        b[i] ^= int(rng.integers(1, 256))
+        cases.append(bytes(b))
+    for c in cases:
+        try:
+            got = MetaInfo.deserialize(c)
+            assert got.digest == mi.digest  # survived mutation unchanged
+        except MetaInfoError:
+            pass  # the only acceptable failure type

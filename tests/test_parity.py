@@ -431,3 +431,39 @@ def test_redis_peerstore_pipeline_error_keeps_stream_synced():
                 await store.close()
 
     asyncio.run(main())
+
+
+def test_announce_shape_garbage_is_400():
+    """Wrong-shaped announce bodies (right keys, wrong types) must be 400s,
+    not 500s."""
+    from aiohttp import ClientSession
+
+    from kraken_tpu.assembly import TrackerNode
+
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+        try:
+            async with ClientSession() as http:
+                for body in (
+                    b"[]", b"null", b'{"info_hash": "x"}',
+                    b'{"info_hash": "x", "peer": []}',
+                    b'{"info_hash": "x", "peer": "y"}',
+                    b'{"info_hash": ["x"], "peer": {"peer_id": 5}}',
+                    # unhashable info_hash with a perfectly VALID peer:
+                    # must 400 at parse, not 500 at store time
+                    b'{"info_hash": ["x"], "peer": {"peer_id": "'
+                    + b"ab" * 20 + b'", "ip": "1.2.3.4", "port": 1}}',
+                    b'{"info_hash": 5, "peer": {"peer_id": "'
+                    + b"ab" * 20 + b'", "ip": "1.2.3.4", "port": 1}}',
+                    b'{"info_hash": "x", "peer": {"peer_id": 5, "ip": 1, "port": []}}',
+                ):
+                    async with http.post(
+                        f"http://{tracker.addr}/announce", data=body,
+                        headers={"Content-Type": "application/json"},
+                    ) as r:
+                        assert r.status == 400, (body, r.status)
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())
