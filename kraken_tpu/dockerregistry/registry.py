@@ -180,17 +180,49 @@ class RegistryServer:
             "Content-Type": "application/octet-stream",
         }
         if not is_temp:
+            # FileResponse handles Range natively (docker resumes
+            # interrupted layer pulls with byte ranges).
             return web.FileResponse(path, headers=headers)
         try:
-            resp = web.StreamResponse(headers={
-                **headers, "Content-Length": str(os.path.getsize(path)),
+            size = os.path.getsize(path)
+            start, end = 0, size - 1
+            status = 200
+            # aiohttp's own Range parser -- the same one FileResponse (the
+            # agent-flavor path) uses, so both registry flavors agree on
+            # lenient/strict cases. Malformed ranges fall back to a full
+            # 200 body (permitted by RFC 9110).
+            try:
+                rng = req.http_range
+            except ValueError:
+                rng = slice(None, None)
+            if rng.start is not None or rng.stop is not None:
+                start = rng.start if rng.start is not None else 0
+                if start < 0:  # suffix range: bytes=-N
+                    start = max(0, size + start)
+                # Clamp an end past EOF to the last byte (RFC 9110: a
+                # too-large last-byte-pos is satisfiable).
+                end = min(rng.stop - 1 if rng.stop is not None else end,
+                          size - 1)
+                if start >= size or start > end:
+                    raise web.HTTPRequestRangeNotSatisfiable(
+                        headers={"Content-Range": f"bytes */{size}"}
+                    )
+                status = 206
+                headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+            resp = web.StreamResponse(status=status, headers={
+                **headers, "Content-Length": str(end - start + 1),
             })
             await resp.prepare(req)
             with open(path, "rb") as f:
-                while True:
-                    chunk = await asyncio.to_thread(f.read, 1 << 20)
+                f.seek(start)
+                remaining = end - start + 1
+                while remaining:
+                    chunk = await asyncio.to_thread(
+                        f.read, min(1 << 20, remaining)
+                    )
                     if not chunk:
                         break
+                    remaining -= len(chunk)
                     await resp.write(chunk)
             await resp.write_eof()
             return resp

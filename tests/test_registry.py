@@ -257,3 +257,54 @@ def test_tags_list_pagination(tmp_path):
             await stop_cluster(c)
 
     asyncio.run(main())
+
+
+def test_blob_get_range_resume(tmp_path):
+    """Byte-range blob GETs (docker's pull-resume) on both registry
+    flavors: the agent's FileResponse path and the proxy's spooled-temp
+    streaming path."""
+
+    async def main():
+        c = await build_cluster(tmp_path, "a")
+        try:
+            http = HTTPClient()
+            config, layers, manifest = make_image(nlayers=1, layer_size=300_000)
+            await push_image(
+                http, c["proxy"].addr, "library/app", "v1",
+                config, layers, manifest,
+            )
+            layer = layers[0]
+            d = str(Digest.from_bytes(layer))
+            s = await http._get_session()
+            for registry in (c["proxy"].addr, c["agent"].registry_addr):
+                url = f"http://{registry}/v2/library/app/blobs/{d}"
+                async with s.get(url) as r:  # whole blob sanity
+                    assert r.status == 200 and await r.read() == layer
+                async with s.get(
+                    url, headers={"Range": "bytes=100000-"}
+                ) as r:
+                    assert r.status == 206, await r.text()
+                    assert await r.read() == layer[100000:]
+                    assert r.headers["Content-Range"].startswith(
+                        "bytes 100000-"
+                    )
+                async with s.get(
+                    url, headers={"Range": "bytes=1000-1999"}
+                ) as r:
+                    assert r.status == 206
+                    assert await r.read() == layer[1000:2000]
+                # end past EOF is satisfiable (clamped), per RFC 9110
+                async with s.get(
+                    url, headers={"Range": "bytes=100000-999999999"}
+                ) as r:
+                    assert r.status == 206
+                    assert await r.read() == layer[100000:]
+                async with s.get(
+                    url, headers={"Range": f"bytes={len(layer)}-"}
+                ) as r:
+                    assert r.status == 416
+            await http.close()
+        finally:
+            await stop_cluster(c)
+
+    asyncio.run(main())
