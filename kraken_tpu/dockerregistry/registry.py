@@ -240,6 +240,29 @@ class RegistryServer:
         self._check_writable()
         self._purge_stale_uploads()
         repo = req.match_info["repo"]
+        # Cross-repo mount (?mount=<digest>&from=<repo>): blobs are
+        # content-addressed, so if the cluster has (or can restore) the
+        # bytes, the origin ADOPTS them into the target namespace --
+        # namespace sidecar + writeback, as durable as a real upload --
+        # and the mount answers 201 with no upload session. Any miss or
+        # parse failure falls through to the normal 202 flow, which is
+        # the spec's mandated fallback.
+        mount = req.query.get("mount")
+        if mount:
+            source = req.query.get("from", repo)
+            try:
+                d = Digest.parse(mount)
+                mounted = await self.transferer.mount(source, repo, d)
+            except Exception:
+                mounted = False
+            if mounted:
+                return web.Response(
+                    status=201,
+                    headers={
+                        "Location": f"/v2/{repo}/blobs/{d}",
+                        "Docker-Content-Digest": str(d),
+                    },
+                )
         uid = uuidlib.uuid4().hex
         with open(self._upload_path(uid), "wb"):
             pass

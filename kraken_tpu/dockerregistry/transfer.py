@@ -33,6 +33,7 @@ class ImageTransferer(Protocol):
     async def upload_file(
         self, namespace: str, d: Digest, path: str
     ) -> None: ...
+    async def mount(self, source: str, target: str, d: Digest) -> bool: ...
     async def get_tag(self, tag: str) -> Optional[Digest]: ...
     async def put_tag(self, tag: str, d: Digest) -> None: ...
     async def list_repo_tags(self, repo: str) -> list[str]: ...
@@ -70,6 +71,9 @@ class ReadOnlyTransferer:
         raise PermissionError("agent registry is read-only; push via the proxy")
 
     async def upload_file(self, namespace: str, d: Digest, path: str) -> None:
+        raise PermissionError("agent registry is read-only; push via the proxy")
+
+    async def mount(self, source: str, target: str, d: Digest) -> bool:
         raise PermissionError("agent registry is read-only; push via the proxy")
 
     async def get_tag(self, tag: str) -> Optional[Digest]:
@@ -115,6 +119,14 @@ class ProxyTransferer:
         dest = os.path.join(self._spool, f"{d.hex}.{uuidlib.uuid4().hex}")
         await self.origins.download_to_file(namespace, d, dest)
         return dest, True
+
+    async def mount(self, source: str, target: str, d: Digest) -> bool:
+        """Cross-repo blob mount: blobs are content-addressed, so the
+        origin just adopts the existing bytes into the target namespace
+        (durable: namespace sidecar + writeback, with backend read-through
+        from the source if the cache evicted them). False = not found
+        anywhere; the registry falls back to a normal upload session."""
+        return await self.origins.adopt(target, d, source)
 
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
         await self.origins.upload(namespace, d, data)

@@ -66,6 +66,18 @@ class BlobClient:
         )
         return MetaInfo.deserialize(raw)
 
+    async def adopt(self, namespace: str, d: Digest, source: str) -> None:
+        """Cross-repo mount support: associate an existing blob with
+        ``namespace`` (reads through from ``source`` if evicted)."""
+        await self._http.post(
+            self._url(
+                f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/adopt"
+                f"?source={quote(source, safe='')}"
+            ),
+            ok_statuses=(201,),
+            retry_5xx=False,
+        )
+
     async def upload(self, namespace: str, d: Digest, data: bytes,
                      chunk_size: int = 16 * 1024 * 1024) -> None:
         """Chunked upload: start -> PATCH chunks -> commit."""
@@ -211,6 +223,27 @@ class ClusterClient:
 
     async def download(self, namespace: str, d: Digest) -> bytes:
         return await self._try_each(d, lambda c: c.download(namespace, d))
+
+    async def adopt(self, namespace: str, d: Digest, source: str) -> bool:
+        """Cross-repo mount: adopt the blob into ``namespace``. Writes go
+        to EVERY replica (like upload -- the namespace sidecar, writeback,
+        and replication intents should be as durable as a real push);
+        True if at least one replica adopted, False if none could (the
+        registry then falls back to a normal upload session)."""
+        clients = self.clients_for(d)
+        ok = False
+        for c in clients:
+            try:
+                await c.adopt(namespace, d, source)
+                self._report(c, True)
+                ok = True
+            except HTTPError as e:
+                # A clean 404 ("I can't find those bytes") is a healthy
+                # answer, not a node failure.
+                self._report(c, e.status == 404)
+            except Exception:
+                self._report(c, False)
+        return ok
 
     async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
         return await self._try_each(d, lambda c: c.get_metainfo(namespace, d))

@@ -100,6 +100,7 @@ class OriginServer:
         r.add_post("/namespace/{ns}/blobs/{d}/uploads", self._start_upload)
         r.add_patch("/namespace/{ns}/blobs/{d}/uploads/{uid}", self._patch_upload)
         r.add_put("/namespace/{ns}/blobs/{d}/uploads/{uid}/commit", self._commit)
+        r.add_post("/namespace/{ns}/blobs/{d}/adopt", self._adopt)
         r.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
         r.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
         r.add_get("/namespace/{ns}/blobs/{d}/similar", self._similar)
@@ -171,6 +172,20 @@ class OriginServer:
             self.writeback.enqueue(ns, d)
         self._enqueue_replication(ns, d)
         self._schedule_dedup(d)
+
+    async def _adopt(self, req: web.Request) -> web.Response:
+        """Associate an EXISTING blob with a (new) namespace -- the server
+        side of a cross-repo registry mount. Reads through to the SOURCE
+        namespace's backend if the cache evicted the bytes, then runs the
+        full commit path under the target namespace (namespace sidecar,
+        seed, writeback, replication) so the adoption is as durable as an
+        upload. 404 if the blob is nowhere to be found."""
+        ns = urllib.parse.unquote(req.match_info["ns"])
+        d = self._digest(req)
+        source = req.query.get("source", ns)
+        await self._ensure_local(source, d)
+        await self._post_commit(ns, d)
+        return web.Response(status=201)
 
     def _schedule_dedup(self, d: Digest) -> None:
         """Chunk+sketch+index off the request path; failures are non-fatal

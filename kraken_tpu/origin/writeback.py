@@ -38,8 +38,11 @@ class WritebackExecutor:
         if self.backends.try_get_client(namespace) is None:
             return  # namespace has no durable backend configured
         pin(self.store, d, KIND)
+        # Digest-first key: the unpin logic prefix-scans for other pending
+        # writebacks of the same blob (a cross-repo mount enqueues a second
+        # namespace's writeback for the same bytes).
         self.retry.add(
-            Task(kind=KIND, key=f"{namespace}:{d.hex}",
+            Task(kind=KIND, key=f"{d.hex}:{namespace}",
                  payload={"namespace": namespace, "digest": d.hex})
         )
 
@@ -49,5 +52,11 @@ class WritebackExecutor:
         client = self.backends.get_client(namespace)
         data = await asyncio.to_thread(self.store.read_cache_file, d)
         await client.upload(namespace, d.hex, data)  # backend owns pathing
-        # Landed durably: drop the writeback pin (other pins may remain).
-        unpin(self.store, d, KIND)
+        # Landed durably: drop the writeback pin -- but only once no OTHER
+        # pending writeback references this blob (the pin is a reason-set,
+        # not a counter: the first namespace's writeback landing must not
+        # expose the bytes to eviction while a second namespace's -- from
+        # a cross-repo mount -- is still queued). The current task counts
+        # until the retry manager marks it done, hence <= 1.
+        if self.retry.store.count_pending(KIND, f"{d.hex}:") <= 1:
+            unpin(self.store, d, KIND)

@@ -308,3 +308,110 @@ def test_blob_get_range_resume(tmp_path):
             await stop_cluster(c)
 
     asyncio.run(main())
+
+
+def test_cross_repo_blob_mount(tmp_path):
+    """POST /blobs/uploads/?mount=&from= short-circuits to 201 when the
+    cluster already holds the bytes (content-addressed); unknown digests
+    fall back to a normal 202 upload session."""
+
+    async def main():
+        c = await build_cluster(tmp_path, "a")
+        try:
+            http = HTTPClient()
+            config, layers, manifest = make_image(nlayers=1)
+            await push_image(
+                http, c["proxy"].addr, "library/app", "v1",
+                config, layers, manifest,
+            )
+            d = str(Digest.from_bytes(layers[0]))
+            s = await http._get_session()
+            async with s.post(
+                f"http://{c['proxy'].addr}/v2/library/other/blobs/uploads/",
+                params={"mount": d, "from": "library/app"},
+            ) as r:
+                assert r.status == 201, await r.text()
+                assert r.headers["Docker-Content-Digest"] == d
+                assert r.headers["Location"].endswith(f"/blobs/{d}")
+            # The 201 must be backed by behavior: the blob serves under
+            # the TARGET repo, and the origin adopted it durably into the
+            # target namespace (sidecar the repair/writeback paths use).
+            got = await http.get(
+                f"http://{c['proxy'].addr}/v2/library/other/blobs/{d}"
+            )
+            assert got == layers[0]
+            from kraken_tpu.store.metadata import NamespaceMetadata
+
+            md = c["origin"].store.get_metadata(
+                Digest.parse(d), NamespaceMetadata
+            )
+            assert md is not None and md.namespace == "library/other"
+            # Unknown digest -> regular upload session.
+            missing = "sha256:" + "0" * 64
+            async with s.post(
+                f"http://{c['proxy'].addr}/v2/library/other/blobs/uploads/",
+                params={"mount": missing, "from": "library/app"},
+            ) as r:
+                assert r.status == 202
+                assert "Docker-Upload-UUID" in r.headers
+            await http.close()
+        finally:
+            await stop_cluster(c)
+
+    asyncio.run(main())
+
+
+def test_mount_second_writeback_keeps_pin_until_both_land(tmp_path):
+    """The writeback pin is a reason-set, not a counter: after a cross-repo
+    mount there are TWO pending writebacks for one blob, and the first to
+    land must not expose the bytes to eviction while the second is queued."""
+    from kraken_tpu.backend import Manager as BackendManager
+    from kraken_tpu.store.metadata import PersistMetadata
+
+    async def main():
+        backends = BackendManager(
+            [{"namespace": ".*", "backend": "file",
+              "config": {"root": str(tmp_path / "remote")}}]
+        )
+        tracker = TrackerNode(announce_interval_seconds=0.1)
+        await tracker.start()
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"), tracker_addr=tracker.addr,
+            backends=backends,
+        )
+        await origin.start()
+        ring = Ring(HostList(static=[origin.addr]), max_replica=1)
+        cluster = ClusterClient(ring)
+        try:
+            blob = os.urandom(100_000)
+            d = Digest.from_bytes(blob)
+            await cluster.upload("ns-a", d, blob)
+            assert await cluster.adopt("ns-b", d, "ns-a")
+
+            # Two writebacks pending for one digest.
+            from kraken_tpu.origin.writeback import KIND
+
+            assert origin.retry.store.count_pending(KIND, f"{d.hex}:") == 2
+
+            # Run ONE task: pin must survive (the other writeback still
+            # needs the bytes).
+            await origin.retry.run_once()
+            md = origin.store.get_metadata(d, PersistMetadata)
+            remaining = origin.retry.store.count_pending(KIND, f"{d.hex}:")
+            if remaining:  # first landed, second queued
+                assert md is not None and KIND in md.reasons
+                await origin.retry.run_once()
+            # Both landed: pin released, both backends have the bytes.
+            md = origin.store.get_metadata(d, PersistMetadata)
+            assert md is None or KIND not in md.reasons
+            from kraken_tpu.backend.base import make_backend
+
+            be = make_backend("file", {"root": str(tmp_path / "remote")})
+            assert await be.download("ns-a", d.hex) == blob
+            assert await be.download("ns-b", d.hex) == blob
+        finally:
+            await cluster.close()
+            await origin.stop()
+            await tracker.stop()
+
+    asyncio.run(main())
