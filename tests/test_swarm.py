@@ -294,3 +294,70 @@ def test_announce_rate_bounded_at_scale(tmp_path):
             await sched.stop()
 
     asyncio.run(main())
+
+
+def test_seeder_dies_mid_pull_then_returns(tmp_path):
+    """The only seeder dies mid-transfer; the leecher's request timeouts +
+    retry ticks keep the torrent alive, and when a seeder returns on the
+    SAME address the download completes -- no manual intervention, no
+    restart of the leecher (the failure-recovery story of SURVEY.md SS5
+    at the swarm layer)."""
+
+    async def main():
+        from kraken_tpu.store import PieceStatusMetadata
+
+        blob = os.urandom(2 * 1024 * 1024)
+        mi = make_metainfo(blob, piece_length=4096)  # 512 pieces
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        seeder, _sstore = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(tmp_path, "leecher", tracker)
+        await start_all(seeder, leecher)
+        port = seeder.port  # rebind here after the "crash"
+        stopped = asyncio.Event()
+
+        async def kill_when_partial():
+            # Deterministically mid-pull: wait for SOME but well under all
+            # pieces (a near-complete trigger could let the download finish
+            # before stop() lands). Bail if the download somehow completes
+            # first -- completion DELETES the piece-status sidecar, so the
+            # poll would otherwise spin forever.
+            while True:
+                await asyncio.sleep(0.005)
+                if lstore.in_cache(mi.digest):
+                    raise AssertionError("download finished before the kill")
+                st = lstore.get_metadata(mi.digest, PieceStatusMetadata)
+                if st is not None and 0 < st.count() < mi.num_pieces // 2:
+                    break
+            await seeder.stop()
+            stopped.set()
+            await asyncio.sleep(1.0)  # swarm starves: the only seeder is gone
+            reborn, _ = make_peer(
+                tmp_path, "seeder", tracker, seed_blob=blob
+            )
+            reborn.port = port
+            await reborn.start()
+            reborn.seed(mi, NS)
+            return reborn
+
+        seeder.seed(mi, NS)
+        kill_task = asyncio.create_task(kill_when_partial())
+        try:
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 30)
+            assert lstore.read_cache_file(mi.digest) == blob
+            assert stopped.is_set(), "seeder never actually died mid-test"
+        finally:
+            # Bounded, and never mask the try-body's failure: the leecher
+            # must stop even if the kill task itself blew up.
+            reborn = None
+            try:
+                reborn = await asyncio.wait_for(
+                    asyncio.shield(kill_task), 10
+                )
+            except Exception:
+                kill_task.cancel()
+            scheds = [leecher] + ([reborn] if reborn is not None else [])
+            await stop_all(*scheds)
+
+    asyncio.run(main())
