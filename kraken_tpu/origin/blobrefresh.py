@@ -36,6 +36,16 @@ class Refresher:
             return
         await self._coalescer.get(d.hex, lambda: self._pull(namespace, d))
 
+    async def stat(self, namespace: str, d: Digest):
+        """Cheap durable-existence check: backend stat WITHOUT restoring
+        the bytes. Raises BlobNotFoundError on a true miss (including "no
+        backend for this namespace"); transient backend failures propagate
+        so callers can distinguish "not there" from "can't tell"."""
+        client = self.backends.try_get_client(namespace)
+        if client is None:
+            raise BlobNotFoundError(f"no backend for namespace {namespace!r}")
+        return await client.stat(namespace, d.hex)
+
     async def _pull(self, namespace: str, d: Digest) -> None:
         client = self.backends.try_get_client(namespace)
         if client is None:

@@ -32,10 +32,17 @@ class BlobClient:
     def _url(self, path: str) -> str:
         return f"{base_url(self.addr)}{path}"
 
-    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+    async def stat(
+        self, namespace: str, d: Digest, local_only: bool = False
+    ) -> Optional[BlobInfo]:
+        """``local_only`` asks "do YOU cache the bytes" (repair semantics)
+        instead of "does the cluster durably have them"."""
+        suffix = "?local=true" if local_only else ""
         try:
             body = await self._http.get(
-                self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/stat"),
+                self._url(
+                    f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/stat{suffix}"
+                ),
                 retry_5xx=False,
             )
         except HTTPError as e:

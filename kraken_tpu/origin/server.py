@@ -309,7 +309,10 @@ class OriginServer:
         for owner in dict.fromkeys([addr, *owners]):
             peer = BlobClient(owner)
             try:
-                if await peer.stat(ns, d) is not None:
+                # local_only: "owner HOLDS the bytes and can replicate
+                # onward" -- a durable-backend answer would retire the
+                # repair while zero cached copies exist on the ring.
+                if await peer.stat(ns, d, local_only=True) is not None:
                     self._unpin_if_last_replication(d)
                     return
             except Exception as e:
@@ -353,11 +356,31 @@ class OriginServer:
         self._schedule_dedup(d)
 
     async def _stat(self, req: web.Request) -> web.Response:
+        ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         try:
             size = self.store.cache_size(d)
         except KeyError:
-            raise web.HTTPNotFound(text="blob not found")
+            # Not cached. ?local=true keeps cache-only semantics -- the
+            # replication lost-check means "do YOU hold the bytes", and a
+            # durable-backend answer there would retire repair tasks while
+            # ring redundancy is actually zero cached copies.
+            if req.query.get("local") == "true" or self.refresher is None:
+                raise web.HTTPNotFound(text="blob not found")
+            # Possibly durable: answer from a cheap backend stat WITHOUT
+            # restoring the bytes. Stat and download must agree -- docker
+            # HEADs a blob to decide whether to push it, and a 404 for a
+            # blob GET would serve means needless multi-GB re-uploads.
+            try:
+                info = await self.refresher.stat(ns, d)
+            except BlobNotFoundError:
+                raise web.HTTPNotFound(text="blob not found")
+            except Exception:
+                # "Can't tell" must NOT read as "not there": a transient
+                # backend outage would otherwise trigger re-uploads and
+                # false LOST verdicts downstream.
+                raise web.HTTPBadGateway(text="backend stat failed")
+            return web.json_response({"size": info.size})
         return web.json_response({"size": size})
 
     def _touch(self, d: Digest) -> None:

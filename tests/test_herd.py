@@ -318,3 +318,52 @@ def test_origin_restart_skips_corrupt_blob(tmp_path):
             await teardown(tracker, origins, agents, cluster)
 
     asyncio.run(main())
+
+
+def test_stat_reads_through_to_backend_after_eviction(tmp_path):
+    """HEAD/stat and GET must agree: a blob evicted from the origin cache
+    but durable in the backend stats 200 (cheap backend stat, no restore),
+    because docker HEADs blobs to decide whether to re-push them."""
+
+    async def main():
+        backends = BackendManager(
+            [{"namespace": ".*", "backend": "file",
+              "config": {"root": str(tmp_path / "remote")}}]
+        )
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, n_agents=0, backends=backends
+        )
+        try:
+            blob = os.urandom(120_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)
+            # Writeback to the backend, then evict the cache copy.
+            for _ in range(50):
+                await origins[0].retry.run_once()
+                from kraken_tpu.backend.base import make_backend
+
+                be = make_backend("file", {"root": str(tmp_path / "remote")})
+                try:
+                    await be.download("ns", d.hex)
+                    break
+                except Exception:
+                    await asyncio.sleep(0.05)
+            origins[0].store.delete_cache_file(d)
+            assert not origins[0].store.in_cache(d)
+
+            info = await oc.stat("ns", d)
+            assert info is not None and info.size == len(blob)
+            # And the bytes did NOT get restored by the stat.
+            assert not origins[0].store.in_cache(d)
+            # Repair semantics: local_only means "do YOU cache the bytes",
+            # so the evicted copy answers 404 even though it is durable.
+            assert await oc.stat("ns", d, local_only=True) is None
+            # GET still restores + serves.
+            got = await oc.download("ns", d)
+            assert got == blob
+            await oc.close()
+        finally:
+            await teardown(tracker, origins, agents, cluster)
+
+    asyncio.run(main())
