@@ -81,6 +81,10 @@ class AgentServer:
     async def _delete(self, req: web.Request) -> web.Response:
         d = self._digest(req)
         await asyncio.to_thread(self.store.delete_cache_file, d)
+        if self.scheduler is not None:
+            # A deleted blob leaves the swarm (post-unlink, so a racing
+            # handshake cannot resurrect the control).
+            self.scheduler.unseed(d)
         return web.Response(status=204)
 
     async def _health(self, req: web.Request) -> web.Response:

@@ -200,9 +200,9 @@ class OriginNode:
         self.self_addr = self_addr
         self.cleanup = (
             CleanupManager(
-                self.store,
-                cleanup,
+                self.store, cleanup,
                 on_evict=self.dedup.remove_sync if self.dedup else None,
+                after_evict=self._after_evict,
             )
             if cleanup
             else None
@@ -220,11 +220,22 @@ class OriginNode:
         self._health_task: Optional[asyncio.Task] = None
         self._cleanup_task: Optional[asyncio.Task] = None
         self._reseed_task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._repair_tasks: set[asyncio.Task] = set()
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.http_port}"
+
+    def _after_evict(self, d: Digest) -> None:
+        """Runs in the cleanup sweep's worker thread AFTER the bytes are
+        gone: stop seeding (hop to the event loop -- scheduler state is
+        loop-owned). Post-delete ordering matters: unseeding while the
+        blob still existed would let an inbound handshake resurrect the
+        control via the metainfo resolver."""
+        loop, sched = self._loop, self.scheduler
+        if loop is not None and sched is not None:
+            loop.call_soon_threadsafe(sched.unseed, d)
 
     def _resolve_metainfo(self, name: str, namespace: str):
         try:
@@ -233,6 +244,7 @@ class OriginNode:
             return None
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         # Fixed p2p port -> stable addr_hash identity across restarts (the
         # reference's default); ephemeral port -> random identity.
         factory = PeerIDFactory(
@@ -575,7 +587,11 @@ class AgentNode:
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root)
         self.verifier = BatchedVerifier(hasher=get_hasher(hasher))
-        self.cleanup = CleanupManager(self.store, cleanup) if cleanup else None
+        self.cleanup = (
+            CleanupManager(self.store, cleanup, after_evict=self._after_evict)
+            if cleanup
+            else None
+        )
         self.scheduler_config = scheduler_config
         self.ssl_context = ssl_context
         self.scheduler: Optional[Scheduler] = None
@@ -585,10 +601,19 @@ class AgentNode:
         self._tracker_client: Optional[TrackerClient] = None
         self._tag_client = None
         self._cleanup_task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.http_port}"
+
+    def _after_evict(self, d: Digest) -> None:
+        """Cleanup worker thread, post-delete: an evicted blob must leave
+        the swarm (and must already be gone, or an inbound handshake could
+        resurrect the control)."""
+        loop, sched = self._loop, self.scheduler
+        if loop is not None and sched is not None:
+            loop.call_soon_threadsafe(sched.unseed, d)
 
     @property
     def registry_addr(self) -> str | None:
@@ -599,6 +624,7 @@ class AgentNode:
         return f"{self.host}:{self.registry_port}"
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         factory = PeerIDFactory(
             PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
         )

@@ -442,6 +442,11 @@ class OriginServer:
             # the ledger adjustment.
             await self.dedup.remove(d)
         await asyncio.to_thread(self.store.delete_cache_file, d)
+        if self.scheduler is not None:
+            # AFTER the unlink: unseeding first would leave a window where
+            # an inbound handshake resurrects the control while the blob
+            # still exists on disk.
+            self.scheduler.unseed(d)
         return web.Response(status=204)
 
     async def _health(self, req: web.Request) -> web.Response:

@@ -179,6 +179,9 @@ class Scheduler:
         # conn's close must never release a slot a newer conn has taken.
         self._conn_owners: dict[tuple[PeerID, InfoHash], Conn] = {}
         self._controls: dict[InfoHash, _TorrentControl] = {}
+        # digest -> info hash: unseed must be O(1), not a scan -- a
+        # watermark eviction sweep unseeds many blobs back to back.
+        self._digest_to_hash: dict[Digest, InfoHash] = {}
         self._coalescer: RequestCoalescer = RequestCoalescer()
         self._server: Optional[asyncio.base_events.Server] = None
         self._announce_queue = AnnounceQueue()
@@ -260,6 +263,7 @@ class Scheduler:
         ctl = self._controls.pop(h, None)
         if ctl is None:
             return
+        self._digest_to_hash.pop(ctl.torrent.metainfo.digest, None)
         self._announce_queue.remove(h)
         ctl.cancel_tasks()
         ctl.dispatcher.close()
@@ -270,6 +274,17 @@ class Scheduler:
         """Start seeding a complete local blob (origin startup / post-
         download agents keep seeding automatically)."""
         self._get_or_create_control(metainfo, namespace)
+
+    def unseed(self, d: Digest) -> bool:
+        """Stop seeding blob ``d`` (DELETE / cache eviction): the torrent
+        control, its announces, and its conns go away -- a seeder must not
+        keep advertising bytes it can no longer serve. False if no torrent
+        for ``d`` is active."""
+        h = self._digest_to_hash.get(d)
+        if h is None:
+            return False
+        self._remove_control(h)
+        return True
 
     # -- torrent control ---------------------------------------------------
 
@@ -292,6 +307,7 @@ class Scheduler:
         )
         ctl = _TorrentControl(torrent, namespace, dispatcher)
         self._controls[h] = ctl
+        self._digest_to_hash[torrent.metainfo.digest] = h
         # First announce ASAP (downloads need peers now); re-announces are
         # paced by the queue pump under the global rate cap.
         self._announce_queue.schedule(h, 0.0)

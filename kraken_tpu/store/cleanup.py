@@ -38,6 +38,7 @@ class CleanupManager:
         store: CAStore,
         config: CleanupConfig | None = None,
         on_evict=None,
+        after_evict=None,
     ):
         self.store = store
         self.config = config or CleanupConfig()
@@ -45,6 +46,10 @@ class CleanupManager:
         # e.g. DedupIndex.remove_sync, so eviction doesn't leave ghost
         # entries in the similarity index. Failures don't block eviction.
         self.on_evict = on_evict
+        # Called AFTER deletion: e.g. scheduler unseed -- it must run once
+        # the bytes are gone, or a concurrent inbound handshake could
+        # resurrect the torrent control while the blob still exists.
+        self.after_evict = after_evict
         # Access times are recorded in memory on every read (free for the
         # request path) and flushed to TTIMetadata sidecars by the sweep;
         # the sweep always consults the in-memory map too, so a hot blob is
@@ -62,6 +67,11 @@ class CleanupManager:
         self._touched.pop(d.hex, None)
         self._flushed.pop(d.hex, None)
         self.store.delete_cache_file(d)
+        if self.after_evict is not None:
+            try:
+                self.after_evict(d)
+            except Exception:
+                pass
 
     def touch(self, d: Digest, now: float | None = None) -> None:
         """Record an access (callers: every blob read path). Memory-only --

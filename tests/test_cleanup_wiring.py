@@ -120,3 +120,54 @@ def test_agent_schedules_cleanup(tmp_path):
             await agent.stop()
 
     asyncio.run(main())
+
+
+def test_delete_and_eviction_unseed(tmp_path):
+    """A deleted or evicted blob leaves the swarm: the scheduler stops
+    announcing and drops the torrent control (a seeder must not advertise
+    bytes it can no longer serve)."""
+    import asyncio
+    import os
+
+    from kraken_tpu.assembly import OriginNode, TrackerNode
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.origin.client import BlobClient
+    from kraken_tpu.store.cleanup import CleanupConfig
+
+    async def main():
+        tracker = TrackerNode(announce_interval_seconds=0.1)
+        await tracker.start()
+        origin = OriginNode(
+            store_root=str(tmp_path / "o"), tracker_addr=tracker.addr,
+            cleanup=CleanupConfig(
+                tti_seconds=0.0, interval_seconds=3600.0,
+                high_watermark_bytes=1, low_watermark_bytes=0,
+            ),
+        )
+        await origin.start()
+        try:
+            oc = BlobClient(origin.addr)
+            blob_a, blob_b = os.urandom(60_000), os.urandom(60_000)
+            da, db = Digest.from_bytes(blob_a), Digest.from_bytes(blob_b)
+            await oc.upload("ns", da, blob_a)
+            await oc.upload("ns", db, blob_b)
+            assert len(origin.scheduler._controls) == 2
+
+            # Explicit DELETE unseeds immediately.
+            await oc.delete("ns", da)
+            assert len(origin.scheduler._controls) == 1
+
+            # Eviction sweep unseeds via on_evict (thread -> loop hop).
+            evicted = await asyncio.to_thread(origin.cleanup.run_once)
+            assert db in evicted
+            for _ in range(50):
+                if not origin.scheduler._controls:
+                    break
+                await asyncio.sleep(0.02)
+            assert not origin.scheduler._controls
+            await oc.close()
+        finally:
+            await origin.stop()
+            await tracker.stop()
+
+    asyncio.run(main())
