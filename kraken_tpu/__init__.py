@@ -16,15 +16,17 @@ Package layout (mirrors SURVEY.md's layer map, TPU-first design):
                    jax.sharding.Mesh (data-parallel piece axis over ICI).
 - ``store``     -- content-addressable file store with piece-status
                    metadata and TTL/disk cleanup (L2).
-- ``backends``  -- pluggable storage-backend registry (testfs, file, http;
-                   namespace -> backend manager with bandwidth caps) (L2).
+- ``backend``   -- pluggable storage-backend registry (s3, hdfs, http,
+                   registry pull-through, shadow, testfs, file; namespace
+                   -> backend manager with bandwidth caps) (L2).
 - ``placement`` -- rendezvous hashring over health-filtered host lists (L2).
 - ``persistedretry`` -- durable async task queue (sqlite) for writeback and
                    replication (L2).
 - ``p2p``       -- the torrent plane: wire protocol, conns, dispatch,
                    scheduler (L3).
-- ``tracker``, ``origin``, ``agent``, ``proxy``, ``buildindex`` -- the five
-  long-running components (L4-L6).
+- ``tracker``, ``origin``, ``agent``, ``dockerregistry``, ``buildindex`` --
+  the five long-running components' services (L4-L6); ``assembly`` wires
+  them into runnable nodes and ``cli`` is the per-component entry point.
 - ``utils``     -- httputil, dedup, bandwidth, backoff, configutil, log.
 
 Reference: uber/kraken repo layout (upstream paths; /root/reference was an
