@@ -17,6 +17,7 @@ from typing import Callable, Optional
 
 from kraken_tpu.core.peer import PeerID
 from kraken_tpu.p2p.conn import Conn, ConnClosedError
+from kraken_tpu.p2p.networkevent import NoopProducer, Producer
 from kraken_tpu.p2p.piecerequest import RequestManager
 from kraken_tpu.p2p.storage import PieceError, Torrent
 from kraken_tpu.p2p.wire import Message, MsgType
@@ -61,10 +62,12 @@ class Dispatcher:
         requests: RequestManager | None = None,
         on_peer_failure: Callable[[PeerID, str], None] | None = None,
         churn_idle_seconds: float = 4.0,
+        events: Producer | None = None,  # swarm tracing
     ):
         self.torrent = torrent
         self.requests = requests or RequestManager()
         self.churn_idle = churn_idle_seconds
+        self.events = events or NoopProducer()
         self._on_peer_failure = on_peer_failure or (lambda p, r: None)
         self._peers: dict[PeerID, _Peer] = {}
         self._io_tasks: set[asyncio.Task] = set()
@@ -233,6 +236,10 @@ class Dispatcher:
             raise ConnClosedError(msg.header.get("detail", "peer error"))
 
     async def _on_payload(self, peer: _Peer, idx: int, data: bytes) -> None:
+        self.events.emit(
+            "receive_piece", self.torrent.info_hash.hex,
+            peer=peer.conn.peer_id.hex, piece=idx, size=len(data),
+        )
         if self.torrent.has_piece(idx):
             self.requests.clear_piece(idx)
             await self._request_more(peer)
@@ -249,6 +256,10 @@ class Dispatcher:
         if completed:
             if not self.done.done():
                 self.done.set_result(None)
+                self.events.emit(
+                    "torrent_complete", self.torrent.info_hash.hex,
+                    blob=self.torrent.metainfo.digest.hex,
+                )
             for other in list(self._peers.values()):
                 try:
                     await other.conn.send(Message.complete())
@@ -272,6 +283,10 @@ class Dispatcher:
             self._availability(),
         )
         for idx in chosen:
+            self.events.emit(
+                "request_piece", self.torrent.info_hash.hex,
+                peer=peer.conn.peer_id.hex, piece=idx,
+            )
             await peer.conn.send(Message.piece_request(idx))
 
     # -- timers (driven by the scheduler) ----------------------------------

@@ -43,7 +43,16 @@ class Producer:
             **fields,
         }
         if self._sink is not None:
-            self._sink.write(json.dumps(event, separators=(",", ":")) + "\n")
+            # Tracing must never affect the data plane: a full disk or a
+            # closed sink is an observability failure, not peer
+            # misbehavior (an emit raising inside a dispatcher io task
+            # would blacklist an innocent peer).
+            try:
+                self._sink.write(
+                    json.dumps(event, separators=(",", ":")) + "\n"
+                )
+            except Exception:
+                pass
         else:
             self._events.append(event)
             if len(self._events) > self._keep:

@@ -304,6 +304,7 @@ class Scheduler:
             ),
             on_peer_failure=lambda pid, reason: self._peer_failed(pid, h, reason),
             churn_idle_seconds=self.config.conn_churn_idle,
+            events=self.events,
         )
         ctl = _TorrentControl(torrent, namespace, dispatcher)
         self._controls[h] = ctl
