@@ -30,11 +30,10 @@ from kraken_tpu.origin.metainfogen import Generator, PieceLengthConfig
 from kraken_tpu.origin.server import OriginServer
 from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
-from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement import Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import instrument_app
-from kraken_tpu.p2p.connstate import ConnStateConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Dict, Optional
 
 from kraken_tpu.utils.bandwidth import TokenBucket
 

@@ -23,7 +23,6 @@ import xml.etree.ElementTree as ET
 
 from kraken_tpu.backend.base import (
     BackendClient,
-    BackendError,
     BlobInfo,
     BlobNotFoundError,
     register_backend,

@@ -20,7 +20,6 @@ import asyncio
 import json
 import logging
 import signal
-import sys
 
 from kraken_tpu.assembly import (
     AgentNode,

@@ -27,12 +27,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import urllib.parse
-from typing import Optional
 
 from aiohttp import web
 
 from kraken_tpu.core.digest import Digest, DigestError
-from kraken_tpu.core.metainfo import MetaInfo
 from kraken_tpu.backend import BlobNotFoundError
 from kraken_tpu.origin.blobrefresh import Refresher
 from kraken_tpu.origin.client import BlobClient

@@ -15,7 +15,6 @@ batched TPU dispatch (per BASELINE.json's agent-verify config).
 from __future__ import annotations
 
 import asyncio
-import os
 from typing import Optional
 
 from kraken_tpu.core.digest import Digest

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import enum
-from typing import Any, Optional
+from typing import Any
 
 import msgpack
 

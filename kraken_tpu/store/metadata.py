@@ -13,7 +13,7 @@ Each type serializes to bytes and lives at ``<data_path>._md_<name>``.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Type
+from typing import Dict, Type
 
 _REGISTRY: Dict[str, Type["Metadata"]] = {}
 
