@@ -89,6 +89,14 @@ class OriginServer:
         self._dedup_tasks: set[asyncio.Task] = set()
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
+            # Earlier builds keyed tasks '{addr}:{ns}:{hex}'; rewrite any
+            # such persisted rows so the digest-first prefix scan in
+            # _maybe_unpin sees them (a missed row releases the eviction
+            # pin too early).
+            retry.store.canonicalize_keys(
+                REPLICATE_KIND,
+                lambda p: f"{p['digest']}:{p['namespace']}:{p['addr']}",
+            )
 
     # -- app ---------------------------------------------------------------
 

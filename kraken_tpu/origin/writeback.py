@@ -32,6 +32,12 @@ class WritebackExecutor:
         self.backends = backends
         self.retry = retry
         retry.register(KIND, self._execute)
+        # Earlier builds keyed tasks '{namespace}:{hex}'; rewrite any such
+        # persisted rows so the digest-first prefix scan in _execute sees
+        # them (a missed row releases the eviction pin too early).
+        retry.store.canonicalize_keys(
+            KIND, lambda p: f"{p['digest']}:{p['namespace']}"
+        )
 
     def enqueue(self, namespace: str, d: Digest) -> None:
         """Queue a blob for backend upload; pin it against eviction."""

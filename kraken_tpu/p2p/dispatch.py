@@ -157,7 +157,7 @@ class Dispatcher:
             raise PieceError(f"piece index out of range: {idx!r}")
         return idx
 
-    def _spawn_io(self, peer: _Peer, coro) -> None:
+    def _spawn_io(self, peer: _Peer, coro) -> asyncio.Task:
         """Run a storage-touching handler CONCURRENTLY with the recv pump.
 
         Serializing verify->write->next-request per piece makes every piece
@@ -180,6 +180,7 @@ class Dispatcher:
 
         self._io_tasks.add(t)
         t.add_done_callback(done)
+        return t
 
     def _fail_peer(self, pid: PeerID, exc: BaseException) -> None:
         """One exception->drop policy for the pump AND the io tasks."""
@@ -195,13 +196,28 @@ class Dispatcher:
     # bound, each pending serve holds a piece-sized buffer and a hostile
     # leecher could drive a seeder to OOM.
 
-    async def _serve_piece(self, peer: _Peer, idx: int) -> None:
+    def _admit_serve(self, peer: _Peer, idx: int) -> None:
+        """``serving`` must be bumped HERE, synchronously at admission:
+        ``conn.recv()`` on already-buffered frames completes without
+        yielding to the loop, so a burst of buffered PIECE_REQUESTs would
+        otherwise all observe ``serving == 0`` and each spawn a task
+        holding a piece-sized buffer -- exactly the flood the bound
+        exists to prevent. Decrement in the task's done callback, so
+        cancellation-before-first-step can't leak the slot."""
         peer.serving += 1
-        try:
-            data = await self.torrent.read_piece_async(idx)
-            await peer.conn.send(Message.piece_payload(idx, data))
-        finally:
+        t = self._spawn_io(peer, self._serve_piece(peer, idx))
+
+        def release(_task: asyncio.Task) -> None:
             peer.serving -= 1
+
+        t.add_done_callback(release)
+
+    async def _serve_piece(self, peer: _Peer, idx: int) -> None:
+        data = await self.torrent.read_piece_async(idx)
+        await peer.conn.send(Message.piece_payload(idx, data))
+        # A completed send is progress: an honest-but-slow link keeps
+        # earning its churn exemption one delivered piece at a time.
+        peer.last_useful = asyncio.get_running_loop().time()
 
     async def _handle(self, peer: _Peer, msg: Message) -> None:
         if msg.type in (
@@ -215,7 +231,7 @@ class Dispatcher:
                 self.torrent.has_piece(idx)
                 and peer.serving < self._MAX_SERVING_PER_PEER
             ):
-                self._spawn_io(peer, self._serve_piece(peer, idx))
+                self._admit_serve(peer, idx)
         elif msg.type == MsgType.PIECE_PAYLOAD:
             self._spawn_io(
                 peer, self._on_payload(peer, self._check_index(msg), msg.payload)
@@ -298,8 +314,24 @@ class Dispatcher:
         waiting leechers; on a leecher, for peers that actually have data)."""
         now = asyncio.get_running_loop().time()
         for pid, peer in list(self._peers.items()):
-            if now - peer.last_useful > self.churn_idle:
-                self._drop_peer(pid)  # no blacklist: idle, not misbehaving
+            idle_for = now - peer.last_useful
+            if idle_for <= self.churn_idle:
+                continue
+            # Not idle, just slow: a piece we are mid-sending (serving) or
+            # mid-receiving (outstanding request) generates no new inbound
+            # messages for its whole transfer time, and dropping the conn
+            # then discards live work. But the exemption is BOUNDED: a
+            # peer that stops reading its socket (TCP zero window) parks
+            # our sends forever with serving > 0, and an unbounded
+            # exemption would let it pin a conn slot plus piece buffers
+            # indefinitely. Completed serves refresh last_useful, so only
+            # a link too slow to deliver one piece per 10 idle periods
+            # hits the cap. (The request-pending exemption self-bounds via
+            # request expiry, but the cap applies uniformly anyway.)
+            active = peer.serving > 0 or bool(self.requests.pending_for(pid, now))
+            if active and idle_for <= 10.0 * self.churn_idle:
+                continue
+            self._drop_peer(pid)  # no blacklist: idle, not misbehaving
         if self.torrent.complete():
             return
         for peer in list(self._peers.values()):

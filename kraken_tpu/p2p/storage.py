@@ -174,7 +174,10 @@ class Torrent:
         (callers blacklist the sender). File IO runs off-loop so a disk
         stall can't freeze the scheduler."""
         if self._status is None:
-            raise PieceError("torrent already complete")
+            # With endgame duplication a second copy of the final piece
+            # can arrive after completion: a benign duplicate, never a
+            # peer fault.
+            return False
         if len(data) != self.metainfo.piece_length_of(i):
             raise PieceError(
                 f"piece {i}: wrong length {len(data)} != "
@@ -183,7 +186,10 @@ class Torrent:
         if not await self._verifier.verify(data, self.metainfo.piece_hash(i)):
             raise PieceError(f"piece {i}: digest mismatch")
         async with self._lock:
-            if self._status.has(i):
+            # Re-check under the lock: a concurrent writer of the same
+            # final piece may have completed the torrent (set _status to
+            # None) while this task parked on verify or the lock.
+            if self._status is None or self._status.has(i):
                 return False  # duplicate arrival
             await asyncio.to_thread(self._write_at, i, data)
             self._status.set(i)

@@ -106,6 +106,37 @@ class TaskStore:
         ).fetchone()
         return int(row[0])
 
+    def canonicalize_keys(self, kind: str, canonical: Callable[[dict], str]) -> int:
+        """Rewrite pending keys of ``kind`` to ``canonical(payload)``.
+
+        Key formats have changed across builds (digest-first reordering);
+        tasks persisted by an older build still execute correctly from
+        their payload but are invisible to the ``count_pending`` prefix
+        scans the unpin logic relies on -- which can release an eviction
+        pin while a legacy-keyed task for the same blob is still queued.
+        Executors call this once at registration with their canonical key
+        derivation. A legacy row whose canonical key already exists is a
+        duplicate of the pending canonical task and is dropped. Returns
+        rows migrated (rewritten + dropped)."""
+        rows = self._db.execute(
+            "SELECT id, key, payload FROM tasks WHERE kind = ?", (kind,)
+        ).fetchall()
+        changed = 0
+        for row_id, key, payload in rows:
+            want = canonical(json.loads(payload))
+            if key == want:
+                continue
+            try:
+                self._db.execute(
+                    "UPDATE tasks SET key = ? WHERE id = ?", (want, row_id)
+                )
+            except sqlite3.IntegrityError:
+                self._db.execute("DELETE FROM tasks WHERE id = ?", (row_id,))
+            changed += 1
+        if changed:
+            self._db.commit()
+        return changed
+
     def done(self, task: Task) -> None:
         self._db.execute("DELETE FROM tasks WHERE id = ?", (task.id,))
         self._db.commit()

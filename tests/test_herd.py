@@ -367,3 +367,43 @@ def test_stat_reads_through_to_backend_after_eviction(tmp_path):
             await teardown(tracker, origins, agents, cluster)
 
     asyncio.run(main())
+
+
+def test_writeback_legacy_keys_migrate_on_open(tmp_path):
+    """Tasks persisted by an earlier build under '{namespace}:{hex}' keys
+    must become visible to the digest-first prefix scans the unpin logic
+    uses -- otherwise the eviction pin is released while a legacy-keyed
+    writeback of the same blob is still queued."""
+
+    async def main():
+        from kraken_tpu.origin.writeback import KIND, WritebackExecutor
+        from kraken_tpu.persistedretry import Manager as RetryManager, Task
+        from kraken_tpu.persistedretry.manager import TaskStore
+        from kraken_tpu.store import CAStore
+
+        blob = os.urandom(1000)
+        d = Digest.from_bytes(blob)
+        ts = TaskStore(str(tmp_path / "retry.db"))
+        # Simulate the previous build's key ordering.
+        ts.add(Task(kind=KIND, key=f"ns:{d.hex}",
+                    payload={"namespace": "ns", "digest": d.hex}))
+        # Plus a duplicate already present in canonical form.
+        ts.add(Task(kind=KIND, key=f"{d.hex}:other",
+                    payload={"namespace": "other", "digest": d.hex}))
+        ts.add(Task(kind=KIND, key=f"other:{d.hex}",
+                    payload={"namespace": "other", "digest": d.hex}))
+
+        retry = RetryManager(ts)
+        backends = BackendManager(
+            [{"namespace": ".*", "backend": "file",
+              "config": {"root": str(tmp_path / "remote")}}]
+        )
+        store = CAStore(str(tmp_path / "store"))
+        WritebackExecutor(store, backends, retry)
+        # Legacy row rewritten; legacy duplicate of a canonical row dropped.
+        assert ts.count_pending(KIND, f"{d.hex}:") == 2
+        assert {t.key for t in ts.all_pending()} == {
+            f"{d.hex}:ns", f"{d.hex}:other"
+        }
+
+    asyncio.run(main())

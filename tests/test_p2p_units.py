@@ -348,3 +348,115 @@ def test_wire_fuzz_corrupt_frames_raise_wireerror():
                     pass
 
     asyncio.run(main())
+
+
+# -- dispatcher admission & churn (ADVICE r3 regressions) -------------------
+
+class _FakeConn:
+    """Just enough Conn surface for Dispatcher unit tests."""
+
+    def __init__(self, peer_id: PeerID):
+        self.peer_id = peer_id
+        self.sent = []
+        self.closed = False
+
+    async def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        self.closed = True
+
+
+def _seeding_torrent(tmp_path, blob: bytes):
+    mi = make_metainfo(blob)
+    store = CAStore(str(tmp_path / "s"))
+    store.create_cache_file(mi.digest, iter([blob]))
+    return OriginTorrentArchive(store, BatchedVerifier()).create_torrent(mi)
+
+
+def test_serve_flood_bound_holds_for_buffered_bursts(tmp_path):
+    """A burst of PIECE_REQUESTs handled back-to-back WITHOUT yielding to
+    the event loop (how already-buffered frames arrive off conn.recv())
+    must still respect _MAX_SERVING_PER_PEER: admission accounting is
+    synchronous, not deferred to when the spawned task first runs."""
+
+    async def main():
+        from kraken_tpu.p2p.dispatch import Dispatcher, _Peer
+
+        t = _seeding_torrent(tmp_path, os.urandom(4096))
+        d = Dispatcher(t)
+        conn = _FakeConn(pid(1))
+        peer = _Peer(conn, set(), asyncio.get_running_loop().time())
+        d._peers[conn.peer_id] = peer
+        for _ in range(200):
+            await d._handle(peer, Message.piece_request(0))
+        assert peer.serving == Dispatcher._MAX_SERVING_PER_PEER
+        for _ in range(100):
+            if not peer.serving:
+                break
+            await asyncio.sleep(0.01)
+        assert peer.serving == 0  # done-callbacks released every slot
+        assert len(conn.sent) == Dispatcher._MAX_SERVING_PER_PEER
+        d.close()
+
+    asyncio.run(main())
+
+
+def test_idle_churn_exempts_active_transfers(tmp_path):
+    """tick() must not drop a conn that is mid-serve (serving > 0) or that
+    we have outstanding piece requests to: slow links generate no new
+    inbound messages for the whole transfer. But the exemption is bounded
+    (10x churn_idle) so a peer that stops reading its socket can't pin a
+    conn slot forever."""
+
+    async def main():
+        from kraken_tpu.p2p.dispatch import Dispatcher, _Peer
+
+        t = _seeding_torrent(tmp_path, os.urandom(4096))
+        d = Dispatcher(t, churn_idle_seconds=2.0)  # cap at 20 s idle
+        now = asyncio.get_running_loop().time()
+        idle, serving, awaited, stuck = (_FakeConn(pid(i)) for i in (1, 2, 3, 4))
+        for conn in (idle, serving, awaited):
+            d._peers[conn.peer_id] = _Peer(conn, set(), now - 10.0)
+        d._peers[serving.peer_id].serving = 1
+        d.requests.mark_sent(0, awaited.peer_id)
+        # Mid-serve but idle beyond the cap: a zero-window hostile peer.
+        d._peers[stuck.peer_id] = _Peer(stuck, set(), now - 25.0)
+        d._peers[stuck.peer_id].serving = 1
+        await d.tick()
+        assert idle.peer_id not in d._peers  # plain idle: churned
+        assert serving.peer_id in d._peers  # mid-serve: kept
+        assert awaited.peer_id in d._peers  # awaiting payload: kept
+        assert stuck.peer_id not in d._peers  # exemption capped: churned
+        d.close()
+
+    asyncio.run(main())
+
+
+def test_duplicate_final_piece_is_benign(tmp_path):
+    """Endgame duplication can deliver the completing piece twice,
+    concurrently. The loser must see a duplicate arrival (False), never an
+    exception -- an exception hard-blacklists an innocent peer."""
+
+    async def main():
+        blob = os.urandom(3000)
+        mi = make_metainfo(blob)
+        store = CAStore(str(tmp_path / "s"))
+        archive = AgentTorrentArchive(
+            store, BatchedVerifier(max_delay_seconds=0.001)
+        )
+        t = archive.create_torrent(mi)
+        pl = mi.piece_length
+        for i in range(mi.num_pieces - 1):
+            await t.write_piece(i, blob[i * pl : (i + 1) * pl])
+        last = mi.num_pieces - 1
+        data = blob[last * pl :]
+        r1, r2 = await asyncio.gather(
+            t.write_piece(last, data), t.write_piece(last, data)
+        )
+        assert sorted([r1, r2]) == [False, True]
+        assert t.complete()
+        # A third copy landing after completion is also a no-op.
+        assert await t.write_piece(last, data) is False
+
+    asyncio.run(main())
