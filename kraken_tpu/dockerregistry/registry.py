@@ -19,6 +19,12 @@ Implemented (the surface ``docker pull``/``push`` exercises):
     GET  /v2/_catalog                              (via build-index)
 
 The namespace for blob storage is the repo name, as in the reference.
+
+Errors follow the docker/OCI distribution spec: every failure carries the
+``{"errors": [{"code", ...}]}`` envelope (see ``errors.py``) and every
+response the ``Docker-Distribution-API-Version`` header -- clients branch
+on the codes, so this is part of the compatibility contract
+(``tests/test_registry_conformance.py`` asserts exact codes per flow).
 """
 
 from __future__ import annotations
@@ -34,6 +40,11 @@ import uuid as uuidlib
 from aiohttp import web
 
 from kraken_tpu.core.digest import Digest, DigestError
+from kraken_tpu.dockerregistry.errors import (
+    api_version_middleware,
+    check_repo_name,
+    v2_error,
+)
 from kraken_tpu.dockerregistry.transfer import ImageTransferer
 
 _MANIFEST_TYPES = (
@@ -83,7 +94,9 @@ class RegistryServer:
         return len(stale)
 
     def make_app(self) -> web.Application:
-        app = web.Application(client_max_size=1 << 30)
+        app = web.Application(
+            client_max_size=1 << 30, middlewares=[api_version_middleware]
+        )
         r = app.router
         r.add_get("/v2/", self._api_check)
         r.add_get("/v2/_catalog", self._catalog)
@@ -101,31 +114,42 @@ class RegistryServer:
     # -- manifests ---------------------------------------------------------
 
     async def _manifests(self, req: web.Request) -> web.Response:
-        repo = req.match_info["repo"]
+        repo = check_repo_name(req.match_info["repo"])
         ref = req.match_info["ref"]
         if req.method in ("GET", "HEAD"):
             return await self._get_manifest(req, repo, ref)
         if req.method == "PUT":
             return await self._put_manifest(req, repo, ref)
-        raise web.HTTPMethodNotAllowed(req.method, ["GET", "HEAD", "PUT"])
+        raise v2_error("UNSUPPORTED", allowed=("GET", "HEAD", "PUT"))
 
     async def _get_manifest(self, req, repo: str, ref: str) -> web.Response:
         if ref.startswith("sha256:"):
             try:
                 d = Digest.parse(ref)
             except DigestError:
-                raise web.HTTPBadRequest(text="malformed manifest reference")
+                raise v2_error("DIGEST_INVALID", detail={"reference": ref})
         else:
             d = await self.transferer.get_tag(f"{repo}:{ref}")
             if d is None:
-                raise web.HTTPNotFound(text="manifest unknown")
+                raise v2_error(
+                    "MANIFEST_UNKNOWN", detail={"name": repo, "tag": ref}
+                )
         try:
             data = await self.transferer.download(repo, d)
         except Exception:
-            raise web.HTTPNotFound(text="manifest unknown")
-        media = json.loads(data).get(
-            "mediaType", "application/vnd.docker.distribution.manifest.v2+json"
-        )
+            raise v2_error(
+                "MANIFEST_UNKNOWN", detail={"name": repo, "reference": str(d)}
+            )
+        # The stored bytes are only digest-checked, never schema-checked
+        # (a blob can be fetched through the manifest route), so nothing
+        # here may trust their shape.
+        try:
+            parsed = json.loads(data)
+            media = parsed.get("mediaType") if isinstance(parsed, dict) else None
+        except ValueError:
+            media = None
+        if not isinstance(media, str):
+            media = "application/vnd.docker.distribution.manifest.v2+json"
         headers = {
             "Docker-Content-Digest": str(d),
             "Content-Type": media,
@@ -137,9 +161,28 @@ class RegistryServer:
 
     async def _put_manifest(self, req, repo: str, ref: str) -> web.Response:
         if self.read_only:
-            raise web.HTTPMethodNotAllowed("PUT", ["GET", "HEAD"])
+            raise v2_error(
+                "UNSUPPORTED", "registry is read-only; push via the proxy"
+            )
         data = await req.read()
+        try:
+            manifest = json.loads(data)
+            if not isinstance(manifest, dict):
+                raise ValueError("manifest is not a JSON object")
+        except ValueError as e:
+            raise v2_error("MANIFEST_INVALID", detail={"reason": str(e)})
         d = Digest.from_bytes(data)
+        if ref.startswith("sha256:"):
+            # Push-by-digest: the URI reference must match the payload.
+            try:
+                want = Digest.parse(ref)
+            except DigestError:
+                raise v2_error("DIGEST_INVALID", detail={"reference": ref})
+            if want != d:
+                raise v2_error(
+                    "DIGEST_INVALID",
+                    detail={"reference": ref, "computed": str(d)},
+                )
         await self.transferer.upload(repo, d, data)
         if not ref.startswith("sha256:"):
             await self.transferer.put_tag(f"{repo}:{ref}", d)
@@ -150,20 +193,25 @@ class RegistryServer:
     # -- blobs -------------------------------------------------------------
 
     async def _blobs(self, req: web.Request) -> web.Response:
-        repo = req.match_info["repo"]
+        repo = check_repo_name(req.match_info["repo"])
         try:
             d = Digest.parse(req.match_info["digest"])
         except DigestError:
-            raise web.HTTPBadRequest(text="malformed digest")
+            raise v2_error(
+                "DIGEST_INVALID", detail={"digest": req.match_info["digest"]}
+            )
         if req.method not in ("GET", "HEAD"):
-            raise web.HTTPMethodNotAllowed(req.method, ["GET", "HEAD"])
+            raise v2_error("UNSUPPORTED", allowed=("GET", "HEAD"))
+        unknown = v2_error(
+            "BLOB_UNKNOWN", detail={"name": repo, "digest": str(d)}
+        )
         if req.method == "HEAD":
             try:
                 size = await self.transferer.stat(repo, d)
             except Exception:
-                raise web.HTTPNotFound(text="blob unknown")
+                raise unknown
             if size is None:
-                raise web.HTTPNotFound(text="blob unknown")
+                raise unknown
             return web.Response(headers={
                 "Docker-Content-Digest": str(d),
                 "Content-Length": str(size),
@@ -174,7 +222,7 @@ class RegistryServer:
         try:
             path, is_temp = await self.transferer.download_path(repo, d)
         except Exception:
-            raise web.HTTPNotFound(text="blob unknown")
+            raise unknown
         headers = {
             "Docker-Content-Digest": str(d),
             "Content-Type": "application/octet-stream",
@@ -234,12 +282,17 @@ class RegistryServer:
 
     def _check_writable(self) -> None:
         if self.read_only:
-            raise web.HTTPMethodNotAllowed("POST", ["GET", "HEAD"])
+            # Upload-session URLs route no other methods, so Allow is
+            # honestly empty.
+            raise v2_error(
+                "UNSUPPORTED", "registry is read-only; push via the proxy",
+                allowed=(),
+            )
 
     async def _start_upload(self, req: web.Request) -> web.Response:
         self._check_writable()
         self._purge_stale_uploads()
-        repo = req.match_info["repo"]
+        repo = check_repo_name(req.match_info["repo"])
         # Cross-repo mount (?mount=<digest>&from=<repo>): blobs are
         # content-addressed, so if the cluster has (or can restore) the
         # bytes, the origin ADOPTS them into the target namespace --
@@ -292,17 +345,20 @@ class RegistryServer:
                     self._uploads[uid] = time.time()
         if uid not in self._uploads:
             # Purged concurrently: the spool file was unlinked under us.
-            raise web.HTTPNotFound(text="upload expired")
+            raise v2_error(
+                "BLOB_UPLOAD_UNKNOWN", "upload session expired",
+                detail={"uuid": uid},
+            )
         self._uploads[uid] = time.time()
         return os.path.getsize(path)
 
     async def _patch_upload(self, req: web.Request) -> web.Response:
         self._check_writable()
+        repo = check_repo_name(req.match_info["repo"])  # before any spooling
         uid = req.match_info["uid"]
         if uid not in self._uploads:
-            raise web.HTTPNotFound(text="upload unknown")
+            raise v2_error("BLOB_UPLOAD_UNKNOWN", detail={"uuid": uid})
         size = await self._append_body(req, uid)
-        repo = req.match_info["repo"]
         return web.Response(
             status=202,
             headers={
@@ -315,23 +371,30 @@ class RegistryServer:
     async def _finish_upload(self, req: web.Request) -> web.Response:
         self._check_writable()
         uid = req.match_info["uid"]
-        repo = req.match_info["repo"]
+        repo = check_repo_name(req.match_info["repo"])
         if uid not in self._uploads:
-            raise web.HTTPNotFound(text="upload unknown")
+            raise v2_error("BLOB_UPLOAD_UNKNOWN", detail={"uuid": uid})
         path = self._upload_path(uid)
         try:
             await self._append_body(req, uid)  # final chunk may ride the PUT
             try:
                 d = Digest.parse(req.query["digest"])
             except (KeyError, DigestError):
-                raise web.HTTPBadRequest(text="missing/malformed digest param")
+                raise v2_error(
+                    "DIGEST_INVALID", "missing or malformed digest parameter",
+                    detail={"digest": req.query.get("digest", "")},
+                )
 
             def _file_digest() -> Digest:
                 with open(path, "rb") as f:
                     return Digest.from_reader(f)
 
-            if await asyncio.to_thread(_file_digest) != d:
-                raise web.HTTPBadRequest(text="digest mismatch")
+            got = await asyncio.to_thread(_file_digest)
+            if got != d:
+                raise v2_error(
+                    "DIGEST_INVALID",
+                    detail={"expected": str(d), "computed": str(got)},
+                )
             await self.transferer.upload_file(repo, d, path)
         finally:
             self._uploads.pop(uid, None)
@@ -361,7 +424,9 @@ class RegistryServer:
                 if n <= 0:
                     raise ValueError
             except ValueError:
-                raise web.HTTPBadRequest(text="malformed n")
+                raise v2_error(
+                    "PAGINATION_NUMBER_INVALID", detail={"n": req.query["n"]}
+                )
             if len(items) > n:
                 items = items[:n]
                 headers["Link"] = (
@@ -370,11 +435,19 @@ class RegistryServer:
         return items, headers
 
     async def _tags_list(self, req: web.Request) -> web.Response:
-        repo = req.match_info["repo"]
+        repo = check_repo_name(req.match_info["repo"])
         try:
             tags = await self.transferer.list_repo_tags(repo)
         except Exception:
-            tags = []
+            # Transient dependency failure must stay a retryable 5xx: a
+            # 404 here would tell docker a live repository doesn't exist.
+            raise v2_error("UNKNOWN", "failed to list tags")
+        if not tags:
+            # A repository exists iff it has tags (tags are the only
+            # repo-scoped state here); the spec's answer for an unknown
+            # repo is NAME_UNKNOWN, which docker surfaces as
+            # "repository not found" rather than an empty listing.
+            raise v2_error("NAME_UNKNOWN", detail={"name": repo})
         tags, headers = self._paginate(req, sorted(tags))
         return web.json_response({"name": repo, "tags": tags}, headers=headers)
 
@@ -384,7 +457,7 @@ class RegistryServer:
         try:
             tags = await self.transferer.list_all_tags()
         except Exception:
-            tags = []
+            raise v2_error("UNKNOWN", "failed to list repositories")
         repos = sorted({t.rpartition(":")[0] for t in tags if ":" in t})
         repos, headers = self._paginate(req, repos)
         return web.json_response({"repositories": repos}, headers=headers)
