@@ -48,6 +48,7 @@ class BatchedVerifier:
         self._max_delay = max_delay_seconds
         self._queue: list[tuple[bytes, bytes, asyncio.Future]] = []
         self._flusher: Optional[asyncio.Task] = None
+        self._inflight: set[asyncio.Task] = set()  # strong refs to hash tasks
 
     async def verify(self, data: bytes, expected: bytes) -> bool:
         loop = asyncio.get_running_loop()
@@ -76,8 +77,24 @@ class BatchedVerifier:
             "verify_batch_occupancy",
             "Batch fill of the last verify flush (batched / max_batch)",
         ).set(len(batch) / self._max_batch)
+        # The hash itself runs OFF the event loop: a full batch is hundreds
+        # of MBs (CPU: ~100+ ms; TPU: a blocking device round-trip), and an
+        # on-loop hash stalls every conn pump, announce, and accept for the
+        # duration. hashlib releases the GIL for large buffers, so the
+        # loop genuinely keeps running. Multiple flushes may hash
+        # concurrently; each resolves only its own batch's futures, so
+        # ordering doesn't matter.
+        t = asyncio.create_task(self._hash_off_loop(batch))
+        self._inflight.add(t)
+        t.add_done_callback(self._inflight.discard)
+
+    async def _hash_off_loop(
+        self, batch: list[tuple[bytes, bytes, asyncio.Future]]
+    ) -> None:
         try:
-            digests = self._hasher.hash_batch([d for d, _e, _f in batch])
+            digests = await asyncio.to_thread(
+                self._hasher.hash_batch, [d for d, _e, _f in batch]
+            )
         except Exception as e:
             # A hasher failure must fail the waiters, not strand them.
             for _d, _e2, fut in batch:
@@ -119,6 +136,7 @@ class Torrent:
             self._status = md or PieceStatusMetadata(metainfo.num_pieces)
         # Serializes bitfield updates + completion check.
         self._lock = asyncio.Lock()
+        self._full_bits: Optional[bytes] = None  # memoized complete bitfield
 
     # -- introspection -----------------------------------------------------
 
@@ -148,10 +166,15 @@ class Torrent:
 
     def bitfield(self) -> bytes:
         if self._status is None:
-            full = PieceStatusMetadata(self.num_pieces)
-            for i in range(self.num_pieces):
-                full.set(i)
-            return bytes(full.bits)
+            # Memoized: a seeder rebuilds this for EVERY inbound handshake,
+            # and O(pieces) per handshake x a full conn budget on a
+            # 10k-piece blob is real loop time.
+            if self._full_bits is None:
+                full = PieceStatusMetadata(self.num_pieces)
+                for i in range(self.num_pieces):
+                    full.set(i)
+                self._full_bits = bytes(full.bits)
+            return self._full_bits
         return bytes(self._status.bits)
 
     # -- pieces ------------------------------------------------------------

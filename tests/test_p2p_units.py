@@ -460,3 +460,42 @@ def test_duplicate_final_piece_is_benign(tmp_path):
         assert await t.write_piece(last, data) is False
 
     asyncio.run(main())
+
+
+def test_verify_burst_does_not_stall_loop():
+    """The batched hash runs off the event loop: during a 100-piece verify
+    burst (~25 MB of SHA-256, ~100+ ms of CPU) a concurrently-ticking task
+    must never observe a loop stall > 50 ms. Guards the agent's wire
+    goodput -- an on-loop hash freezes every conn pump for the batch."""
+
+    async def main():
+        import hashlib
+
+        v = BatchedVerifier(max_delay_seconds=0.001)
+        pieces = [os.urandom(256 * 1024) for _ in range(100)]
+        digests = [hashlib.sha256(p).digest() for p in pieces]
+
+        loop = asyncio.get_running_loop()
+        stop = loop.create_future()
+        max_stall = 0.0
+
+        async def ticker():
+            nonlocal max_stall
+            last = loop.time()
+            while not stop.done():
+                await asyncio.sleep(0.005)
+                now = loop.time()
+                max_stall = max(max_stall, now - last - 0.005)
+                last = now
+
+        t = asyncio.create_task(ticker())
+        await asyncio.sleep(0)  # let the ticker establish its baseline
+        oks = await asyncio.gather(
+            *(v.verify(p, d) for p, d in zip(pieces, digests))
+        )
+        stop.set_result(None)
+        await t
+        assert all(oks)
+        assert max_stall < 0.05, f"event loop stalled {max_stall * 1e3:.0f} ms"
+
+    asyncio.run(main())
