@@ -1,0 +1,48 @@
+"""BASELINE row 6: p99 pull latency at 10k agents (simulated swarm).
+
+Drives the production policy code (RequestManager, ConnState,
+AnnounceQueue, default_priority handout) through the discrete-event
+simulator in ``kraken_tpu/p2p/sim.py`` -- no sockets, no GIL ceiling, so
+the row's named scale is measured directly rather than extrapolated.
+Deterministic per (seed, config): same invocation replays exactly.
+
+    python bench_sim.py                    # 10k agents, 64 x 4 MiB pieces
+    python bench_sim.py --agents 2000      # smaller, faster
+"""
+
+import argparse
+import json
+import time
+
+from kraken_tpu.p2p.sim import run_sim
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", type=int, default=10_000)
+    ap.add_argument("--pieces", type=int, default=64)
+    ap.add_argument("--piece-mb", type=int, default=4)
+    ap.add_argument("--origins", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=1)
+    args = ap.parse_args()
+
+    t0 = time.time()
+    r = run_sim(
+        n_agents=args.agents,
+        num_pieces=args.pieces,
+        piece_bytes=args.piece_mb << 20,
+        n_origins=args.origins,
+        seed=args.seed,
+    )
+    r["bench_wall_s"] = round(time.time() - t0, 2)
+    print(json.dumps({
+        "metric": f"sim_swarm_pull_p99_s_at_{args.agents}",
+        "value": round(r["p99_s"], 3) if r["p99_s"] is not None else None,
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

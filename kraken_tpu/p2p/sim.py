@@ -1,0 +1,342 @@
+"""Discrete-event swarm simulator: the REAL policy code at 10k-agent scale.
+
+The socket harness tops out at one GIL (~100 MB/s aggregate, PERF.md), so
+BASELINE row 6's "p99 pull latency @ 10k agents" cannot be measured with
+sockets on this rig. This simulator removes the transport, not the logic:
+piece selection (:class:`RequestManager`), conn admission + soft blacklist
+(:class:`ConnState`), announce pacing (:class:`AnnounceQueue`) and tracker
+handout ordering (:func:`default_priority`) are the production objects,
+driven by a simulated clock and an in-memory bandwidth/latency model.
+Mirrors the reference's simulated-swarm test tier (SURVEY.md SS4 tier 3,
+SS6 row 6) -- upstream testing strategy, unverified.
+
+Model (deliberately simple, stated so results are interpretable):
+
+- Each peer has one uplink of ``uplink_bps``; piece serves queue FIFO on
+  it (``busy_until``). Downlinks are not modeled separately -- swarm
+  goodput is uplink-bound, and modeling both would double event count for
+  a second-order effect.
+- Every message hop pays ``latency_s``.
+- Conns are bidirectional, with the dispatcher's idle churn: a conn that
+  carries nothing useful for ``churn_idle_s`` is dropped from both ends.
+  This is LOAD-BEARING at scale, exactly as the dispatcher's docstring
+  claims: without it, completed peers' slots stay pinned to other
+  completed peers and a flash crowd wedges (observed in this sim before
+  churn was modeled -- 10/200 agents completed, the rest starved).
+- Agents announce on join and every ``announce_interval_s`` after
+  (complete agents too, as real seeders do); the tracker answers with the
+  production handout policy. Announce LOAD is reported, the pacing
+  driven through one production :class:`AnnounceQueue`.
+
+Determinism: one seeded ``random.Random`` drives every stochastic choice
+(handout shuffle + selection tiebreaks route through ``random`` module
+state, seeded per run), so a (seed, config) pair replays exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import random
+import statistics
+from typing import Callable, Optional
+
+from kraken_tpu.core.metainfo import InfoHash
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.p2p.announcequeue import AnnounceQueue
+from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
+from kraken_tpu.p2p.piecerequest import RequestManager
+from kraken_tpu.tracker.peerhandout import default_priority
+
+
+@dataclasses.dataclass
+class SimConfig:
+    n_agents: int = 1000
+    n_origins: int = 1
+    num_pieces: int = 64
+    piece_bytes: int = 4 << 20
+    uplink_bps: float = 1.25e9  # ~10 GbE
+    origin_uplink_bps: float = 1.25e9
+    latency_s: float = 0.001
+    announce_interval_s: float = 3.0
+    handout_limit: int = 20
+    max_conns_per_torrent: int = 10
+    pipeline_limit: int = 4
+    piece_timeout_s: float = 8.0
+    churn_idle_s: float = 4.0  # dispatcher default
+    churn_tick_s: float = 1.0
+    seed: int = 0
+    max_sim_s: float = 600.0
+
+
+class _Peer:
+    """Sim-side agent or origin. Policy objects are the production ones."""
+
+    __slots__ = (
+        "pid", "origin", "join_t", "done_t", "has", "avail", "conns",
+        "requests", "cs", "busy_until", "uplink_bps", "dialing",
+    )
+
+    def __init__(self, pid: PeerID, cfg: SimConfig, origin: bool, join_t: float):
+        self.pid = pid
+        self.origin = origin
+        self.join_t = join_t
+        self.done_t: Optional[float] = None
+        self.has: set[int] = set(range(cfg.num_pieces)) if origin else set()
+        self.avail: dict[int, int] = {}  # piece -> count over conns
+        self.conns: dict[PeerID, float] = {}  # peer -> last_useful
+        self.requests = RequestManager(
+            pipeline_limit=cfg.pipeline_limit,
+            timeout_seconds=cfg.piece_timeout_s,
+        )
+        self.cs = ConnState(ConnStateConfig(
+            max_open_conns_per_torrent=cfg.max_conns_per_torrent,
+            # Global cap can't bind with one torrent; keep it out of the way.
+            max_global_conns=10 ** 9,
+        ))
+        self.busy_until = 0.0
+        self.uplink_bps = cfg.origin_uplink_bps if origin else cfg.uplink_bps
+        self.dialing: set[PeerID] = set()
+
+    def complete(self) -> bool:
+        return self.done_t is not None or self.origin
+
+
+class SwarmSim:
+    """One blob, ``n_agents`` leechers, ``n_origins`` seeders."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.h = InfoHash("ab" * 32)
+        self.now = 0.0
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+        self.peers: dict[PeerID, _Peer] = {}
+        self.announce_q = AnnounceQueue()
+        self.announces = 0
+        self.transfers = 0
+        self.duplicates = 0
+        self.busy_rejects = 0
+        self._remaining = cfg.n_agents  # incomplete agents
+        # Tracker swarm membership (each pid once, append-only: the sim
+        # has no TTL churn). Handouts SAMPLE this, as the production
+        # peerstore does; completeness is read from live peer state, a
+        # one-interval-fresher view than the tracker's announce records.
+        self._members: list[PeerID] = []
+        self._member_set: set[PeerID] = set()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _at(self, t: float, fn: Callable[[], None]) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, fn))
+
+    def run(self) -> dict:
+        random.seed(self.cfg.seed)
+        cfg = self.cfg
+        for i in range(cfg.n_origins):
+            pid = PeerID("ff" * 2 + f"{i:036x}")
+            self.peers[pid] = _Peer(pid, cfg, origin=True, join_t=0.0)
+            self._members.append(pid)
+            self._member_set.add(pid)
+        for i in range(cfg.n_agents):
+            pid = PeerID(f"{i:040x}")
+            self.peers[pid] = _Peer(pid, cfg, origin=False, join_t=0.0)
+            self.announce_q.schedule(pid, 0.0)
+        # One announce pump, as in the production scheduler: drain due
+        # announces in batches rather than a timer per peer.
+        self._at(0.0, self._announce_pump)
+        self._at(cfg.churn_tick_s, self._churn_tick)
+
+        while self._heap and self.now <= cfg.max_sim_s and self._remaining:
+            t, _seq, fn = heapq.heappop(self._heap)
+            self.now = t
+            fn()
+        return self._report()
+
+    # -- announce plane ----------------------------------------------------
+
+    def _announce_pump(self) -> None:
+        for pid in self.announce_q.pop_ready(self.now, limit=10 ** 6):
+            self._announce(self.peers[pid])
+        if self._remaining:
+            self._at(self.now + 0.05, self._announce_pump)
+
+    def _info(self, pid: PeerID) -> PeerInfo:
+        p = self.peers[pid]
+        return PeerInfo(pid, "sim", 0, origin=p.origin, complete=p.complete())
+
+    def _announce(self, p: _Peer) -> None:
+        self.announces += 1
+        # Tracker side: record membership, sample candidates (as the
+        # production peerstore does), order with the production policy.
+        if p.pid not in self._member_set:
+            self._member_set.add(p.pid)
+            self._members.append(p.pid)
+        limit = self.cfg.handout_limit
+        k = min(len(self._members), limit + 1)
+        candidates = random.sample(self._members, k)
+        others = [self._info(q) for q in candidates if q != p.pid][:limit]
+        handout = default_priority(others)
+        self.announce_q.schedule(
+            p.pid, self.now + self.cfg.announce_interval_s
+        )
+        if p.complete():
+            return  # seeders announce for discoverability, don't dial
+        for info in handout:
+            self._try_dial(p, info.peer_id)
+
+    # -- conn plane --------------------------------------------------------
+
+    def _try_dial(self, a: _Peer, bid: PeerID) -> None:
+        # Explicit sim-time blacklist check: ConnState.can_dial consults
+        # the blacklist with wall time internally, which is meaningless
+        # under the sim clock.
+        if a.cs.blacklist.blocked(bid, self.h, now=self.now):
+            return
+        if not a.cs.add_pending(bid, self.h):
+            return
+        self._at(self.now + self.cfg.latency_s,
+                 lambda: self._dial_arrives(a, bid))
+
+    def _dial_arrives(self, a: _Peer, bid: PeerID) -> None:
+        b = self.peers[bid]
+        if b.cs.at_capacity(self.h):
+            # Polite busy frame -> soft blacklist, as the production
+            # scheduler does on a busy rejection (scheduler.py:412).
+            self.busy_rejects += 1
+            self._at(self.now + self.cfg.latency_s, lambda: (
+                a.cs.remove_pending(bid, self.h),
+                a.cs.blacklist.add(bid, self.h, now=self.now, soft=True),
+            ))
+            return
+        b.cs.promote(a.pid, self.h)  # inbound: promote directly
+        self._at(self.now + self.cfg.latency_s,
+                 lambda: self._established(a, b))
+
+    def _established(self, a: _Peer, b: _Peer) -> None:
+        a.cs.promote(b.pid, self.h)
+        for x, y in ((a, b), (b, a)):
+            if y.pid not in x.conns:
+                x.conns[y.pid] = self.now
+                for i in y.has:
+                    x.avail[i] = x.avail.get(i, 0) + 1
+        self._select(a, b)
+        self._select(b, a)
+
+    def _drop_conn(self, x: _Peer, y: _Peer) -> None:
+        if y.pid not in x.conns:
+            return
+        for a, b in ((x, y), (y, x)):
+            del a.conns[b.pid]
+            a.cs.remove(b.pid, self.h)
+            a.requests.clear_peer(b.pid)
+            # Clamped decrement: an announce in flight when the conn drops
+            # was never counted, so subtracting b's full has-set can
+            # transiently undercount by one -- bounded by the latency
+            # window, and preferable to per-conn piece snapshots (O(conns
+            # x pieces) memory at 10k agents).
+            for i in b.has:
+                n = a.avail.get(i, 0) - 1
+                if n > 0:
+                    a.avail[i] = n
+                else:
+                    a.avail.pop(i, None)
+
+    def _churn_tick(self) -> None:
+        cutoff = self.cfg.churn_idle_s
+        for p in self.peers.values():
+            for qid, last in list(p.conns.items()):
+                if self.now - last > cutoff:
+                    self._drop_conn(p, self.peers[qid])
+        if self._remaining:
+            self._at(self.now + self.cfg.churn_tick_s, self._churn_tick)
+
+    # -- piece plane -------------------------------------------------------
+
+    def _select(self, a: _Peer, b: _Peer) -> None:
+        """``a`` asks the production RequestManager what to fetch from
+        ``b`` and schedules the transfers."""
+        if a.origin or a.done_t is not None or b.pid not in a.conns:
+            return
+        missing = [i for i in range(self.cfg.num_pieces) if i not in a.has]
+        if not missing:
+            return
+        chosen = a.requests.select(
+            b.pid, b.has, missing, a.avail, now=self.now
+        )
+        for i in chosen:
+            self._at(self.now + self.cfg.latency_s,
+                     lambda i=i: self._serve(b, a, i))
+
+    def _serve(self, b: _Peer, a: _Peer, i: int) -> None:
+        """Request for piece ``i`` arrives at ``b``: FIFO-queue it on b's
+        uplink."""
+        if i not in b.has:
+            return  # raced ahead of an announce; timeout will re-request
+        if a.pid in b.conns:
+            b.conns[a.pid] = self.now  # a request is useful traffic
+        start = max(self.now, b.busy_until)
+        done = start + self.cfg.piece_bytes / b.uplink_bps
+        b.busy_until = done
+        self._at(done + self.cfg.latency_s,
+                 lambda: self._on_piece(a, b, i))
+
+    def _on_piece(self, a: _Peer, b: _Peer, i: int) -> None:
+        self.transfers += 1
+        if b.pid in a.conns:
+            a.conns[b.pid] = self.now  # payload is useful traffic
+        a.requests.clear_piece(i, now=self.now)
+        if i in a.has or a.done_t is not None:
+            self.duplicates += 1
+            self._select(a, b)  # endgame duplicate: just keep pulling
+            return
+        a.has.add(i)
+        # Announce the new piece to every conn (metadata hop).
+        for cid in a.conns:
+            c = self.peers[cid]
+            self._at(self.now + self.cfg.latency_s,
+                     lambda a=a, c=c, i=i: self._on_announce_piece(c, a, i))
+        if len(a.has) == self.cfg.num_pieces:
+            a.done_t = self.now
+            self._remaining -= 1
+            return
+        self._select(a, b)
+
+    def _on_announce_piece(self, c: _Peer, a: _Peer, i: int) -> None:
+        if a.pid not in c.conns:
+            return
+        c.conns[a.pid] = self.now  # progress announce is useful traffic
+        c.avail[i] = c.avail.get(i, 0) + 1
+        self._select(c, a)
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report(self) -> dict:
+        lat = sorted(
+            p.done_t - p.join_t
+            for p in self.peers.values()
+            if not p.origin and p.done_t is not None
+        )
+        n = len(lat)
+        incomplete = self.cfg.n_agents - n
+        q = (lambda f: lat[min(n - 1, int(f * n))]) if n else (lambda f: None)
+        return {
+            "agents": self.cfg.n_agents,
+            "completed": n,
+            "incomplete": incomplete,
+            "p50_s": q(0.50),
+            "p99_s": q(0.99),
+            "max_s": lat[-1] if n else None,
+            "mean_s": statistics.fmean(lat) if n else None,
+            "sim_end_s": self.now,
+            "announces": self.announces,
+            "announces_per_s": self.announces / self.now if self.now else 0.0,
+            "transfers": self.transfers,
+            "duplicate_transfers": self.duplicates,
+            "busy_rejects": self.busy_rejects,
+        }
+
+
+def run_sim(**overrides) -> dict:
+    return SwarmSim(SimConfig(**overrides)).run()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 from typing import Optional
 
@@ -41,10 +42,17 @@ class PeerStore:
 
 
 class InMemoryPeerStore(PeerStore):
+    # Amortized sweep cadence: every N updates, expire-scan EVERY swarm.
+    # Per-swarm pruning in get_peers only reaps hashes someone still asks
+    # about; a tracker serving many one-shot torrents accumulates dead
+    # swarms nobody will ever query again.
+    _SWEEP_EVERY = 1024
+
     def __init__(self, ttl_seconds: float = 30.0):
         self.ttl = ttl_seconds
         # info_hash -> peer_id hex -> (expiry, PeerInfo)
         self._swarms: dict[str, dict[str, tuple[float, PeerInfo]]] = {}
+        self._updates = 0
 
     async def update(
         self, info_hash: str, peer: PeerInfo, now: float | None = None
@@ -52,6 +60,17 @@ class InMemoryPeerStore(PeerStore):
         now = time.monotonic() if now is None else now
         swarm = self._swarms.setdefault(info_hash, {})
         swarm[peer.peer_id.hex] = (now + self.ttl, peer)
+        self._updates += 1
+        if self._updates % self._SWEEP_EVERY == 0:
+            self._sweep(now)
+
+    def _sweep(self, now: float) -> None:
+        for h, swarm in list(self._swarms.items()):
+            for pid, (expiry, _p) in list(swarm.items()):
+                if expiry <= now:
+                    del swarm[pid]
+            if not swarm:
+                del self._swarms[h]
 
     async def get_peers(
         self, info_hash: str, limit: int = 50, now: float | None = None
@@ -63,7 +82,21 @@ class InMemoryPeerStore(PeerStore):
         for pid, (expiry, _p) in list(swarm.items()):
             if expiry <= now:
                 del swarm[pid]
-        return [p for _e, p in swarm.values()][:limit]
+        if not swarm:
+            # Drop the emptied swarm entry: a tracker serving many
+            # one-shot torrents would otherwise grow without bound.
+            del self._swarms[info_hash]
+            return []
+        if len(swarm) <= limit:
+            return [p for _e, p in swarm.values()]
+        # SAMPLE, don't slice: insertion order hands every announcer the
+        # same first-N peers, and in a large swarm those N saturate while
+        # everyone else starves (measured: the 10k-agent sim could not
+        # complete before this). Random sampling is also the reference
+        # peerstore's behavior.
+        return [
+            swarm[k][1] for k in random.sample(list(swarm), limit)
+        ]
 
 
 class RespError(Exception):
@@ -253,7 +286,13 @@ class RedisPeerStore(PeerStore):
                 dead.append(field)
         if dead:
             await self._cmd("HDEL", self._key(info_hash), *dead)
-        return out[:limit]
+        if len(out) <= limit:
+            return out
+        # SAMPLE, not slice: HGETALL field order is stable per key, so a
+        # slice hands every announcer the same N peers -- the large-swarm
+        # starvation wedge documented in PERF.md (same fix as the
+        # in-memory store above).
+        return random.sample(out, limit)
 
     async def close(self) -> None:
         if self._conn is not None:

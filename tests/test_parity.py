@@ -467,3 +467,67 @@ def test_announce_shape_garbage_is_400():
             await tracker.stop()
 
     asyncio.run(main())
+
+
+def test_inmemory_peerstore_samples_prunes_and_sweeps():
+    """Pins the large-swarm handout behavior PERF.md calls load-bearing:
+    over-limit swarms are randomly SAMPLED (a stable slice hands every
+    announcer the same N peers and starves the rest), emptied swarms are
+    dropped on read, and an amortized sweep reaps one-shot swarms nobody
+    queries again."""
+
+    async def main():
+        from kraken_tpu.tracker.peerstore import InMemoryPeerStore
+
+        def peer(i: int) -> PeerInfo:
+            return PeerInfo(peer_id=PeerID(f"{i:040x}"), ip="10.0.0.1", port=i)
+
+        store = InMemoryPeerStore(ttl_seconds=30.0)
+        for i in range(400):
+            await store.update("big", peer(i), now=0.0)
+        # Small swarm: everyone, no sampling.
+        await store.update("small", peer(1), now=0.0)
+        assert len(await store.get_peers("small", limit=10, now=1.0)) == 1
+        # Over-limit swarm: repeated reads must not keep returning the
+        # same window. 5 draws of 10 from 400 cover >10 distinct peers
+        # with probability 1 - ~1e-60.
+        seen = set()
+        for _ in range(5):
+            got = await store.get_peers("big", limit=10, now=1.0)
+            assert len(got) == 10
+            seen |= {p.peer_id for p in got}
+        assert len(seen) > 10
+        # Emptied swarm entries are dropped on read...
+        store2 = InMemoryPeerStore(ttl_seconds=1.0)
+        await store2.update("oneshot", peer(1), now=0.0)
+        assert await store2.get_peers("oneshot", now=10.0) == []
+        assert "oneshot" not in store2._swarms
+        # ...and swarms nobody re-reads are reaped by the update sweep.
+        store3 = InMemoryPeerStore(ttl_seconds=1.0)
+        for i in range(200):
+            await store3.update(f"h{i}", peer(i), now=0.0)
+        for j in range(InMemoryPeerStore._SWEEP_EVERY):
+            await store3.update("live", peer(j % 64), now=100.0)
+        assert set(store3._swarms) == {"live"}
+
+    asyncio.run(main())
+
+
+def test_redis_peerstore_samples_large_swarms():
+    """Same starvation fix on the Redis store: HGETALL field order is
+    stable, so over-limit swarms must sample, not slice."""
+
+    async def main():
+        async with FakeRedis() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30)
+            for i in range(1, 120):
+                await store.update("big", _peer(i % 250 + 1))
+            seen = set()
+            for _ in range(5):
+                got = await store.get_peers("big", limit=10)
+                assert len(got) == 10
+                seen |= {p.port for p in got}
+            assert len(seen) > 10
+            await store.close()
+
+    asyncio.run(main())

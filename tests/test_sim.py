@@ -1,0 +1,56 @@
+"""Discrete-event swarm simulator tests (SURVEY.md SS4 tier 3).
+
+The sim drives the production policy objects (RequestManager, ConnState,
+AnnounceQueue, default_priority); these tests pin completion, conservation
+invariants, and determinism so the 10k-agent bench numbers are trustable
+as regression signals.
+"""
+
+from kraken_tpu.p2p.sim import SimConfig, SwarmSim, run_sim
+
+
+def test_small_swarm_completes():
+    r = run_sim(n_agents=50, num_pieces=16, seed=7)
+    assert r["completed"] == 50 and r["incomplete"] == 0
+    assert 0 < r["p50_s"] <= r["p99_s"] <= r["max_s"] < 60.0
+    # Conservation: every agent got every piece exactly once, plus any
+    # endgame duplicates (bounded by the rescue policy).
+    assert r["transfers"] == 50 * 16 + r["duplicate_transfers"]
+    assert r["duplicate_transfers"] <= 50 * 16 * 0.25
+    assert r["announces"] >= 50  # at least the join announces
+
+
+def test_same_seed_replays_exactly():
+    a = run_sim(n_agents=120, num_pieces=16, seed=3)
+    b = run_sim(n_agents=120, num_pieces=16, seed=3)
+    assert a == b
+
+
+def test_flash_crowd_exercises_admission_and_churn():
+    """A crowd 20x the origin's conn budget must busy-reject (polite
+    rejection + soft blacklist) yet still complete: churn is what frees
+    seeder slots for waiting leechers."""
+    r = run_sim(
+        n_agents=200, num_pieces=16, max_conns_per_torrent=10, seed=1
+    )
+    assert r["busy_rejects"] > 0
+    assert r["completed"] == 200
+
+
+def test_origin_bottleneck_shows_in_latency():
+    """Halving the origin's uplink must not halve swarm throughput -- the
+    point of the P2P mesh is that agents serve each other. The sim should
+    show sublinear sensitivity to origin bandwidth."""
+    fast = run_sim(n_agents=100, num_pieces=16, seed=5)
+    slow = run_sim(
+        n_agents=100, num_pieces=16, seed=5, origin_uplink_bps=1.25e9 / 4
+    )
+    assert slow["completed"] == fast["completed"] == 100
+    assert slow["p99_s"] < fast["p99_s"] * 3.0
+
+
+def test_incomplete_is_reported_not_hidden():
+    """A sim cut off early reports incompletes honestly."""
+    r = run_sim(n_agents=100, num_pieces=64, seed=2, max_sim_s=0.5)
+    assert r["incomplete"] > 0
+    assert r["completed"] + r["incomplete"] == 100
