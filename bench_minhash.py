@@ -1,27 +1,34 @@
 """MinHash/LSH index benchmark at survey scale.
 
 BASELINE.json config #5: "MinHash/SimHash index, 1M layer chunk-sets,
-top-k recall vs brute force -- measure". This drives the production index
-(kraken_tpu/ops/minhash.py: MinHasher 128 hashes, LSHIndex 32 bands) on a
-corpus of N synthetic layer chunk-fingerprint sets with planted
-near-duplicates across the Jaccard range, and reports:
+top-k recall vs brute force -- measure". Drives the production index
+(kraken_tpu/ops/minhash.py: MinHasher 128 hashes, 32 bands) on a corpus
+of N synthetic layer chunk-fingerprint sets with planted near-duplicates
+across the Jaccard range, and reports:
 
 - recall@10 vs the brute-force oracle (restricted to true matches with
   J >= 0.3, i.e. above the LSH S-curve knee at ~0.42 where retrieval is
   the design intent);
 - planted-pair retrieval rate per Jaccard bucket (the operative number:
   "if a layer J-similar to a stored one arrives, do we find it?");
-- sketch throughput (TPU-batched), index build rate, and query rate.
+- sketch throughput (TPU-batched), index build rate, query rate, peak
+  RSS, and the index's accounted bytes/set.
 
-Prints ONE JSON line. N defaults to 100k sets (~128 chunks each ~= a 8
-MiB layer at 64 KiB chunks -- so the default models a ~0.8 TiB corpus);
-override with MINHASH_N. Memory is O(N * 128) u32 for sketches.
+The corpus is generated-and-sketched in streaming batches (raw sets are
+never all resident), so N=1,000,000 runs in ~1.2 GB of index memory.
+Index implementation: ``CompactLSHIndex`` (array-backed, byte-budgeted)
+for N > 200k or MINHASH_INDEX=compact; the dict-based ``LSHIndex`` (the
+origin /similar path) otherwise. Prints ONE JSON line.
+
+    MINHASH_N=1000000 python bench_minhash.py        # BASELINE row 5 scale
+    MINHASH_BUDGET_MB=1500 MINHASH_N=1000000 ...     # with eviction budget
 
 Run on TPU (default platform) or CPU (JAX_PLATFORMS=cpu).
 """
 
 import json
 import os
+import resource
 import sys
 import time
 
@@ -32,63 +39,102 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 N = int(os.environ.get("MINHASH_N", 100_000))
 CHUNKS_PER_SET = int(os.environ.get("MINHASH_CHUNKS", 128))
 N_QUERIES = int(os.environ.get("MINHASH_QUERIES", 500))
+BUDGET_MB = int(os.environ.get("MINHASH_BUDGET_MB", 0))
+INDEX_KIND = os.environ.get(
+    "MINHASH_INDEX", "compact" if N > 200_000 else "dict"
+)
 J_BUCKETS = (0.3, 0.5, 0.7, 0.9)
+BATCH = 2048
 
 
-def make_corpus(rng: np.random.Generator):
-    """N fingerprint sets; the last len(J_BUCKETS)*Q sets are planted
-    near-duplicates of base sets at controlled Jaccard levels."""
-    sets = [
-        rng.integers(1, 1 << 32, size=CHUNKS_PER_SET, dtype=np.uint64)
-        .astype(np.uint32)
-        for _ in range(N)
-    ]
-    planted = []  # (query_idx, target_idx, j_expected)
+def gen_and_sketch(rng: np.random.Generator, hasher):
+    """Stream-generate the corpus and sketch it batch-by-batch; only the
+    planted-query base sets are retained as raw fingerprints. Returns
+    ([N+Q, K] sketches, planted (query_idx, target_idx, j), seconds)."""
     q_per_bucket = N_QUERIES // len(J_BUCKETS)
+    nq = q_per_bucket * len(J_BUCKETS)
+    base_idx = rng.integers(0, N, size=nq)
+    base_needed = set(base_idx.tolist())
+    kept: dict[int, np.ndarray] = {}
+    sketches = np.empty((N + nq, hasher.num_hashes), dtype=np.uint32)
+    # sketch_s times ONLY the sketch_batch calls (device throughput),
+    # not corpus generation -- comparability with the round-3 metric.
+    sketch_s = 0.0
+    for start in range(0, N, BATCH):
+        cnt = min(BATCH, N - start)
+        batch = [
+            rng.integers(1, 1 << 32, size=CHUNKS_PER_SET, dtype=np.uint64)
+            .astype(np.uint32)
+            for _ in range(cnt)
+        ]
+        for k, s in enumerate(batch):
+            if start + k in base_needed:
+                kept[start + k] = s
+        t0 = time.perf_counter()
+        sketches[start : start + cnt] = hasher.sketch_batch(batch)
+        sketch_s += time.perf_counter() - t0
+    planted = []
+    qsets = []
     next_idx = N
+    qi = 0
+    # (query construction below is untimed; their sketching is timed)
     for j in J_BUCKETS:
         for _ in range(q_per_bucket):
-            base_idx = int(rng.integers(0, N))
-            base = sets[base_idx]
-            # |A n B| / |A u B| = j with |A| = |B| = m: share s = 2j/(1+j)
+            bidx = int(base_idx[qi])
+            qi += 1
+            base = kept[bidx]
             m = len(base)
+            # |A n B| / |A u B| = j with |A| = |B| = m: share 2j/(1+j).
             shared = int(round(m * 2 * j / (1 + j)))
-            q = np.concatenate([
+            qsets.append(np.concatenate([
                 base[:shared],
                 rng.integers(1, 1 << 32, size=m - shared, dtype=np.uint64)
                 .astype(np.uint32),
-            ])
-            sets.append(q)
-            planted.append((next_idx, base_idx, j))
+            ]))
+            planted.append((next_idx, bidx, j))
             next_idx += 1
-    return sets, planted
+    for start in range(0, nq, BATCH):
+        cnt = min(BATCH, nq - start)
+        t0 = time.perf_counter()
+        sketches[N + start : N + start + cnt] = hasher.sketch_batch(
+            qsets[start : start + cnt]
+        )
+        sketch_s += time.perf_counter() - t0
+    return sketches, planted, sketch_s
 
 
 def main():
-    from kraken_tpu.ops.minhash import LSHIndex, MinHasher
+    from kraken_tpu.ops.minhash import CompactLSHIndex, LSHIndex, MinHasher
 
     rng = np.random.default_rng(7)
-    sets, planted = make_corpus(rng)
     hasher = MinHasher(num_hashes=128)
+    sketches, planted, sketch_s = gen_and_sketch(rng, hasher)
+    sets_per_s = (N + len(planted)) / sketch_s
 
-    # Sketch: TPU-batched in fixed groups.
-    t0 = time.perf_counter()
-    sketches = []
-    B = 2048
-    for s in range(0, len(sets), B):
-        sketches.append(hasher.sketch_batch(sets[s : s + B]))
-    sketches = np.concatenate(sketches)
-    sketch_s = time.perf_counter() - t0
-    sets_per_s = len(sets) / sketch_s
+    if INDEX_KIND == "compact":
+        index = CompactLSHIndex(
+            hasher, num_bands=32,
+            budget_bytes=BUDGET_MB << 20 if BUDGET_MB else None,
+        )
+        t0 = time.perf_counter()
+        for s in range(0, N, BATCH):
+            index.add_batch(
+                list(range(s, min(s + BATCH, N))),
+                sketches[s : min(s + BATCH, N)],
+            )
+        index.flush()  # bulk-load-then-query: queries become pure bisect
+        build_s = time.perf_counter() - t0
+        bytes_per_set = index.footprint_bytes() // max(1, len(index))
+        evictions = index.evictions
+    else:
+        index = LSHIndex(hasher, num_bands=32)
+        t0 = time.perf_counter()
+        for i in range(N):
+            index.add(i, sketches[i])
+        build_s = time.perf_counter() - t0
+        bytes_per_set = None  # dict storage: no accounted footprint
+        evictions = 0
 
-    # Build the index over the N corpus sets (queries stay out).
-    index = LSHIndex(hasher, num_bands=32)
-    t0 = time.perf_counter()
-    for i in range(N):
-        index.add(i, sketches[i])
-    build_s = time.perf_counter() - t0
-
-    # Planted-pair retrieval + recall@10 vs brute force.
     hits_by_j = {j: 0 for j in J_BUCKETS}
     count_by_j = {j: 0 for j in J_BUCKETS}
     recall_sum = 0.0
@@ -116,7 +162,8 @@ def main():
         "value": round(recall10, 4),
         "unit": "fraction (vs brute-force oracle, J>=0.3)",
         "vs_baseline": round(recall10, 4),  # baseline target: measure
-        "n_sets": len(sets),
+        "n_sets": N + len(planted),
+        "index": INDEX_KIND,
         "planted_retrieval_by_jaccard": {
             str(j): round(hits_by_j[j] / max(1, count_by_j[j]), 4)
             for j in J_BUCKETS
@@ -124,6 +171,10 @@ def main():
         "sketch_sets_per_s": round(sets_per_s),
         "index_adds_per_s": round(N / build_s),
         "queries_per_s": round(len(planted) / query_s),
+        "index_bytes_per_set": bytes_per_set,
+        "evictions": evictions,
+        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        // 1024,
     }))
 
 

@@ -71,6 +71,18 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.kt_pack_tiles_mt.restype = None
+        lib.kt_cdc_chunk.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        lib.kt_cdc_chunk.restype = ctypes.c_size_t
         _LIB = lib
     except (OSError, AttributeError):
         # AttributeError: a stale cached _hostpack.so from an older source
@@ -82,6 +94,38 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def have_native_packer() -> bool:
     return _load() is not None
+
+
+def cdc_chunk_native(
+    data: np.ndarray,
+    min_size: int,
+    avg_size: int,
+    max_size: int,
+    mask_strict: int,
+    mask_loose: int,
+) -> Optional[np.ndarray]:
+    """Sequential FastCDC cut offsets via the C chunker (~1.5 GB/s/core);
+    None when no native library is available. ``data`` is a contiguous
+    uint8 array; returns uint64 end offsets (exclusive)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "kt_cdc_chunk"):
+        return None
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    n = data.size
+    cap = n // min_size + 2
+    cuts = np.empty(cap, dtype=np.uint64)
+    ncuts = lib.kt_cdc_chunk(
+        data.ctypes.data_as(ctypes.c_void_p),
+        n,
+        min_size,
+        avg_size,
+        max_size,
+        mask_strict,
+        mask_loose,
+        cuts.ctypes.data_as(ctypes.c_void_p),
+        cap,
+    )
+    return cuts[:ncuts]
 
 
 def default_pack_threads() -> int:

@@ -285,3 +285,336 @@ class LSHIndex:
             scores = _score(query, self._corpus[live])
         order = np.argsort(-scores)[:k]
         return [(self._keys[live[i]], float(scores[i])) for i in order]
+
+
+def _band_sigs(sketches: np.ndarray, num_bands: int) -> np.ndarray:
+    """[N, K] uint32 sketches -> [N, B] uint64 band signatures (FNV-1a
+    over each band's rows, vectorized). 64-bit sigs at 1M rows/band give
+    ~3e-8 expected accidental collisions -- noise next to LSH's own
+    false-candidate rate -- at half the memory of raw 16-byte keys."""
+    n, k = sketches.shape
+    rows = k // num_bands
+    v = sketches.reshape(n, num_bands, rows).astype(np.uint64)
+    h = np.full((n, num_bands), 0xCBF29CE484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    for r in range(rows):
+        h = (h ^ v[:, :, r]) * prime
+    return h
+
+
+class BudgetExceeded(Exception):
+    pass
+
+
+class CompactLSHIndex:
+    """Array-backed LSH index for million-set corpora, with a byte budget.
+
+    Same banding math and the same query semantics as :class:`LSHIndex`,
+    different storage (that class spends multiple KB/set in per-band dict
+    buckets at 1M sets; this one ~1 KB/set all-in):
+
+    - sketches live in ONE growable ``[cap, K]`` uint32 matrix -- no
+      per-row Python objects (512 B/set at K=128);
+    - each band keeps (sorted uint64 sigs, parallel int32 rows) numpy
+      pairs plus an unsorted pending tail; the tail merges in when it
+      outgrows ``max(4096, merged/8)``, so lookups are two binary
+      searches + a small linear scan, amortized O(N log N) to build;
+      12 B/set/band x 32 bands = 384 B/set for the band plane;
+    - ``budget_bytes`` caps the accounted footprint; when an add would
+      exceed it the OLDEST live rows are evicted (layer churn means old
+      sketches are the least likely to be queried) and storage compacted.
+
+    Tombstoned/evicted rows are dropped at merge/compact; ``remove`` and
+    re-``add`` share :class:`LSHIndex` semantics (latest add wins).
+    """
+
+    def __init__(
+        self,
+        hasher: MinHasher,
+        num_bands: int = 32,
+        budget_bytes: int | None = None,
+    ):
+        if hasher.num_hashes % num_bands:
+            raise ValueError(
+                f"num_bands {num_bands} must divide num_hashes {hasher.num_hashes}"
+            )
+        self.hasher = hasher
+        self.num_bands = num_bands
+        self.rows = hasher.num_hashes // num_bands
+        self.budget_bytes = budget_bytes
+        self.evictions = 0
+        k = hasher.num_hashes
+        self._mat = np.empty((1024, k), dtype=np.uint32)
+        self._n = 0  # rows used in _mat (live + dead)
+        self._alive = np.zeros(1024, dtype=bool)
+        self._keys: list[Hashable] = []
+        self._key_idx: dict[Hashable, int] = {}
+        self._dead = 0
+        # Per band: merged (sorted sigs, rows) + pending (unsorted numpy
+        # tail, filled to _pend_n). Pending is numpy so the per-query
+        # equality scan is SIMD, not a Python loop.
+        self._merged: list[tuple[np.ndarray, np.ndarray]] = [
+            (np.empty(0, np.uint64), np.empty(0, np.int32))
+            for _ in range(num_bands)
+        ]
+        self._pend_sigs: list[np.ndarray] = [
+            np.empty(4096, np.uint64) for _ in range(num_bands)
+        ]
+        self._pend_rows: list[np.ndarray] = [
+            np.empty(4096, np.int32) for _ in range(num_bands)
+        ]
+        self._pend_n = [0] * num_bands
+        # Device-resident live rows for brute scans (see LSHIndex).
+        self._gen = 0
+        self._dev = None
+        self._dev_live: np.ndarray | None = None
+        self._dev_gen = -1
+
+    def __len__(self) -> int:
+        return self._n - self._dead
+
+    # -- storage -----------------------------------------------------------
+
+    def footprint_bytes(self) -> int:
+        """Accounted index footprint: the numpy storage exactly, plus a
+        ~100 B/key allowance for the Python key list + key->row dict."""
+        b = self._mat.nbytes + self._alive.nbytes
+        for sigs, rows in self._merged:
+            b += sigs.nbytes + rows.nbytes
+        for p in self._pend_sigs:
+            b += p.nbytes
+        for p in self._pend_rows:
+            b += p.nbytes
+        b += len(self._keys) * 100
+        return b
+
+    def _grow(self, need: int) -> None:
+        cap = self._mat.shape[0]
+        if self._n + need <= cap:
+            return
+        new_cap = cap
+        while new_cap < self._n + need:
+            new_cap *= 2
+        self._mat = np.concatenate(
+            [self._mat, np.empty((new_cap - cap, self._mat.shape[1]),
+                                 dtype=np.uint32)]
+        )
+        self._alive = np.concatenate(
+            [self._alive, np.zeros(new_cap - cap, dtype=bool)]
+        )
+
+    # Pending tails merge when full. The cap trades amortized merge-sort
+    # work against the per-query linear scan of the tail; 64k keeps both
+    # small (a 1M-row band re-sorts ~15 times; a query scans <= 64k u64
+    # per band, SIMD).
+    _PEND_MAX = 65536
+
+    def _pend_cap(self, band: int) -> int:
+        return min(
+            self._PEND_MAX, max(4096, len(self._merged[band][0]) // 8)
+        )
+
+    def _merge_band(self, band: int) -> None:
+        n = self._pend_n[band]
+        sigs, rows = self._merged[band]
+        all_s = np.concatenate([sigs, self._pend_sigs[band][:n]])
+        all_r = np.concatenate([rows, self._pend_rows[band][:n]])
+        live = self._alive[all_r]  # drop tombstones while we're here
+        all_s, all_r = all_s[live], all_r[live]
+        order = np.argsort(all_s, kind="stable")
+        self._merged[band] = (all_s[order], all_r[order])
+        self._pend_n[band] = 0
+
+    def flush(self) -> None:
+        """Merge every pending tail. Bulk-load-then-query workloads call
+        this once after loading so queries are pure binary search."""
+        for band in range(self.num_bands):
+            if self._pend_n[band]:
+                self._merge_band(band)
+
+    # -- mutation ----------------------------------------------------------
+
+    def add(self, key: Hashable, sketch: np.ndarray) -> None:
+        self.add_batch([key], np.asarray(sketch, dtype=np.uint32)[None, :])
+
+    def add_batch(self, keys: Sequence[Hashable], sketches: np.ndarray) -> None:
+        """Bulk add: one signature pass + one pending append per band.
+        Keys must be unique within the batch (duplicates across batches
+        follow re-add semantics: latest wins)."""
+        sketches = np.asarray(sketches, dtype=np.uint32)
+        if sketches.ndim != 2 or sketches.shape[0] != len(keys):
+            raise ValueError("sketches must be [len(keys), K]")
+        for key in keys:
+            old = self._key_idx.pop(key, None)
+            if old is not None and self._alive[old]:
+                self._alive[old] = False
+                self._dead += 1
+        n = len(keys)
+        self._grow(n)
+        start = self._n
+        self._mat[start : start + n] = sketches
+        self._alive[start : start + n] = True
+        self._n += n
+        for i, key in enumerate(keys):
+            self._keys.append(key)
+            self._key_idx[key] = start + i
+        self._gen += 1  # live-row set changed: device cache is stale
+        sigs = _band_sigs(sketches, self.num_bands)
+        new_rows = np.arange(start, start + n, dtype=np.int32)
+        for band in range(self.num_bands):
+            self._pend_append(band, sigs[:, band], new_rows)
+            if self._pend_n[band] >= self._pend_cap(band):
+                self._merge_band(band)
+        if self.budget_bytes is not None:
+            self._enforce_budget()
+        elif self._dead > 64 and self._dead * 2 > self._n:
+            self._compact()
+
+    def _pend_append(
+        self, band: int, sigs: np.ndarray, rows: np.ndarray
+    ) -> None:
+        need = self._pend_n[band] + len(sigs)
+        buf_s = self._pend_sigs[band]
+        if need > len(buf_s):
+            cap = max(need, 2 * len(buf_s))
+            ns = np.empty(cap, np.uint64)
+            nr = np.empty(cap, np.int32)
+            ns[: self._pend_n[band]] = buf_s[: self._pend_n[band]]
+            nr[: self._pend_n[band]] = self._pend_rows[band][
+                : self._pend_n[band]
+            ]
+            self._pend_sigs[band], self._pend_rows[band] = ns, nr
+        self._pend_sigs[band][self._pend_n[band] : need] = sigs
+        self._pend_rows[band][self._pend_n[band] : need] = rows
+        self._pend_n[band] = need
+
+    def remove(self, key: Hashable) -> bool:
+        idx = self._key_idx.pop(key, None)
+        if idx is None or not self._alive[idx]:
+            return False
+        self._alive[idx] = False
+        self._dead += 1
+        self._gen += 1
+        if self._dead > 64 and self._dead * 2 > self._n:
+            self._compact()
+        return True
+
+    def _compact(self, extra_evict: int = 0) -> None:
+        """Rebuild matrix + bands from live rows (oldest ``extra_evict``
+        live rows dropped first -- the budget eviction path)."""
+        live_rows = np.flatnonzero(self._alive[: self._n])
+        if extra_evict:
+            evicted = live_rows[:extra_evict]
+            self._alive[evicted] = False
+            self.evictions += len(evicted)
+            live_rows = live_rows[extra_evict:]
+        mat = self._mat[live_rows].copy()
+        keys = [self._keys[i] for i in live_rows]
+        k = self.hasher.num_hashes
+        self._n = len(keys)
+        cap = max(1024, _next_pow2(self._n))
+        self._mat = np.empty((cap, k), dtype=np.uint32)
+        self._mat[: self._n] = mat
+        self._alive = np.zeros(cap, dtype=bool)
+        self._alive[: self._n] = True
+        self._keys = keys
+        self._key_idx = {key: i for i, key in enumerate(keys)}
+        self._dead = 0
+        self._gen += 1
+        self._merged = [
+            (np.empty(0, np.uint64), np.empty(0, np.int32))
+            for _ in range(self.num_bands)
+        ]
+        self._pend_sigs = [
+            np.empty(4096, np.uint64) for _ in range(self.num_bands)
+        ]
+        self._pend_rows = [
+            np.empty(4096, np.int32) for _ in range(self.num_bands)
+        ]
+        self._pend_n = [0] * self.num_bands
+        if self._n:
+            sigs = _band_sigs(self._mat[: self._n], self.num_bands)
+            rows = np.arange(self._n, dtype=np.int32)
+            for band in range(self.num_bands):
+                order = np.argsort(sigs[:, band], kind="stable")
+                self._merged[band] = (sigs[order, band], rows[order])
+
+    def _enforce_budget(self) -> None:
+        if self.footprint_bytes() <= self.budget_bytes:
+            return
+        # Evict oldest live rows, at least 10% of the corpus per pass
+        # (avoids thrashing a compaction per add).
+        self._compact()  # drop dead rows first; they are free savings
+        while self.footprint_bytes() > self.budget_bytes:
+            if not len(self):
+                # Budget below the empty-index floor (preallocated matrix
+                # + pending buffers): no eviction can satisfy it -- a
+                # misconfiguration that must be loud, not a silently
+                # always-empty index.
+                raise BudgetExceeded(
+                    f"budget {self.budget_bytes} B is below the empty-"
+                    f"index floor ({self.footprint_bytes()} B)"
+                )
+            self._compact(extra_evict=max(1, len(self) // 10))
+
+    # -- query -------------------------------------------------------------
+
+    def candidates(self, sketch: np.ndarray) -> set[int]:
+        """LIVE row indices sharing >= 1 band signature with ``sketch``."""
+        sketch = np.asarray(sketch, dtype=np.uint32)
+        sigs = _band_sigs(sketch[None, :], self.num_bands)[0]
+        out: set[int] = set()
+        for band in range(self.num_bands):
+            target = sigs[band]
+            merged_s, merged_r = self._merged[band]
+            lo = np.searchsorted(merged_s, target, side="left")
+            hi = np.searchsorted(merged_s, target, side="right")
+            if hi > lo:
+                out.update(merged_r[lo:hi].tolist())
+            n_p = self._pend_n[band]
+            if n_p:
+                hits = np.flatnonzero(self._pend_sigs[band][:n_p] == target)
+                if hits.size:
+                    out.update(self._pend_rows[band][hits].tolist())
+        return {i for i in out if self._alive[i]}
+
+    def query(
+        self, sketch: np.ndarray, k: int = 10, min_jaccard: float = 0.0
+    ) -> list[tuple[Hashable, float]]:
+        cand = sorted(self.candidates(sketch))
+        if not cand:
+            return []
+        scores = _score(
+            np.asarray(sketch, dtype=np.uint32), self._mat[cand]
+        )
+        order = np.argsort(-scores)[:k]
+        return [
+            (self._keys[cand[i]], float(scores[i]))
+            for i in order
+            if scores[i] >= min_jaccard
+        ]
+
+    def query_brute(
+        self, sketch: np.ndarray, k: int = 10
+    ) -> list[tuple[Hashable, float]]:
+        """Top-k over every live row (oracle path; one [N, K] device op
+        for large corpora)."""
+        if not len(self):
+            return []
+        query = np.asarray(sketch, dtype=np.uint32)
+        if len(self) >= _SCORE_DEVICE_MIN:
+            if self._dev is None or self._dev_gen != self._gen:
+                self._dev_live = np.flatnonzero(self._alive[: self._n])
+                self._dev = jnp.asarray(
+                    _pad_pow2_rows(self._mat[self._dev_live])
+                )
+                self._dev_gen = self._gen
+            live = self._dev_live
+            scores = np.asarray(
+                _score_kernel(jnp.asarray(query), self._dev)
+            )[: len(live)]
+        else:
+            live = np.flatnonzero(self._alive[: self._n])
+            scores = _score(query, self._mat[live])
+        order = np.argsort(-scores)[:k]
+        return [(self._keys[live[i]], float(scores[i])) for i in order]

@@ -74,7 +74,7 @@ class _Peer:
 
     __slots__ = (
         "pid", "origin", "join_t", "done_t", "has", "avail", "conns",
-        "requests", "cs", "busy_until", "uplink_bps", "dialing",
+        "requests", "cs", "bl", "busy_until", "uplink_bps",
     )
 
     def __init__(self, pid: PeerID, cfg: SimConfig, origin: bool, join_t: float):
@@ -89,14 +89,23 @@ class _Peer:
             pipeline_limit=cfg.pipeline_limit,
             timeout_seconds=cfg.piece_timeout_s,
         )
-        self.cs = ConnState(ConnStateConfig(
+        cs_config = ConnStateConfig(
             max_open_conns_per_torrent=cfg.max_conns_per_torrent,
             # Global cap can't bind with one torrent; keep it out of the way.
             max_global_conns=10 ** 9,
-        ))
+        )
+        self.cs = ConnState(cs_config)
+        # The blacklist lives OUTSIDE self.cs: ConnState.can_dial consults
+        # its own blacklist with wall-clock time, which against sim-time
+        # expiries would make results depend on host uptime. This
+        # standalone production Blacklist is driven with explicit sim
+        # `now`; cs.blacklist stays empty so can_dial's internal check is
+        # inert.
+        from kraken_tpu.p2p.connstate import Blacklist
+
+        self.bl = Blacklist(cs_config)
         self.busy_until = 0.0
         self.uplink_bps = cfg.origin_uplink_bps if origin else cfg.uplink_bps
-        self.dialing: set[PeerID] = set()
 
     def complete(self) -> bool:
         return self.done_t is not None or self.origin
@@ -189,10 +198,9 @@ class SwarmSim:
     # -- conn plane --------------------------------------------------------
 
     def _try_dial(self, a: _Peer, bid: PeerID) -> None:
-        # Explicit sim-time blacklist check: ConnState.can_dial consults
-        # the blacklist with wall time internally, which is meaningless
-        # under the sim clock.
-        if a.cs.blacklist.blocked(bid, self.h, now=self.now):
+        # Sim-time blacklist check against the peer's standalone
+        # Blacklist (see _Peer.bl for why it is not cs.blacklist).
+        if a.bl.blocked(bid, self.h, now=self.now):
             return
         if not a.cs.add_pending(bid, self.h):
             return
@@ -207,7 +215,7 @@ class SwarmSim:
             self.busy_rejects += 1
             self._at(self.now + self.cfg.latency_s, lambda: (
                 a.cs.remove_pending(bid, self.h),
-                a.cs.blacklist.add(bid, self.h, now=self.now, soft=True),
+                a.bl.add(bid, self.h, now=self.now, soft=True),
             ))
             return
         b.cs.promote(a.pid, self.h)  # inbound: promote directly

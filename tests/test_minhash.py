@@ -136,3 +136,74 @@ def test_lsh_remove_and_compaction():
     assert index.query_brute(q, k=3)[0][0] == "keep"
     # Removed keys never appear.
     assert all(k == "keep" for k, _ in index.query(q, k=10))
+
+
+def test_compact_index_matches_dict_index():
+    """CompactLSHIndex is a storage change, not a semantics change: same
+    candidates and same query results as LSHIndex on identical input,
+    before and after flush()."""
+    from kraken_tpu.ops.minhash import CompactLSHIndex
+
+    rng = np.random.default_rng(11)
+    mh = MinHasher(num_hashes=64)
+    a, b = LSHIndex(mh, num_bands=16), CompactLSHIndex(mh, num_bands=16)
+    sets = [make_set(rng, 64) for _ in range(800)]
+    sk = mh.sketch_batch(sets)
+    for i in range(800):
+        a.add(i, sk[i])
+    b.add_batch(list(range(400)), sk[:400])
+    for i in range(400, 800):
+        b.add(i, sk[i])
+    for qi in rng.integers(0, 800, size=100):
+        assert a.candidates(sk[qi]) == b.candidates(sk[qi])
+        assert a.query(sk[qi], k=5) == b.query(sk[qi], k=5)
+    b.flush()
+    for qi in rng.integers(0, 800, size=100):
+        assert a.candidates(sk[qi]) == b.candidates(sk[qi])
+
+
+def test_compact_index_remove_and_readd():
+    from kraken_tpu.ops.minhash import CompactLSHIndex
+
+    rng = np.random.default_rng(12)
+    mh = MinHasher(num_hashes=64)
+    idx = CompactLSHIndex(mh, num_bands=16)
+    sets = [make_set(rng, 64) for _ in range(300)]
+    sk = mh.sketch_batch(sets)
+    idx.add_batch(list(range(300)), sk)
+    assert idx.remove(7)
+    assert not idx.remove(7)
+    assert 7 not in {k for k, _ in idx.query(sk[7], k=5)}
+    assert 7 not in {k for k, _ in idx.query_brute(sk[7], k=5)}
+    idx.add(7, sk[7])
+    assert dict(idx.query(sk[7], k=3))[7] == 1.0
+    # Churn compacts: storage stays O(live).
+    for i in range(300):
+        idx.remove(i) if i != 7 else None
+        idx.add(1000 + i, sk[i])
+    assert len(idx) in (300, 301)
+    assert idx._n - idx._dead == len(idx)
+
+
+def test_compact_index_budget_evicts_oldest():
+    from kraken_tpu.ops.minhash import BudgetExceeded, CompactLSHIndex
+
+    rng = np.random.default_rng(13)
+    mh = MinHasher(num_hashes=64)
+    sk = mh.sketch_batch([make_set(rng, 64) for _ in range(2000)])
+    budget = 3_000_000
+    idx = CompactLSHIndex(mh, num_bands=16, budget_bytes=budget)
+    for rep in range(4):
+        for s in range(0, 2000, 500):
+            idx.add_batch(
+                [rep * 2000 + s + j for j in range(500)], sk[s : s + 500]
+            )
+        assert idx.footprint_bytes() <= budget
+    assert idx.evictions > 0 and len(idx) > 0
+    # Oldest keys evicted first; the newest batch survives.
+    assert max(idx._keys) == 4 * 2000 - 1 + 500 - 500
+    # A budget below the empty-index floor is a loud error, not a
+    # silently empty index.
+    tiny = CompactLSHIndex(mh, num_bands=16, budget_bytes=1000)
+    with pytest.raises(BudgetExceeded):
+        tiny.add(0, sk[0])

@@ -71,3 +71,30 @@ def test_scalar_and_simd_paths_agree():
     finally:
         native._LIB = lib
     assert np.array_equal(c_out, py_out)
+
+
+def test_native_cdc_chunker_matches_reference():
+    """The C chunker and the NumPy fallback both produce chunk_reference's
+    exact cuts -- boundaries are a persistent on-disk contract."""
+    import numpy as np
+
+    import kraken_tpu.native as nat
+    from kraken_tpu.ops.cdc import CDCParams, chunk_host, chunk_reference
+
+    p = CDCParams(min_size=64, avg_size=256, max_size=1024)
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 63, 64, 65, 255, 4096, 20000):
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        ref = chunk_reference(data, p) if n else []
+        assert chunk_host(data, p).tolist() == ref, n
+        lib, nat._LIB = nat._LIB, None  # force the NumPy fallback
+        try:
+            assert chunk_host(data, p).tolist() == ref, ("numpy", n)
+        finally:
+            nat._LIB = lib
+    # Low-entropy data (max_size forcing) and default params.
+    data = b"\x00" * 300_000
+    pd = CDCParams()
+    ref = chunk_reference(data, pd)
+    assert chunk_host(data, pd).tolist() == ref
+    assert ref[0] == pd.max_size  # constant data never hits a mask
