@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Optional
 
 from aiohttp import web
@@ -523,6 +524,7 @@ class ProxyNode:
         host: str = "127.0.0.1",
         port: int = 0,
         ssl_context=None,
+        spool_root: str | None = None,
     ):
         from kraken_tpu.buildindex.server import TagClient
         from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -532,8 +534,16 @@ class ProxyNode:
         self.port = port
         self.origin_cluster = origin_cluster
         self._tag_client = TagClient(build_index_addr)
+        # A configured spool_root makes upload sessions durable across
+        # proxy restarts (a crashed mid-push resumes); without it both
+        # spools fall back to fresh temp dirs.
+        upload_dir = os.path.join(spool_root, "uploads") if spool_root else None
+        pass_dir = os.path.join(spool_root, "passthrough") if spool_root else None
         self.server = RegistryServer(
-            ProxyTransferer(origin_cluster, self._tag_client), read_only=False
+            ProxyTransferer(origin_cluster, self._tag_client,
+                            spool_dir=pass_dir),
+            read_only=False,
+            upload_dir=upload_dir,
         )
         self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None

@@ -130,6 +130,9 @@ def main(argv: list[str] | None = None) -> None:
                          help="comma-separated origin http addrs")
     p_proxy.add_argument("--build-index", default=None,
                          help="build-index addr for tag puts")
+    p_proxy.add_argument("--spool", default=None,
+                         help="durable spool root: upload sessions survive"
+                              " proxy restarts (docker push resumes)")
 
     args = parser.parse_args(argv)
     cfg = load_config(args.config) if args.config else {}
@@ -322,6 +325,7 @@ def main(argv: list[str] | None = None) -> None:
             host=host,
             port=port,
             ssl_context=ssl_context,
+            spool_root=pick(args.spool, "spool", None),
         )
         asyncio.run(_run_until_signal(node, {"component": "proxy"}))
 

@@ -68,14 +68,26 @@ class RegistryServer:
         self.transferer = transferer
         self.read_only = read_only
         # Push uploads spill to disk (an interrupted ``docker push`` must
-        # not pin blob-sized buffers in RAM for the process lifetime);
-        # sessions idle past the TTL are purged lazily on the next upload.
+        # not pin blob-sized buffers in RAM for the process lifetime).
+        # With a configured ``upload_dir`` the sessions are DURABLE: a
+        # proxy that crashes mid-push recovers them at startup (below)
+        # and the client resumes against the same Location. Sessions idle
+        # past the TTL are purged by the app's timer (make_app) and
+        # lazily on the next POST.
         self._upload_dir = upload_dir or tempfile.mkdtemp(
             prefix="kt-registry-upload-"
         )
         os.makedirs(self._upload_dir, exist_ok=True)
         self._upload_ttl = upload_ttl_seconds
         self._uploads: dict[str, float] = {}  # uid -> last-touched
+        # Recover sessions persisted by a previous process; last-touched
+        # resumes from the spool's mtime, so an abandoned session still
+        # ages out on schedule rather than restarting its TTL.
+        for name in os.listdir(self._upload_dir):
+            path = os.path.join(self._upload_dir, name)
+            if os.path.isfile(path):
+                with contextlib.suppress(OSError):
+                    self._uploads[name] = os.path.getmtime(path)
 
     def _upload_path(self, uid: str) -> str:
         return os.path.join(self._upload_dir, uid)
@@ -93,15 +105,35 @@ class RegistryServer:
                 os.unlink(self._upload_path(uid))
         return len(stale)
 
+    async def _purge_ctx(self, app: web.Application):
+        """Timer-driven TTL purge: an idle proxy must reclaim abandoned
+        spools too, not only on the next POST (a crashed `docker push`
+        against a quiet registry would otherwise pin disk until the next
+        push arrives)."""
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(max(1.0, self._upload_ttl / 4))
+                self._purge_stale_uploads()
+
+        task = asyncio.create_task(loop())
+        yield
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+
     def make_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1 << 30, middlewares=[api_version_middleware]
         )
+        if not self.read_only:
+            app.cleanup_ctx.append(self._purge_ctx)
         r = app.router
         r.add_get("/v2/", self._api_check)
         r.add_get("/v2/_catalog", self._catalog)
         r.add_route("*", "/v2/{repo:.+}/manifests/{ref}", self._manifests)
         r.add_post("/v2/{repo:.+}/blobs/uploads/", self._start_upload)
+        r.add_get("/v2/{repo:.+}/blobs/uploads/{uid}", self._upload_status)
         r.add_patch("/v2/{repo:.+}/blobs/uploads/{uid}", self._patch_upload)
         r.add_put("/v2/{repo:.+}/blobs/uploads/{uid}", self._finish_upload)
         r.add_route("*", "/v2/{repo:.+}/blobs/{digest}", self._blobs)
@@ -351,6 +383,23 @@ class RegistryServer:
             )
         self._uploads[uid] = time.time()
         return os.path.getsize(path)
+
+    async def _upload_status(self, req: web.Request) -> web.Response:
+        """Spec upload-status probe: docker GETs the upload URL to learn
+        the committed offset before resuming an interrupted push."""
+        self._check_writable()
+        check_repo_name(req.match_info["repo"])
+        uid = req.match_info["uid"]
+        if uid not in self._uploads:
+            raise v2_error("BLOB_UPLOAD_UNKNOWN", detail={"uuid": uid})
+        try:
+            size = os.path.getsize(self._upload_path(uid))
+        except OSError:
+            raise v2_error("BLOB_UPLOAD_UNKNOWN", detail={"uuid": uid})
+        return web.Response(status=204, headers={
+            "Docker-Upload-UUID": uid,
+            "Range": f"0-{max(size - 1, 0)}",
+        })
 
     async def _patch_upload(self, req: web.Request) -> web.Response:
         self._check_writable()

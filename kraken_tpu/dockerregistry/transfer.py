@@ -104,6 +104,7 @@ class ProxyTransferer:
         self.tags = tags
         # Pass-through blob reads spool here (deleted after each response).
         self._spool = spool_dir or tempfile.mkdtemp(prefix="kt-proxy-spool-")
+        os.makedirs(self._spool, exist_ok=True)
 
     async def download(self, namespace: str, d: Digest) -> bytes:
         return await self.origins.download(namespace, d)

@@ -218,3 +218,87 @@ def test_sighup_reloads_scheduler_config(tmp_path):
             raise AssertionError(
                 "reload log line never appeared: " + err_path.read_text()[-2000:]
             )
+
+
+def test_proxy_crash_resumes_upload_session(tmp_path):
+    """Durable proxy spools (--spool): SIGKILL the proxy mid-push,
+    restart it on the same port + spool root, and the client resumes the
+    SAME upload session (status probe shows the committed offset) and
+    finishes the blob. Unknown sessions still answer the spec error."""
+    with herd() as procs:
+        origin, oinfo = spawn(
+            ["origin", "--store", str(tmp_path / "origin")]
+        )
+        procs.append(origin)
+        bi, binfo = spawn(
+            ["build-index", "--store", str(tmp_path / "bi"),
+             "--origins", oinfo["addr"]]
+        )
+        procs.append(bi)
+        spool = str(tmp_path / "spool")
+        proxy, pinfo = spawn(
+            ["proxy", "--origins", oinfo["addr"],
+             "--build-index", binfo["addr"], "--spool", spool]
+        )
+        procs.append(proxy)
+        pport = pinfo["addr"].split(":")[1]
+
+        async def drive():
+            import aiohttp
+
+            from kraken_tpu.core.digest import Digest
+
+            blob = os.urandom(600_000)
+            half = len(blob) // 2
+            d = Digest.from_bytes(blob)
+            base = f"http://{pinfo['addr']}"
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    f"{base}/v2/library/app/blobs/uploads/"
+                ) as r:
+                    assert r.status == 202
+                    loc = r.headers["Location"]
+                async with http.patch(
+                    f"{base}{loc}", data=blob[:half]
+                ) as r:
+                    assert r.status == 202
+
+                # Crash the proxy mid-push (no graceful shutdown).
+                proxy.kill()
+                proxy.wait(timeout=10)
+                procs.remove(proxy)
+                proxy2, pinfo2 = spawn(
+                    ["proxy", "--origins", oinfo["addr"],
+                     "--build-index", binfo["addr"], "--spool", spool,
+                     "--port", pport]
+                )
+                procs.append(proxy2)
+
+                # Status probe: the recovered session reports the
+                # committed offset.
+                async with http.get(f"{base}{loc}") as r:
+                    assert r.status == 204, await r.text()
+                    assert r.headers["Range"] == f"0-{half - 1}"
+                # Resume and finish.
+                async with http.patch(
+                    f"{base}{loc}", data=blob[half:]
+                ) as r:
+                    assert r.status == 202
+                    assert r.headers["Range"] == f"0-{len(blob) - 1}"
+                async with http.put(f"{base}{loc}?digest={d}") as r:
+                    assert r.status == 201, await r.text()
+                # The blob made it to the origin, byte-identical.
+                async with http.get(
+                    f"{base}/v2/library/app/blobs/{d}"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == blob
+                # A session that never existed answers the spec code.
+                async with http.patch(
+                    f"{base}/v2/library/app/blobs/uploads/nope", data=b"x"
+                ) as r:
+                    assert r.status == 404
+                    body = json.loads(await r.text())
+                    assert body["errors"][0]["code"] == "BLOB_UPLOAD_UNKNOWN"
+
+        asyncio.run(drive())

@@ -176,6 +176,11 @@ def test_push_flow_error_codes():
                 "BLOB_UPLOAD_UNKNOWN", 404,
             )
 
+            await rig.expect(
+                "GET", "/v2/repo/blobs/uploads/deadbeef",
+                "BLOB_UPLOAD_UNKNOWN", 404,
+            )
+
             async def start_upload():
                 async with rig.http.post(
                     rig.base + "/v2/repo/blobs/uploads/"
@@ -183,6 +188,14 @@ def test_push_flow_error_codes():
                     assert r.status == 202
                     assert r.headers["Docker-Upload-UUID"]
                     return r.headers["Location"]
+
+            # Status probe on a live session: 204 + committed Range.
+            loc = await start_upload()
+            async with rig.http.patch(rig.base + loc, data=b"12345") as r:
+                assert r.status == 202
+            async with rig.http.get(rig.base + loc) as r:
+                assert r.status == 204
+                assert r.headers["Range"] == "0-4"
 
             # Finalize without a digest parameter.
             loc = await start_upload()
