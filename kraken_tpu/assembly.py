@@ -34,7 +34,7 @@ from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
 from kraken_tpu.utils.httputil import HTTPClient, base_url
-from kraken_tpu.utils.metrics import instrument_app
+from kraken_tpu.utils.metrics import FailureMeter, instrument_app
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -48,6 +48,17 @@ from kraken_tpu.tracker.peerstore import InMemoryPeerStore, RedisPeerStore
 from kraken_tpu.tracker.server import TrackerServer
 
 _log = logging.getLogger("kraken.assembly")
+
+_ring_refresh_failures = FailureMeter(
+    "ring_refresh_failures_total",
+    "Origin-ring membership refreshes that raised (retried next interval)",
+    _log,
+)
+_health_probe_failures = FailureMeter(
+    "health_probe_failures_total",
+    "Health-probe loop iterations that raised (retried next interval)",
+    _log,
+)
 
 
 async def _cleanup_loop(manager: CleanupManager) -> None:
@@ -78,8 +89,10 @@ async def _ring_refresh_loop(get_cluster, interval: float) -> None:
         try:
             if cluster is not None:
                 await cluster.ring.refresh_async()
-        except Exception:
-            pass
+        except Exception as e:
+            # Flapping DNS / dead origins must show on /metrics, not
+            # vanish into the retry loop.
+            _ring_refresh_failures.record("ring refresh", e)
 
 
 async def _serve(app: web.Application, host: str, port: int,
@@ -418,8 +431,8 @@ class OriginNode:
                 ]
                 await self.monitor.check_all(peers)
                 await self.ring.refresh_async()
-            except Exception:
-                pass
+            except Exception as e:
+                _health_probe_failures.record("health probe sweep", e)
 
     def _on_ring_change(self, hosts: list[str]) -> None:
         try:

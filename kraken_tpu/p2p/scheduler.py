@@ -42,8 +42,15 @@ from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.dedup import RequestCoalescer
+from kraken_tpu.utils.metrics import FailureMeter
 
 _log = logging.getLogger("kraken.p2p")
+
+_announce_failures = FailureMeter(
+    "announce_failures_total",
+    "Tracker announce attempts that raised (retried next interval)",
+    _log,
+)
 
 
 class _AtCapacity(Exception):
@@ -367,8 +374,10 @@ class Scheduler:
                 self._maybe_dial(ctl, peer)
         except asyncio.CancelledError:
             raise
-        except Exception:
-            pass  # tracker hiccup: retry next interval
+        except Exception as e:
+            # Tracker hiccup: retry next interval -- but METERED, or a
+            # dead tracker is invisible on /metrics.
+            _announce_failures.record(f"announce {h.hex[:12]}", e)
         if h in self._controls:
             self._announce_queue.schedule(
                 h, asyncio.get_running_loop().time() + interval

@@ -164,6 +164,45 @@ class Registry:
 REGISTRY = Registry()
 
 
+class FailureMeter:
+    """Counter + throttled WARN for control loops that must swallow
+    failures to keep running (announce, ring refresh, health probes).
+
+    A bare ``except Exception: pass`` makes a dead tracker or flapping
+    DNS invisible; an unconditional log makes a 1 s retry loop a flood.
+    This meters every failure on ``/metrics`` and logs ONE warning per
+    ``throttle_seconds`` with a count of what was suppressed -- the
+    reference meters every dependency via tally + zap (upstream
+    behavior, unverified; SURVEY.md SS5)."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        logger,
+        throttle_seconds: float = 30.0,
+    ):
+        self.counter = REGISTRY.counter(name, help_)
+        self._log = logger
+        self._throttle = throttle_seconds
+        self._last_warn = -float("inf")
+        self._suppressed = 0
+
+    def record(self, what: str, exc: BaseException) -> None:
+        self.counter.inc()
+        now = time.monotonic()
+        if now - self._last_warn >= self._throttle:
+            extra = (
+                f" ({self._suppressed} similar suppressed)"
+                if self._suppressed else ""
+            )
+            self._log.warning("%s failed: %r%s", what, exc, extra)
+            self._last_warn = now
+            self._suppressed = 0
+        else:
+            self._suppressed += 1
+
+
 def instrument_app(app, component: str, registry: Registry = REGISTRY):
     """Attach per-endpoint metrics middleware + ``GET /metrics`` to an
     aiohttp app. Endpoint label is the ROUTE TEMPLATE (not the raw path:

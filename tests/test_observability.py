@@ -13,6 +13,7 @@ each process exposes only its own).
 """
 
 import asyncio
+import os
 import json
 import logging
 
@@ -154,5 +155,66 @@ def test_network_events_cover_piece_flow(tmp_path):
                     if e["name"] == "receive_piece"]
         assert len(received) == mi.num_pieces
         assert all(e["info_hash"] == mi.info_hash.hex for e in received)
+
+    asyncio.run(main())
+
+
+def test_failure_meter_counts_and_throttles(caplog):
+    """Every failure increments the counter; the WARN is throttled to one
+    per window with a suppressed-count on the next emit."""
+    import logging
+
+    from kraken_tpu.utils.metrics import FailureMeter
+
+    log = logging.getLogger("kraken.test.meter")
+    m = FailureMeter("test_meter_failures_total", "t", log,
+                     throttle_seconds=3600)
+    with caplog.at_level(logging.WARNING, logger="kraken.test.meter"):
+        for i in range(10):
+            m.record("probe", RuntimeError(f"e{i}"))
+    assert m.counter.value() == 10
+    warns = [r for r in caplog.records if "probe failed" in r.getMessage()]
+    assert len(warns) == 1  # 9 suppressed inside the window
+    m._last_warn = -float("inf")  # window elapses
+    with caplog.at_level(logging.WARNING, logger="kraken.test.meter"):
+        m.record("probe", RuntimeError("e10"))
+    assert any(
+        "9 similar suppressed" in r.getMessage() for r in caplog.records
+    )
+
+
+def test_announce_failures_metered_when_tracker_dies(tmp_path):
+    """A dead tracker is visible: announce_failures_total moves while the
+    seeding agent's announce loop retries into the void."""
+
+    async def main():
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from test_herd import build_herd, teardown
+
+        from kraken_tpu.core.digest import Digest
+        from kraken_tpu.origin.client import BlobClient
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        counter = REGISTRY.counter("announce_failures_total")
+        tracker, origins, agents, cluster = await build_herd(
+            tmp_path, n_agents=0
+        )
+        try:
+            blob = os.urandom(50_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origins[0].addr)
+            await oc.upload("ns", d, blob)  # origin seeds + announces
+            await oc.close()
+            before = counter.value()
+            await tracker.stop()  # the void
+            for _ in range(100):
+                if counter.value() > before:
+                    break
+                await asyncio.sleep(0.05)
+            assert counter.value() > before, "announce failures not metered"
+        finally:
+            await teardown(tracker, origins, agents, cluster)
 
     asyncio.run(main())
