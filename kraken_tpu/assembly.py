@@ -177,6 +177,8 @@ class OriginNode:
         piece_lengths: PieceLengthConfig | None = None,
         cleanup: CleanupConfig | None = None,
         dedup: bool = True,
+        dedup_index: str = "dict",  # "compact" for million-blob corpora
+        dedup_budget_bytes: int | None = None,
         hash_window_bytes: int = 256 * 1024 * 1024,
         health_interval_seconds: float = 5.0,
         health_fail_threshold: int = 3,
@@ -197,7 +199,12 @@ class OriginNode:
             window_bytes=hash_window_bytes,
         )
         self.dedup = (
-            DedupIndex(self.store, hasher=get_hasher(hasher)) if dedup else None
+            DedupIndex(
+                self.store, hasher=get_hasher(hasher),
+                index_kind=dedup_index,
+                index_budget_bytes=dedup_budget_bytes,
+            )
+            if dedup else None
         )
         self.backends = backends
         self.refresher = (

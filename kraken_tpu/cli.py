@@ -258,6 +258,8 @@ def main(argv: list[str] | None = None) -> None:
             ring=ring,
             self_addr=self_addr,
             cleanup=cleanup,
+            dedup_index=cfg.get("dedup_index", "dict"),
+            dedup_budget_bytes=cfg.get("dedup_budget_bytes"),
             scheduler_config_doc=cfg.get("scheduler"),
             ssl_context=ssl_context,
         )

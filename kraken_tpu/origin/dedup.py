@@ -28,6 +28,7 @@ from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.hasher import PieceHasher, get_hasher
 from kraken_tpu.ops.cdc import CDCParams, chunk_spans
 from kraken_tpu.ops.minhash import (
+    CompactLSHIndex,
     LSHIndex,
     MinHasher,
     fingerprints_from_digests,
@@ -100,12 +101,25 @@ class DedupIndex:
         num_hashes: int = 128,
         num_bands: int = 32,
         max_blobs: int = 200_000,
+        index_kind: str = "dict",
+        index_budget_bytes: int | None = None,
     ):
         self.store = store
         self.hasher = hasher or get_hasher("cpu")
         self.params = params or CDCParams()
         self.minhasher = MinHasher(num_hashes=num_hashes)
-        self._index = LSHIndex(self.minhasher, num_bands=num_bands)
+        # "dict" (LSHIndex) for typical origins; "compact" (array-backed,
+        # ~1 KB/blob, optional byte budget) for million-blob corpora --
+        # same banding math and query results, parity-tested.
+        if index_kind == "compact":
+            self._index = CompactLSHIndex(
+                self.minhasher, num_bands=num_bands,
+                budget_bytes=index_budget_bytes,
+            )
+        elif index_kind == "dict":
+            self._index = LSHIndex(self.minhasher, num_bands=num_bands)
+        else:
+            raise ValueError(f"unknown dedup index kind: {index_kind!r}")
         self._lock = threading.Lock()
         # Insertion-ordered (dict keys): beyond max_blobs the OLDEST
         # indexed blob leaves the in-memory index (its sidecar stays on

@@ -34,11 +34,12 @@ def _near_dup_blobs(rng) -> tuple[bytes, bytes, bytes]:
     return a, b, c
 
 
-def test_similar_finds_shifted_duplicate(tmp_path):
+@pytest.mark.parametrize("index_kind", ["dict", "compact"])
+def test_similar_finds_shifted_duplicate(tmp_path, index_kind):
     rng = np.random.default_rng(0)
     a, b, c = _near_dup_blobs(rng)
     store = CAStore(str(tmp_path))
-    index = DedupIndex(store, params=PARAMS)
+    index = DedupIndex(store, params=PARAMS, index_kind=index_kind)
     da, db, dc = (_store_blob(store, x) for x in (a, b, c))
     for d in (da, db, dc):
         index.add_blob_sync(d)
