@@ -13,6 +13,7 @@ table -- upstream paths, unverified; SURVEY.md SS2.4, SS7 hard part #5.
 from __future__ import annotations
 
 import json
+import logging
 import re
 
 from aiohttp import web
@@ -53,6 +54,7 @@ _STATUS_EXC: dict[int, type[web.HTTPException]] = {
     416: web.HTTPRequestRangeNotSatisfiable,
     429: web.HTTPTooManyRequests,
     500: web.HTTPInternalServerError,
+    502: web.HTTPBadGateway,
 }
 
 # The spec's repository-name grammar (path components joined by "/").
@@ -97,6 +99,37 @@ def v2_error(
     )
 
 
+def is_definitive_not_found(e: BaseException) -> bool:
+    """True iff a dependency failure proves the resource does not exist.
+
+    Only a replica's explicit 404 (or a local lookup miss) qualifies; a
+    connection error, timeout, or 5xx is a fault of the dependency, not a
+    statement about the blob. Docker clients treat 404 codes as FINAL
+    (mount probes fall back to full re-upload, pulls abort), so guessing
+    not-found on a transient failure breaks them in ways a retryable 5xx
+    does not.
+    """
+    from kraken_tpu.utils import httputil
+
+    if isinstance(e, (KeyError, LookupError, FileNotFoundError)):
+        return True
+    return isinstance(e, httputil.HTTPError) and e.status == 404
+
+
+def map_dependency_error(
+    e: BaseException, code: str, *, detail=None
+) -> web.HTTPException:
+    """Map a dependency failure to either the definitive ``code`` (404
+    family) or a retryable 502 UNKNOWN envelope. Callers ``raise`` the
+    result."""
+    if is_definitive_not_found(e):
+        return v2_error(code, detail=detail)
+    return v2_error(
+        "UNKNOWN", "upstream dependency unavailable",
+        status=502, detail=detail,
+    )
+
+
 def check_repo_name(repo: str) -> str:
     """NAME_INVALID for names outside the spec grammar (a client that sent
     one is confused; letting it through would mint un-pullable tags)."""
@@ -109,11 +142,25 @@ def check_repo_name(repo: str) -> str:
 async def api_version_middleware(req: web.Request, handler):
     """Stamp ``Docker-Distribution-API-Version: registry/2.0`` on every
     response, errors included -- clients use it to confirm they are
-    talking to a v2 registry before trusting any other header."""
+    talking to a v2 registry before trusting any other header. Anything
+    that escapes a handler un-enveloped (a bug, or a dependency error a
+    handler failed to map) is converted to the spec's UNKNOWN 500 here:
+    aiohttp's bare text/plain 500 carries no code for a client to branch
+    on and would violate the envelope contract this module declares."""
     try:
         resp = await handler(req)
     except web.HTTPException as e:
         e.headers[API_VERSION_HEADER] = API_VERSION
         raise
+    except Exception:
+        logging.getLogger("kraken_tpu.registry").exception(
+            "unhandled error on %s %s", req.method, req.path
+        )
+        return web.Response(
+            status=500,
+            text=error_body("UNKNOWN"),
+            content_type="application/json",
+            headers={API_VERSION_HEADER: API_VERSION},
+        )
     resp.headers[API_VERSION_HEADER] = API_VERSION
     return resp

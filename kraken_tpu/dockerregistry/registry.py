@@ -43,6 +43,7 @@ from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.dockerregistry.errors import (
     api_version_middleware,
     check_repo_name,
+    map_dependency_error,
     v2_error,
 )
 from kraken_tpu.dockerregistry.transfer import ImageTransferer
@@ -161,16 +162,22 @@ class RegistryServer:
             except DigestError:
                 raise v2_error("DIGEST_INVALID", detail={"reference": ref})
         else:
-            d = await self.transferer.get_tag(f"{repo}:{ref}")
+            try:
+                d = await self.transferer.get_tag(f"{repo}:{ref}")
+            except Exception as e:
+                raise map_dependency_error(
+                    e, "MANIFEST_UNKNOWN", detail={"name": repo, "tag": ref}
+                )
             if d is None:
                 raise v2_error(
                     "MANIFEST_UNKNOWN", detail={"name": repo, "tag": ref}
                 )
         try:
             data = await self.transferer.download(repo, d)
-        except Exception:
-            raise v2_error(
-                "MANIFEST_UNKNOWN", detail={"name": repo, "reference": str(d)}
+        except Exception as e:
+            raise map_dependency_error(
+                e, "MANIFEST_UNKNOWN",
+                detail={"name": repo, "reference": str(d)},
             )
         # The stored bytes are only digest-checked, never schema-checked
         # (a blob can be fetched through the manifest route), so nothing
@@ -234,16 +241,14 @@ class RegistryServer:
             )
         if req.method not in ("GET", "HEAD"):
             raise v2_error("UNSUPPORTED", allowed=("GET", "HEAD"))
-        unknown = v2_error(
-            "BLOB_UNKNOWN", detail={"name": repo, "digest": str(d)}
-        )
+        blob_detail = {"name": repo, "digest": str(d)}
         if req.method == "HEAD":
             try:
                 size = await self.transferer.stat(repo, d)
-            except Exception:
-                raise unknown
+            except Exception as e:
+                raise map_dependency_error(e, "BLOB_UNKNOWN", detail=blob_detail)
             if size is None:
-                raise unknown
+                raise v2_error("BLOB_UNKNOWN", detail=blob_detail)
             return web.Response(headers={
                 "Docker-Content-Digest": str(d),
                 "Content-Length": str(size),
@@ -253,8 +258,8 @@ class RegistryServer:
         # spooled temp) -- O(chunk) request memory for any layer size.
         try:
             path, is_temp = await self.transferer.download_path(repo, d)
-        except Exception:
-            raise unknown
+        except Exception as e:
+            raise map_dependency_error(e, "BLOB_UNKNOWN", detail=blob_detail)
         headers = {
             "Docker-Content-Digest": str(d),
             "Content-Type": "application/octet-stream",

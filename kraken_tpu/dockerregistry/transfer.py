@@ -19,6 +19,7 @@ from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.p2p.scheduler import Scheduler
 from kraken_tpu.store import CAStore
+from kraken_tpu.utils import httputil
 
 
 class ImageTransferer(Protocol):
@@ -77,10 +78,15 @@ class ReadOnlyTransferer:
         raise PermissionError("agent registry is read-only; push via the proxy")
 
     async def get_tag(self, tag: str) -> Optional[Digest]:
+        # None means PROVEN absent (build-index said 404). A transient
+        # build-index failure propagates so the registry surface can
+        # answer a retryable 5xx instead of a definitive MANIFEST_UNKNOWN.
         try:
             return await self.tags.get(tag)
-        except Exception:
-            return None
+        except Exception as e:
+            if httputil.is_not_found(e):
+                return None
+            raise
 
     async def put_tag(self, tag: str, d: Digest) -> None:
         raise PermissionError("agent registry is read-only; push via the proxy")
@@ -136,10 +142,15 @@ class ProxyTransferer:
         await self.origins.upload_from_file(namespace, d, path)
 
     async def get_tag(self, tag: str) -> Optional[Digest]:
+        # None means PROVEN absent (build-index said 404). A transient
+        # build-index failure propagates so the registry surface can
+        # answer a retryable 5xx instead of a definitive MANIFEST_UNKNOWN.
         try:
             return await self.tags.get(tag)
-        except Exception:
-            return None
+        except Exception as e:
+            if httputil.is_not_found(e):
+                return None
+            raise
 
     async def put_tag(self, tag: str, d: Digest) -> None:
         await self.tags.put(tag, d, replicate=True)

@@ -345,3 +345,104 @@ def test_name_and_pagination_codes():
                 assert await r.json() == {"name": "repo", "tags": ["v1"]}
 
     asyncio.run(main())
+
+
+def test_transient_dependency_failures_are_retryable_5xx():
+    """An unreachable origin/build-index must NOT surface as *_UNKNOWN:
+    docker treats the 404 codes as final (pull aborts, mount probe falls
+    back to full re-upload), while any 5xx is retried. Only a dependency's
+    explicit 404 proves absence."""
+    from kraken_tpu.utils.httputil import HTTPError
+
+    async def main():
+        async with Rig() as rig:
+            transient = HTTPError("GET", "http://origin/blob", 503)
+
+            async def down(*a, **kw):
+                raise transient
+
+            # Blob pull paths: HEAD stat + GET download_path. (HEAD has
+            # no body to parse -- status + version header only.)
+            rig.transferer.stat = down
+            rig.transferer.download_path = down
+            async with rig.http.head(
+                rig.base + f"/v2/repo/blobs/{GOOD}"
+            ) as r:
+                assert r.status == 502
+                assert (
+                    r.headers["Docker-Distribution-API-Version"]
+                    == "registry/2.0"
+                )
+            await rig.expect(
+                "GET", f"/v2/repo/blobs/{GOOD}", "UNKNOWN", 502
+            )
+            # Manifest pull: tag resolution down, then manifest body down.
+            rig.transferer.get_tag = down
+            await rig.expect(
+                "GET", "/v2/repo/manifests/v1", "UNKNOWN", 502
+            )
+            del rig.transferer.get_tag
+            rig.transferer.tags["repo:v1"] = Digest.from_bytes(b"m")
+            rig.transferer.download = down
+            await rig.expect(
+                "GET", "/v2/repo/manifests/v1", "UNKNOWN", 502
+            )
+            # A replica's explicit 404 stays the definitive code.
+            async def gone(*a, **kw):
+                raise HTTPError("GET", "http://origin/blob", 404)
+
+            rig.transferer.download_path = gone
+            await rig.expect(
+                "GET", f"/v2/repo/blobs/{GOOD}", "BLOB_UNKNOWN", 404
+            )
+
+    asyncio.run(main())
+
+
+def test_unhandled_exception_still_enveloped():
+    """A bug (or unmapped dependency error) escaping a handler must still
+    produce the UNKNOWN envelope + API-version header, not aiohttp's bare
+    text/plain 500 -- clients parse every error body."""
+
+    async def main():
+        async with Rig() as rig:
+            async def boom(*a, **kw):
+                raise RuntimeError("wire tripped")
+
+            # transferer.upload is called with no handler-level mapping:
+            # the middleware catch-all must envelope it.
+            rig.transferer.upload = boom
+            await rig.expect(
+                "PUT", "/v2/repo/manifests/v1", "UNKNOWN", 500,
+                data=json.dumps({"mediaType": "x"}).encode(),
+            )
+
+    asyncio.run(main())
+
+
+def test_transferer_get_tag_classifies_dependency_errors():
+    """The REAL transferer classes (not the fake) must turn a build-index
+    404 into None (proven absent) and let transient failures propagate --
+    this is the seam the registry's 404-vs-502 mapping rests on."""
+    from kraken_tpu.dockerregistry.transfer import (
+        ProxyTransferer, ReadOnlyTransferer,
+    )
+    from kraken_tpu.utils.httputil import HTTPError
+
+    class Tags:
+        def __init__(self, exc):
+            self.exc = exc
+
+        async def get(self, tag):
+            raise self.exc
+
+    async def main():
+        for cls in (ReadOnlyTransferer, ProxyTransferer):
+            t = cls.__new__(cls)  # seam test: only .tags is touched
+            t.tags = Tags(HTTPError("GET", "http://bi/tags/x", 404))
+            assert await t.get_tag("repo:v1") is None
+            t.tags = Tags(HTTPError("GET", "http://bi/tags/x", 503))
+            with pytest.raises(HTTPError):
+                await t.get_tag("repo:v1")
+
+    asyncio.run(main())
