@@ -91,10 +91,13 @@ def _rounds64(state, wget):
     for i in range(64):
         wi = w[i % 16]
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
+        # ch/maj in their 3-op/4-op forms (vs the textbook 4/5).
+        # Measured neutral on v5e -- Mosaic strength-reduces the textbook
+        # forms -- kept because fewer ops can't hurt other backends.
+        ch = g ^ (e & (f ^ g))
         t1 = h + s1 + ch + np.uint32(_K[i]) + wi
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
+        maj = (a & (b ^ c)) ^ (b & c)
         a, b, c, d, e, f, g, h = t1 + s0 + maj, a, b, c, d + t1, e, f, g
         if i < 48:
             w15 = w[(i + 1) % 16]
