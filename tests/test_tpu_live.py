@@ -1,0 +1,124 @@
+"""Live-TPU herd test: the PRODUCTION origin wiring on the real chip.
+
+Gated behind ``KT_TPU_E2E=1`` because the default suite pins the whole
+pytest process to CPU (tests/conftest.py) and the real chip admits one
+client at a time. Run manually / from bench rigs:
+
+    KT_TPU_E2E=1 python -m pytest tests/test_tpu_live.py -q
+
+What it proves that the CPU suite cannot: ``--hasher tpu`` selected via
+the production CLI path compiles and runs the Pallas kernel inside a real
+origin process (axon PJRT plugin, first compile 20-40 s), its metainfo
+feeds a real P2P pull by a CPU agent, and the north-star gauges move on
+the origin's /metrics endpoint.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("KT_TPU_E2E") != "1",
+    reason="live-TPU herd test: set KT_TPU_E2E=1 (requires the real chip)",
+)
+
+
+def _spawn(args, *, tpu: bool):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if tpu:
+        # The real chip: the axon platform must win, and the CPU suite's
+        # virtual-device flags must not leak in.
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+    else:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kraken_tpu.cli", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    for line in proc.stdout:
+        if line.startswith("READY "):
+            return proc, json.loads(line[6:])
+    raise RuntimeError(f"component died: {args}")
+
+
+def test_tpu_hasher_serves_real_pull(tmp_path):
+    procs = []
+    try:
+        origin, oinfo = _spawn(
+            ["origin", "--store", str(tmp_path / "origin"), "--hasher", "tpu"],
+            tpu=True,
+        )
+        procs.append(origin)
+        tracker, tinfo = _spawn(
+            ["tracker", "--origins", oinfo["addr"]], tpu=False
+        )
+        procs.append(tracker)
+        origin.send_signal(signal.SIGTERM)
+        origin.wait(timeout=15)
+        procs.remove(origin)
+        origin, oinfo = _spawn(
+            ["origin", "--store", str(tmp_path / "origin"),
+             "--hasher", "tpu",
+             "--port", oinfo["addr"].split(":")[1],
+             "--tracker", tinfo["addr"]],
+            tpu=True,
+        )
+        procs.append(origin)
+        agent, ainfo = _spawn(
+            ["agent", "--store", str(tmp_path / "agent"),
+             "--tracker", tinfo["addr"]],
+            tpu=False,
+        )
+        procs.append(agent)
+
+        async def drive():
+            from kraken_tpu.core.digest import Digest
+            from kraken_tpu.origin.client import BlobClient
+            from kraken_tpu.utils.httputil import HTTPClient
+
+            # 48 MiB = 12 pieces at the table's 4 MiB: a real multi-piece
+            # batch through the TPU plane, small enough to stay minutes-
+            # scale through the first Mosaic compile.
+            blob = os.urandom(48 * 1024 * 1024)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(oinfo["addr"], HTTPClient(timeout_seconds=600))
+            await oc.upload("ns", d, blob)
+            http = HTTPClient(timeout_seconds=600)
+            got = await http.get(
+                f"http://{ainfo['addr']}/namespace/ns/blobs/{d.hex}"
+            )
+            assert got == blob, "pulled bytes differ"
+            metrics = (
+                await http.get(f"http://{oinfo['addr']}/metrics")
+            ).decode()
+            await oc.close()
+            await http.close()
+            tpu_lines = [
+                ln for ln in metrics.splitlines()
+                if ln.startswith("hasher_bytes_total") and 'hasher="tpu"' in ln
+            ]
+            assert tpu_lines, f"tpu hasher never ran:\n{metrics[:2000]}"
+            assert float(tpu_lines[0].rsplit(" ", 1)[1]) >= len(blob), tpu_lines
+
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
