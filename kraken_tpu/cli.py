@@ -146,11 +146,14 @@ def main(argv: list[str] | None = None) -> None:
     cleanup_cfg = cfg.get("cleanup")
     cleanup = CleanupConfig(**cleanup_cfg) if cleanup_cfg else None
 
-    # YAML: tls: {cert: path, key: path} -- terminate TLS on the HTTP
-    # listener (the reference fronts components with nginx; here the
-    # listener itself terminates). Outbound trust of a private CA comes
-    # from SSL_CERT_FILE (honored by aiohttp's default verification);
-    # TLS-fronted peers are addressed as https://host:port.
+    # YAML: tls: {cert: path, key: path[, client_ca: path]} -- terminate
+    # TLS on the HTTP listener (the reference fronts components with
+    # nginx; here the listener itself terminates). With ``client_ca`` the
+    # listener additionally REQUIRES a client certificate signed by that
+    # CA (mutual TLS -- the reference's nginx client-verification for
+    # intra-cluster traffic). Outbound trust of a private CA comes from
+    # SSL_CERT_FILE or ``tls_client.ca``; TLS-fronted peers are
+    # addressed as https://host:port.
     tls_cfg = cfg.get("tls")
     ssl_context = None
     if tls_cfg:
@@ -158,6 +161,25 @@ def main(argv: list[str] | None = None) -> None:
 
         ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ssl_context.load_cert_chain(tls_cfg["cert"], tls_cfg["key"])
+        if tls_cfg.get("client_ca"):
+            ssl_context.load_verify_locations(cafile=tls_cfg["client_ca"])
+            ssl_context.verify_mode = ssl.CERT_REQUIRED
+
+    # YAML: tls_client: {cert: path, key: path[, ca: path]} -- this
+    # process's OUTBOUND identity: every internal HTTP client presents
+    # this cert (what mTLS peers demand) and, with ``ca``, verifies
+    # peers against the cluster CA instead of the system store.
+    tlsc_cfg = cfg.get("tls_client")
+    if tlsc_cfg:
+        import ssl
+
+        from kraken_tpu.utils.httputil import set_default_client_ssl
+
+        client_ctx = ssl.create_default_context(
+            cafile=tlsc_cfg.get("ca") or None
+        )
+        client_ctx.load_cert_chain(tlsc_cfg["cert"], tlsc_cfg["key"])
+        set_default_client_ssl(client_ctx)
 
     host = pick(args.host, "host", "127.0.0.1")
     port = pick(args.port, "port", 0)

@@ -57,6 +57,20 @@ def is_accepted(err: Exception) -> bool:
     return is_status(err, 202)
 
 
+# Process-wide outbound TLS identity. A component is one process, so
+# "this process's client cert + cluster CA" is a process property, not a
+# per-call-site one: setting it here at boot (cli.py `tls_client:` YAML)
+# gives every internal client -- tracker, origin cluster, build-index,
+# writeback -- the same identity without threading an ssl arg through
+# every constructor. Explicit ``HTTPClient(ssl=...)`` still overrides.
+_default_client_ssl = None
+
+
+def set_default_client_ssl(ctx) -> None:
+    global _default_client_ssl
+    _default_client_ssl = ctx
+
+
 class HTTPClient:
     """Thin aiohttp wrapper: retries on connection errors / 5xx, raises
     :class:`HTTPError` on non-2xx. One instance per component process."""
@@ -72,15 +86,19 @@ class HTTPClient:
         self._retries = retries
         self._backoff = backoff or Backoff()
         # ssl.SSLContext for https:// peers signed by a private CA; None
-        # uses aiohttp's default verification against the system store.
+        # falls back to the process default (set_default_client_ssl) and
+        # then to aiohttp's verification against the system store.
         self._ssl = ssl
         self._session: aiohttp.ClientSession | None = None
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
+            use_ssl = (
+                self._ssl if self._ssl is not None else _default_client_ssl
+            )
             connector = (
-                aiohttp.TCPConnector(ssl=self._ssl)
-                if self._ssl is not None
+                aiohttp.TCPConnector(ssl=use_ssl)
+                if use_ssl is not None
                 else None
             )
             self._session = aiohttp.ClientSession(

@@ -531,3 +531,78 @@ def test_redis_peerstore_samples_large_swarms():
             await store.close()
 
     asyncio.run(main())
+
+
+def test_mutual_tls_requires_client_cert(tmp_path):
+    """tls.client_ca turns the listener into mutual TLS: a cert-less
+    client is refused at the handshake; a client presenting a cert signed
+    by the CA gets through -- including via the process-wide outbound
+    identity (tls_client YAML -> set_default_client_ssl) that every
+    internal HTTPClient inherits."""
+    from kraken_tpu.assembly import TrackerNode
+    from kraken_tpu.tracker.client import TrackerClient
+    from kraken_tpu.utils.httputil import HTTPClient, set_default_client_ssl
+
+    def gen_selfsigned(name):
+        cert, key = tmp_path / f"{name}.pem", tmp_path / f"{name}.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", f"/CN={name}",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True,
+        )
+        return cert, key
+
+    server_cert, server_key = gen_selfsigned("server")
+    client_cert, client_key = gen_selfsigned("client")
+
+    # Server: terminate TLS + REQUIRE a client cert chained to client_ca
+    # (the self-signed client cert is its own CA).
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(str(server_cert), str(server_key))
+    server_ctx.load_verify_locations(cafile=str(client_cert))
+    server_ctx.verify_mode = ssl.CERT_REQUIRED
+
+    async def main():
+        from kraken_tpu.core.metainfo import InfoHash
+
+        tracker = TrackerNode(ssl_context=server_ctx)
+        await tracker.start()
+        try:
+            # 1. No client cert: refused during the handshake.
+            bare_ctx = ssl.create_default_context(cafile=str(server_cert))
+            bare = TrackerClient(
+                f"https://{tracker.addr}",
+                peer_id=_peer(1).peer_id, ip="127.0.0.1", port=7001,
+                http=HTTPClient(ssl=bare_ctx, retries=1),
+            )
+            with pytest.raises(Exception) as exc_info:
+                await bare.announce(
+                    None, InfoHash("ab" * 32), "ns", complete=False
+                )
+            assert not isinstance(exc_info.value, AssertionError)
+            await bare.close()
+
+            # 2. Process-wide identity: HTTPClient() with NO explicit ssl
+            # picks up the default context (what tls_client YAML sets).
+            ident_ctx = ssl.create_default_context(cafile=str(server_cert))
+            ident_ctx.load_cert_chain(str(client_cert), str(client_key))
+            set_default_client_ssl(ident_ctx)
+            try:
+                ok = TrackerClient(
+                    f"https://{tracker.addr}",
+                    peer_id=_peer(2).peer_id, ip="127.0.0.1", port=7002,
+                    http=HTTPClient(),
+                )
+                peers, interval = await ok.announce(
+                    None, InfoHash("ab" * 32), "ns", complete=False
+                )
+                assert peers == [] and interval > 0
+                await ok.close()
+            finally:
+                set_default_client_ssl(None)
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())
