@@ -1,9 +1,9 @@
 """Pull-through backend against an upstream Docker registry.
 
 Mirrors uber/kraken ``lib/backend/registrybackend`` (blobs + tags clients
-speaking the Registry v2 API to an existing registry; how real clusters
-bootstrap content they didn't push) -- upstream path, unverified; SURVEY.md
-SS2.3.
+speaking the Registry v2 API to an existing registry, plus the
+``security`` token-auth flow; how real clusters bootstrap content they
+didn't push) -- upstream path, unverified; SURVEY.md SS2.3.
 
 Two registrations:
 
@@ -12,11 +12,25 @@ Two registrations:
 - ``registry_tag``: name = ``repo:tag``; download resolves the manifest
   and returns the manifest DIGEST string (the tag value the build-index
   stores), taken from ``Docker-Content-Digest`` or hashed from the body.
+
+Auth: real registries (Docker Hub, GHCR, Quay) answer anonymous requests
+with ``401`` + ``WWW-Authenticate: Bearer realm=...,service=...`` and
+expect the docker token flow: GET the realm (with basic credentials if
+the account is private) for a short-lived JWT, then retry with
+``Authorization: Bearer``. :class:`_AuthSession` implements that flow
+with a per-scope token cache; plain ``Basic`` challenges are answered
+directly. Configure ``username``/``password`` for private upstreams;
+public pulls work anonymously (the token endpoint still issues a token).
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
+import json
+import re
+import time
+from urllib.parse import urlencode
 
 from kraken_tpu.backend.base import (
     BackendClient,
@@ -36,9 +50,117 @@ _MANIFEST_ACCEPT = ", ".join(
     )
 )
 
+_CHALLENGE_PARAM = re.compile(r'(\w+)="([^"]*)"')
+
 
 def _full_digest(name: str) -> str:
     return name if name.startswith("sha256:") else f"sha256:{name}"
+
+
+class _AuthSession:
+    """Docker registry token auth with a per-scope cache.
+
+    One instance per backend client. Tokens are cached until shortly
+    before their advertised expiry (a 10 s guard band keeps a token from
+    dying between the cache check and the upstream's clock).
+    """
+
+    def __init__(self, http: HTTPClient, username: str = "", password: str = ""):
+        self._http = http
+        self._username = username
+        self._password = password
+        self._tokens: dict[str, tuple[str, float]] = {}  # scope -> (tok, exp)
+
+    async def request(
+        self,
+        method: str,
+        url: str,
+        *,
+        scope: str,
+        headers: dict | None = None,
+        ok: tuple[int, ...] = (200,),
+        retry_5xx: bool = True,
+    ) -> tuple[int, dict, bytes]:
+        hdrs = dict(headers or {})
+        cached = self._cached(scope)
+        if cached:
+            hdrs["Authorization"] = cached
+        status, h, b = await self._http.request_full(
+            method, url, headers=hdrs,
+            ok_statuses=tuple(ok) + (401,), retry_5xx=retry_5xx,
+        )
+        if status != 401:
+            return status, h, b
+        hdrs["Authorization"] = await self._answer(
+            h.get("WWW-Authenticate", ""), scope
+        )
+        return await self._http.request_full(
+            method, url, headers=hdrs, ok_statuses=tuple(ok),
+            retry_5xx=retry_5xx,
+        )
+
+    def _cached(self, scope: str) -> str | None:
+        tok = self._tokens.get(scope)
+        if tok and tok[1] > time.monotonic():
+            return tok[0]
+        return None
+
+    def _basic(self) -> str:
+        creds = f"{self._username}:{self._password}".encode()
+        return "Basic " + base64.b64encode(creds).decode()
+
+    async def _answer(self, challenge: str, scope: str) -> str:
+        scheme, _, rest = challenge.partition(" ")
+        scheme = scheme.lower()
+        if scheme == "basic":
+            if not self._username:
+                raise BackendError(
+                    "upstream registry requires basic auth; configure "
+                    "username/password on the backend"
+                )
+            return self._basic()
+        if scheme != "bearer":
+            raise BackendError(
+                f"unsupported upstream auth challenge: {challenge!r}"
+            )
+        params = dict(_CHALLENGE_PARAM.findall(rest))
+        realm = params.get("realm")
+        if not realm:
+            raise BackendError(f"bearer challenge without realm: {challenge!r}")
+        # The challenge's own scope wins (the upstream knows what it wants
+        # granted); the caller's is the fallback for terse challenges.
+        use_scope = params.get("scope") or scope
+        query = {
+            k: v
+            for k, v in (
+                ("service", params.get("service", "")),
+                ("scope", use_scope),
+            )
+            if v
+        }
+        token_url = realm + (f"?{urlencode(query)}" if query else "")
+        token_headers = (
+            {"Authorization": self._basic()} if self._username else None
+        )
+        try:
+            body = await self._http.get(token_url, headers=token_headers)
+        except HTTPError as e:
+            raise BackendError(
+                f"token endpoint refused ({e.status}): check credentials"
+            ) from e
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            raise BackendError("token endpoint returned non-JSON") from None
+        tok = payload.get("token") or payload.get("access_token")
+        if not isinstance(tok, str) or not tok:
+            raise BackendError("token endpoint returned no token")
+        ttl = float(payload.get("expires_in") or 60.0)
+        value = f"Bearer {tok}"
+        self._tokens[use_scope] = (
+            value, time.monotonic() + max(ttl - 10.0, 10.0)
+        )
+        return value
 
 
 class _RegistryBase(BackendClient):
@@ -47,6 +169,11 @@ class _RegistryBase(BackendClient):
         scheme = "https" if config.get("tls", False) else "http"
         self.base = f"{scheme}://{addr}/v2"
         self._http = HTTPClient(retries=config.get("retries", 3))
+        self._auth = _AuthSession(
+            self._http,
+            username=config.get("username", ""),
+            password=config.get("password", ""),
+        )
 
     async def upload(self, namespace: str, name: str, data: bytes) -> None:
         raise BackendError("registry backend is read-only (pull-through)")
@@ -60,16 +187,21 @@ class _RegistryBase(BackendClient):
 
 @register_backend("registry_blob")
 class RegistryBlobBackend(_RegistryBase):
-    """config: address ("host:port"), tls (false), retries."""
+    """config: address ("host:port"), tls (false), retries, username,
+    password (empty = anonymous token flow)."""
 
     def _url(self, namespace: str, name: str) -> str:
         return f"{self.base}/{namespace}/blobs/{_full_digest(name)}"
 
+    @staticmethod
+    def _scope(namespace: str) -> str:
+        return f"repository:{namespace}:pull"
+
     async def stat(self, namespace: str, name: str) -> BlobInfo:
         try:
-            _s, headers, _b = await self._http.request_full(
-                "HEAD", self._url(namespace, name), ok_statuses=(200,),
-                retry_5xx=False,
+            _s, headers, _b = await self._auth.request(
+                "HEAD", self._url(namespace, name),
+                scope=self._scope(namespace), retry_5xx=False,
             )
         except HTTPError as e:
             if e.status == 404:
@@ -79,7 +211,11 @@ class RegistryBlobBackend(_RegistryBase):
 
     async def download(self, namespace: str, name: str) -> bytes:
         try:
-            return await self._http.get(self._url(namespace, name))
+            _s, _h, body = await self._auth.request(
+                "GET", self._url(namespace, name),
+                scope=self._scope(namespace),
+            )
+            return body
         except HTTPError as e:
             if e.status == 404:
                 raise BlobNotFoundError(name) from None
@@ -90,21 +226,23 @@ class RegistryBlobBackend(_RegistryBase):
 class RegistryTagBackend(_RegistryBase):
     """Resolves ``repo:tag`` names to manifest digests via the upstream."""
 
-    def _url(self, name: str) -> str:
+    def _split(self, name: str) -> tuple[str, str]:
         repo, sep, tag = name.rpartition(":")
         if not sep:
             raise BackendError(f"tag name must be repo:tag, got {name!r}")
-        return f"{self.base}/{repo}/manifests/{tag}"
+        return repo, tag
 
     async def stat(self, namespace: str, name: str) -> BlobInfo:
         digest = await self.download(namespace, name)
         return BlobInfo(len(digest))
 
     async def download(self, namespace: str, name: str) -> bytes:
+        repo, tag = self._split(name)
         try:
-            _s, headers, body = await self._http.request_full(
-                "GET", self._url(name),
-                headers={"Accept": _MANIFEST_ACCEPT}, ok_statuses=(200,),
+            _s, headers, body = await self._auth.request(
+                "GET", f"{self.base}/{repo}/manifests/{tag}",
+                scope=f"repository:{repo}:pull",
+                headers={"Accept": _MANIFEST_ACCEPT},
             )
         except HTTPError as e:
             if e.status == 404:

@@ -160,17 +160,60 @@ class FakeWebHDFS:
 
 
 class FakeUpstreamRegistry:
-    """Minimal Docker registry v2: blobs + manifests with content digests."""
+    """Minimal Docker registry v2: blobs + manifests with content digests.
+
+    With ``token_auth=True`` it enforces the docker token flow (as Docker
+    Hub/GHCR do): v2 requests without a valid Bearer token get 401 + a
+    ``WWW-Authenticate`` challenge pointing at ``/token``; the token
+    endpoint requires basic credentials iff ``username`` is set."""
 
     __test__ = False
 
-    def __init__(self):
+    def __init__(self, token_auth: bool = False, username: str = "", password: str = ""):
         self.blobs: dict[str, bytes] = {}  # "repo/sha256:hex" -> bytes
         self.manifests: dict[str, bytes] = {}  # "repo:tag" -> manifest bytes
         self.addr = ""
         self._runner = None
+        self.token_auth = token_auth
+        self.username = username
+        self.password = password
+        self.token_fetches = 0
+        self._token = "fake-jwt-0123"
+
+    def _challenge(self, req: web.Request) -> web.Response | None:
+        if not self.token_auth:
+            return None
+        if req.headers.get("Authorization") == f"Bearer {self._token}":
+            return None
+        return web.Response(
+            status=401,
+            headers={
+                "WWW-Authenticate": (
+                    f'Bearer realm="http://{self.addr}/token",'
+                    f'service="fake-registry",'
+                    f'scope="repository:{req.match_info["repo"]}:pull"'
+                )
+            },
+        )
+
+    async def _token_endpoint(self, req: web.Request) -> web.Response:
+        if self.username:
+            import base64 as b64
+
+            want = "Basic " + b64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            if req.headers.get("Authorization") != want:
+                return web.Response(status=401)
+        assert req.query.get("service") == "fake-registry"
+        assert req.query.get("scope", "").startswith("repository:")
+        self.token_fetches += 1
+        return web.json_response({"token": self._token, "expires_in": 300})
 
     async def _blob(self, req: web.Request) -> web.Response:
+        denied = self._challenge(req)
+        if denied is not None:
+            return denied
         key = f"{req.match_info['repo']}/{req.match_info['digest']}"
         data = self.blobs.get(key)
         if data is None:
@@ -181,6 +224,9 @@ class FakeUpstreamRegistry:
         return web.Response(body=data, headers=headers)
 
     async def _manifest(self, req: web.Request) -> web.Response:
+        denied = self._challenge(req)
+        if denied is not None:
+            return denied
         key = f"{req.match_info['repo']}:{req.match_info['ref']}"
         data = self.manifests.get(key)
         if data is None:
@@ -190,6 +236,7 @@ class FakeUpstreamRegistry:
 
     async def __aenter__(self):
         app = web.Application()
+        app.router.add_get("/token", self._token_endpoint)
         app.router.add_route(
             "*", "/v2/{repo:.+}/blobs/{digest}", self._blob
         )
@@ -385,5 +432,79 @@ def test_origin_pulls_through_upstream_registry(tmp_path):
             finally:
                 await node.stop()
                 await backends.close()
+
+    asyncio.run(main())
+
+
+def test_registry_backend_token_auth_flow():
+    """The docker token flow against a challenging upstream: 401 Bearer
+    challenge -> token fetch (with basic creds) -> retried request; the
+    token is CACHED per scope (one fetch serves repeated pulls) and bad
+    credentials surface as BackendError, not a raw 401."""
+
+    async def main():
+        async with FakeUpstreamRegistry(
+            token_auth=True, username="puller", password="hunter2"
+        ) as up:
+            layer = b"private-layer" * 50
+            d = "sha256:" + hashlib.sha256(layer).hexdigest()
+            up.blobs[f"acme/app/{d}"] = layer
+            manifest = json.dumps({"layers": [{"digest": d}]}).encode()
+            up.manifests["acme/app:v1"] = manifest
+
+            blobs = make_backend("registry_blob", {
+                "address": up.addr, "username": "puller",
+                "password": "hunter2",
+            })
+            tags = make_backend("registry_tag", {
+                "address": up.addr, "username": "puller",
+                "password": "hunter2",
+            })
+            try:
+                assert await blobs.download("acme/app", d) == layer
+                assert (await blobs.stat("acme/app", d)).size == len(layer)
+                assert await blobs.download("acme/app", d) == layer
+                # One scope, many requests: exactly one token fetch.
+                assert up.token_fetches == 1, up.token_fetches
+                got = await tags.download("x", "acme/app:v1")
+                want = "sha256:" + hashlib.sha256(manifest).hexdigest()
+                assert got.decode() == want
+                # 404 vs auth stays distinguishable through the flow.
+                with pytest.raises(BlobNotFoundError):
+                    await blobs.download("acme/app", "0" * 64)
+            finally:
+                await blobs.close()
+                await tags.close()
+
+            from kraken_tpu.backend.base import BackendError
+
+            bad = make_backend("registry_blob", {
+                "address": up.addr, "username": "puller",
+                "password": "wrong",
+            })
+            try:
+                with pytest.raises(BackendError, match="credentials"):
+                    await bad.download("acme/app", d)
+            finally:
+                await bad.close()
+
+    asyncio.run(main())
+
+
+def test_registry_backend_anonymous_token_flow():
+    """Public upstreams still challenge: the anonymous flow (no creds on
+    the token fetch) must work, as docker pulls of public images do."""
+
+    async def main():
+        async with FakeUpstreamRegistry(token_auth=True) as up:
+            layer = b"public-layer" * 50
+            d = "sha256:" + hashlib.sha256(layer).hexdigest()
+            up.blobs[f"library/nginx/{d}"] = layer
+            blobs = make_backend("registry_blob", {"address": up.addr})
+            try:
+                assert await blobs.download("library/nginx", d) == layer
+                assert up.token_fetches == 1
+            finally:
+                await blobs.close()
 
     asyncio.run(main())
