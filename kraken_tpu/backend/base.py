@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import asyncio
+import os
 import re
 from typing import Callable, Dict, Optional
 
@@ -38,6 +40,34 @@ class BackendClient:
 
     async def upload(self, namespace: str, name: str, data: bytes) -> None:
         raise NotImplementedError
+
+    async def upload_file(self, namespace: str, name: str, path: str) -> None:
+        """Upload from a local file. Default: buffer + :meth:`upload`
+        (correct for all backends; memory-bound for multi-GB blobs).
+        Backends with a streaming/multipart story override this -- the
+        writeback plane always calls THIS, so overriding is sufficient."""
+
+        def _read() -> bytes:
+            with open(path, "rb") as f:
+                return f.read()
+
+        data = await asyncio.to_thread(_read)
+        await self.upload(namespace, name, data)
+
+    async def download_to_file(
+        self, namespace: str, name: str, dest_path: str
+    ) -> int:
+        """Download into a local file; returns byte count. Default:
+        :meth:`download` + write (memory-bound); streaming backends
+        override."""
+        data = await self.download(namespace, name)
+
+        def _write() -> None:
+            with open(dest_path, "wb") as f:
+                f.write(data)
+
+        await asyncio.to_thread(_write)
+        return len(data)
 
     async def list(self, prefix: str) -> list[str]:
         raise NotImplementedError
@@ -89,6 +119,18 @@ class _ThrottledClient(BackendClient):
     async def upload(self, namespace: str, name: str, data: bytes) -> None:
         await self._egress.acquire(len(data))
         await self._inner.upload(namespace, name, data)
+
+    async def upload_file(self, namespace: str, name: str, path: str) -> None:
+        size = await asyncio.to_thread(os.path.getsize, path)
+        await self._egress.acquire(size)
+        await self._inner.upload_file(namespace, name, path)
+
+    async def download_to_file(
+        self, namespace: str, name: str, dest_path: str
+    ) -> int:
+        n = await self._inner.download_to_file(namespace, name, dest_path)
+        await self._ingress.acquire(n)
+        return n
 
     async def list(self, prefix: str) -> list[str]:
         return await self._inner.list(prefix)

@@ -7,10 +7,13 @@ SURVEY.md SS2.3/SS3.5. Requests coalesce so a miss storm pulls once.
 
 from __future__ import annotations
 
+import asyncio
+
 from kraken_tpu.backend import BlobNotFoundError, Manager
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.metainfogen import Generator
 from kraken_tpu.store import CAStore
+from kraken_tpu.store.castore import DigestMismatchError, FileExistsInCacheError
 from kraken_tpu.utils.dedup import RequestCoalescer
 
 
@@ -51,12 +54,23 @@ class Refresher:
         if client is None:
             raise BlobNotFoundError(f"no backend for namespace {namespace!r}")
         # Logical name only: each backend owns its physical layout
-        # (pather) -- see kraken_tpu/backend/namepath.py.
-        data = await client.download(namespace, d.hex)
-        actual = Digest.from_bytes(data)
-        if actual != d:
-            raise BlobNotFoundError(
-                f"backend returned corrupt blob: expected {d}, got {actual}"
+        # (pather) -- see kraken_tpu/backend/namepath.py. The bytes stream
+        # backend -> upload area -> verified atomic commit: a restored
+        # multi-GB layer never transits RAM whole.
+        uid = self.store.create_upload()
+        try:
+            await client.download_to_file(
+                namespace, d.hex, self.store.upload_path(uid)
             )
-        self.store.create_cache_file(d, iter([data]), verify=False)
+            try:
+                await asyncio.to_thread(self.store.commit_upload, uid, d)
+            except FileExistsInCacheError:
+                pass  # a concurrent path restored it; ours was redundant
+            except DigestMismatchError as e:
+                raise BlobNotFoundError(
+                    f"backend returned corrupt blob: {e}"
+                ) from None
+        except BaseException:
+            self.store.abort_upload(uid)
+            raise
         await self.generator.generate(d)

@@ -56,8 +56,10 @@ class WritebackExecutor:
         namespace = task.payload["namespace"]
         d = Digest.from_hex(task.payload["digest"])
         client = self.backends.get_client(namespace)
-        data = await asyncio.to_thread(self.store.read_cache_file, d)
-        await client.upload(namespace, d.hex, data)  # backend owns pathing
+        # File-based: backends stream/multipart it (S3), or buffer via the
+        # base-class default; either way writeback never holds a layer in
+        # RAM itself. The backend owns pathing.
+        await client.upload_file(namespace, d.hex, self.store.cache_path(d))
         # Landed durably: drop the writeback pin -- but only once no OTHER
         # pending writeback references this blob (the pin is a reason-set,
         # not a counter: the first namespace's writeback landing must not

@@ -83,6 +83,12 @@ class CAStore:
             pass
         return uid
 
+    def upload_path(self, uid: str) -> str:
+        """Filesystem path of an in-progress upload, for file-based
+        writers that stream straight into the upload area (e.g. backend
+        ``download_to_file``) before an atomic verified commit."""
+        return self._upload_path(uid)
+
     def upload_exists(self, uid: str) -> bool:
         return os.path.exists(self._upload_path(uid))
 
