@@ -30,7 +30,7 @@ import hashlib
 import json
 import re
 import time
-from urllib.parse import urlencode
+from urllib.parse import urlencode, urljoin
 
 from kraken_tpu.backend.base import (
     BackendClient,
@@ -85,19 +85,45 @@ class _AuthSession:
         cached = self._cached(scope)
         if cached:
             hdrs["Authorization"] = cached
-        status, h, b = await self._http.request_full(
-            method, url, headers=hdrs,
-            ok_statuses=tuple(ok) + (401,), retry_5xx=retry_5xx,
+        status, h, b = await self._one_hop(
+            method, url, hdrs, ok=tuple(ok) + (401,), retry_5xx=retry_5xx
         )
         if status != 401:
             return status, h, b
         hdrs["Authorization"] = await self._answer(
             h.get("WWW-Authenticate", ""), scope
         )
-        return await self._http.request_full(
-            method, url, headers=hdrs, ok_statuses=tuple(ok),
-            retry_5xx=retry_5xx,
+        return await self._one_hop(
+            method, url, hdrs, ok=tuple(ok), retry_5xx=retry_5xx
         )
+
+    async def _one_hop(
+        self, method: str, url: str, hdrs: dict, *, ok, retry_5xx
+    ) -> tuple[int, dict, bytes]:
+        """One request, following redirects MANUALLY so the registry
+        Authorization header is dropped on the redirected hop: real
+        upstreams answer authorized blob GETs with 307 to a presigned
+        S3/CDN URL, and S3 rejects requests carrying BOTH presigned
+        query auth and an Authorization header."""
+        redirects = (301, 302, 303, 307, 308)
+        status, h, b = await self._http.request_full(
+            method, url, headers=hdrs, ok_statuses=tuple(ok) + redirects,
+            retry_5xx=retry_5xx, allow_redirects=False,
+        )
+        for _hop in range(5):
+            if status not in redirects:
+                return status, h, b
+            location = h.get("Location", "")
+            if not location:
+                raise HTTPError(method, url, status, b"redirect without Location")
+            url = urljoin(url, location)
+            clean = {k: v for k, v in hdrs.items() if k != "Authorization"}
+            status, h, b = await self._http.request_full(
+                method, url, headers=clean,
+                ok_statuses=tuple(ok) + redirects,
+                retry_5xx=retry_5xx, allow_redirects=False,
+            )
+        raise HTTPError(method, url, status, b"too many redirects")
 
     def _cached(self, scope: str) -> str | None:
         tok = self._tokens.get(scope)
@@ -118,7 +144,12 @@ class _AuthSession:
                     "upstream registry requires basic auth; configure "
                     "username/password on the backend"
                 )
-            return self._basic()
+            value = self._basic()
+            # Cache under the CALLER's scope (the lookup key) so every
+            # subsequent request attaches it proactively instead of
+            # eating a guaranteed 401 + retry round-trip.
+            self._tokens[scope] = (value, float("inf"))
+            return value
         if scheme != "bearer":
             raise BackendError(
                 f"unsupported upstream auth challenge: {challenge!r}"
@@ -157,9 +188,13 @@ class _AuthSession:
             raise BackendError("token endpoint returned no token")
         ttl = float(payload.get("expires_in") or 60.0)
         value = f"Bearer {tok}"
-        self._tokens[use_scope] = (
-            value, time.monotonic() + max(ttl - 10.0, 10.0)
-        )
+        entry = (value, time.monotonic() + max(ttl - 10.0, 10.0))
+        # Store under the CALLER's scope too: lookups key on it, and an
+        # upstream whose challenge carries a broader/re-normalized scope
+        # string would otherwise never hit the cache (three round-trips
+        # per request, hammering a rate-limited token endpoint).
+        self._tokens[use_scope] = entry
+        self._tokens[scope] = entry
         return value
 
 

@@ -175,9 +175,14 @@ def main(argv: list[str] | None = None) -> None:
 
         from kraken_tpu.utils.httputil import set_default_client_ssl
 
-        client_ctx = ssl.create_default_context(
-            cafile=tlsc_cfg.get("ca") or None
-        )
+        # System roots PLUS the cluster CA (trust union): the same
+        # default client reaches both mTLS cluster peers and external
+        # TLS endpoints (S3, GCS, upstream registries) -- a cafile=
+        # constructor would REPLACE the system store and break every
+        # cloud backend in the process.
+        client_ctx = ssl.create_default_context()
+        if tlsc_cfg.get("ca"):
+            client_ctx.load_verify_locations(cafile=tlsc_cfg["ca"])
         client_ctx.load_cert_chain(tlsc_cfg["cert"], tlsc_cfg["key"])
         set_default_client_ssl(client_ctx)
 

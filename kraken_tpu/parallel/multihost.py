@@ -24,6 +24,7 @@ Hermetic self-test: ``python -m kraken_tpu.parallel.multihost <proc>
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import numpy as np
@@ -105,6 +106,15 @@ def _allgather_digests(
     return [gathered[p, : counts[p]] for p in range(ctx.num_processes)]
 
 
+@functools.lru_cache(maxsize=8)
+def _replicate_fn(mesh: Mesh):
+    """Compile-cached replicating identity for one hosts mesh. A fresh
+    ``jax.jit(lambda x: x)`` per call would key the jit cache on a new
+    function object every time -- every batch would recompile (and
+    re-lower in lockstep on every host) the cross-host collective."""
+    return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+
+
 def _gather(ctx: MultihostContext, local_block: np.ndarray, m: int):
     """All-gather ``local_block`` ([1, ...] per host) over the hosts mesh."""
     mesh = ctx.hosts_mesh
@@ -116,10 +126,7 @@ def _gather(ctx: MultihostContext, local_block: np.ndarray, m: int):
         global_shape, NamedSharding(mesh, spec), [shard]
     )
     with mesh:
-        out = jax.jit(
-            lambda x: x,
-            out_shardings=NamedSharding(mesh, P()),
-        )(garr)
+        out = _replicate_fn(mesh)(garr)
     return out
 
 

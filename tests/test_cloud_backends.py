@@ -219,7 +219,7 @@ class FakeUpstreamRegistry:
 
     __test__ = False
 
-    def __init__(self, token_auth: bool = False, username: str = "", password: str = ""):
+    def __init__(self, token_auth: bool = False, username: str = "", password: str = "", redirect_blobs: bool = False):
         self.blobs: dict[str, bytes] = {}  # "repo/sha256:hex" -> bytes
         self.manifests: dict[str, bytes] = {}  # "repo:tag" -> manifest bytes
         self.addr = ""
@@ -229,6 +229,10 @@ class FakeUpstreamRegistry:
         self.password = password
         self.token_fetches = 0
         self._token = "fake-jwt-0123"
+        # Real upstreams 307 authorized blob GETs to a presigned CDN URL
+        # that REJECTS an Authorization header (S3 allows only one auth
+        # mechanism); redirect_blobs models that.
+        self.redirect_blobs = redirect_blobs
 
     def _challenge(self, req: web.Request) -> web.Response | None:
         if not self.token_auth:
@@ -268,10 +272,25 @@ class FakeUpstreamRegistry:
         data = self.blobs.get(key)
         if data is None:
             return web.Response(status=404)
+        if self.redirect_blobs and req.method == "GET":
+            return web.Response(status=307, headers={
+                "Location": (
+                    f"http://{self.addr}/cdn/{key}?X-Amz-Signature=fake"
+                ),
+            })
         headers = {"Content-Length": str(len(data))}
         if req.method == "HEAD":
             return web.Response(headers=headers)
         return web.Response(body=data, headers=headers)
+
+    async def _cdn(self, req: web.Request) -> web.Response:
+        if "Authorization" in req.headers:
+            # S3's "Only one auth mechanism allowed" on presigned URLs.
+            return web.Response(status=400, text="OnlyOneAuthMechanismAllowed")
+        data = self.blobs.get(req.match_info["key"])
+        if data is None:
+            return web.Response(status=404)
+        return web.Response(body=data)
 
     async def _manifest(self, req: web.Request) -> web.Response:
         denied = self._challenge(req)
@@ -287,6 +306,7 @@ class FakeUpstreamRegistry:
     async def __aenter__(self):
         app = web.Application()
         app.router.add_get("/token", self._token_endpoint)
+        app.router.add_get("/cdn/{key:.+}", self._cdn)
         app.router.add_route(
             "*", "/v2/{repo:.+}/blobs/{digest}", self._blob
         )
@@ -615,5 +635,29 @@ def test_s3_multipart_upload_file(tmp_path):
                 assert "failkey" not in s3.objects
             finally:
                 await client.close()
+
+    asyncio.run(main())
+
+
+def test_registry_backend_presigned_redirect_drops_auth():
+    """Authorized blob GETs that 307 to a presigned CDN URL must follow
+    the redirect WITHOUT the Authorization header (S3 rejects mixed auth
+    mechanisms); the token cache must also key on the caller's scope so
+    repeated pulls don't re-fetch tokens."""
+
+    async def main():
+        async with FakeUpstreamRegistry(
+            token_auth=True, redirect_blobs=True
+        ) as up:
+            layer = b"cdn-layer" * 64
+            d = "sha256:" + hashlib.sha256(layer).hexdigest()
+            up.blobs[f"library/redis/{d}"] = layer
+            blobs = make_backend("registry_blob", {"address": up.addr})
+            try:
+                assert await blobs.download("library/redis", d) == layer
+                assert await blobs.download("library/redis", d) == layer
+                assert up.token_fetches == 1, up.token_fetches
+            finally:
+                await blobs.close()
 
     asyncio.run(main())
