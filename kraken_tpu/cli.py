@@ -97,6 +97,10 @@ def main(argv: list[str] | None = None) -> None:
     p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
     p_origin.add_argument("--cluster", default=None,
                           help="comma-separated origin http addrs (incl. self)")
+    p_origin.add_argument("--cluster-dns", default=None,
+                          help="host:port whose DNS A/AAAA records are the"
+                               " ring membership (k8s headless services);"
+                               " mutually exclusive with --cluster")
     p_origin.add_argument("--self-addr", default=None,
                           help="this origin's address AS IT APPEARS in"
                                " --cluster (required with --cluster; health"
@@ -223,7 +227,7 @@ def main(argv: list[str] | None = None) -> None:
         ]
         # YAML: cluster_dns: "origins.example.com:80" -- membership from
         # DNS A/AAAA records instead of a static list.
-        cluster_dns = cfg.get("cluster_dns", "")
+        cluster_dns = pick(args.cluster_dns, "cluster_dns", "")
         if cluster_addrs and cluster_dns:
             parser.error(
                 "--cluster and cluster_dns are mutually exclusive -- a"
