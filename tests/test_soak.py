@@ -1,0 +1,163 @@
+"""Chaos soak: continuous push/pull traffic WHILE an origin dies and
+revives. Every other failure test freezes the world around one injected
+fault; real clusters take faults under load. This drives the whole stack
+-- chunked uploads, ring replication, P2P pulls through agents, repair --
+concurrently with the outage and asserts nothing is lost and nothing is
+corrupt at the end.
+
+Kept to ~15 s wall so it stays in the default suite; crank BLOBS /
+durations for a longer manual soak.
+"""
+
+import asyncio
+import os
+import socket
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement.healthcheck import PassiveFilter
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+
+BLOBS = 14
+BLOB_BYTES = 96_000
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _origin(tmp_path, name, addrs, port):
+    node = OriginNode(
+        store_root=str(tmp_path / name),
+        http_port=port,
+        ring=Ring(HostList(static=addrs), max_replica=2),
+        self_addr=f"127.0.0.1:{port}",
+        dedup=False,
+        health_interval_seconds=0.2,
+        health_fail_threshold=2,
+    )
+    return node
+
+
+def test_soak_push_pull_through_origin_outage(tmp_path):
+    asyncio.run(_drive(tmp_path))
+
+
+async def _drive(tmp_path):
+    ports = [_free_port() for _ in range(3)]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+
+    tracker = TrackerNode(
+        announce_interval_seconds=0.1,
+        peer_ttl_seconds=5.0,
+        ring_refresh_seconds=0.2,
+    )
+    await tracker.start()
+    origins = {}
+    for i in range(3):
+        n = _origin(tmp_path, f"o{i}", addrs, ports[i])
+        n.tracker_addr = tracker.addr
+        await n.start()
+        origins[i] = n
+
+    health = PassiveFilter(fail_threshold=1, cooldown_seconds=0.5)
+    cluster = ClusterClient(
+        Ring(HostList(static=addrs), max_replica=2, health_filter=health.filter),
+        client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+        health=health,
+    )
+    tracker.server.origin_cluster = cluster
+
+    agents = []
+    for i in range(2):
+        a = AgentNode(
+            store_root=str(tmp_path / f"a{i}"), tracker_addr=tracker.addr
+        )
+        await a.start()
+        agents.append(a)
+
+    http = HTTPClient(timeout_seconds=30)
+    uploaded: dict[str, bytes] = {}  # digest hex -> bytes, as they land
+    errors: list[str] = []
+
+    async def uploader():
+        """One blob every ~0.25 s, through the outage. Uploads ride the
+        cluster client's replica fan-out; a replica being dead mid-fan
+        must not fail the upload (>=1 acceptance wins)."""
+        for i in range(BLOBS):
+            blob = os.urandom(BLOB_BYTES) + i.to_bytes(4, "big")
+            d = Digest.from_bytes(blob)
+            try:
+                await cluster.upload("ns", d, blob)
+                uploaded[d.hex] = blob
+            except Exception as e:
+                errors.append(f"upload {i}: {e!r}")
+            await asyncio.sleep(0.25)
+
+    async def puller(agent, name):
+        """Pull everything that exists, repeatedly, verifying bytes."""
+        seen: set[str] = set()
+        while len(seen) < BLOBS or uploading.done() is False:
+            for hexd, blob in list(uploaded.items()):
+                try:
+                    got = await http.get(
+                        f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+                    )
+                except HTTPError as e:
+                    if e.status >= 500:
+                        continue  # transient during the outage: retry later
+                    errors.append(f"{name} pull {hexd[:8]}: {e!r}")
+                    seen.add(hexd)
+                    continue
+                if got != blob:
+                    errors.append(f"{name} pull {hexd[:8]}: BYTES DIFFER")
+                seen.add(hexd)
+            await asyncio.sleep(0.05)
+
+    async def chaos():
+        """Kill an origin 1.5 s in, revive it at the same address 2 s
+        later, while traffic continues."""
+        await asyncio.sleep(1.5)
+        victim = 1
+        await origins[victim].stop()
+        await asyncio.sleep(2.0)
+        reborn = _origin(tmp_path / "reborn", f"o{victim}", addrs, ports[victim])
+        reborn.tracker_addr = tracker.addr
+        await reborn.start()
+        origins[victim] = reborn
+
+    uploading = asyncio.create_task(uploader())
+    chaos_task = asyncio.create_task(chaos())
+    pullers = [
+        asyncio.create_task(puller(a, f"agent{i}"))
+        for i, a in enumerate(agents)
+    ]
+    try:
+        await asyncio.wait_for(uploading, 30)
+        await asyncio.wait_for(chaos_task, 30)
+        await asyncio.wait_for(asyncio.gather(*pullers), 60)
+
+        assert not errors, "\n".join(errors)
+        assert len(uploaded) == BLOBS, f"only {len(uploaded)} uploads landed"
+        # Final sweep: every blob byte-identical via BOTH agents.
+        for agent in agents:
+            for hexd, blob in uploaded.items():
+                got = await http.get(
+                    f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+                )
+                assert got == blob, f"final pull differs: {hexd[:8]}"
+    finally:
+        for t in (uploading, chaos_task, *pullers):
+            if not t.done():
+                t.cancel()
+        await http.close()
+        await cluster.close()
+        for a in agents:
+            await a.stop()
+        for n in origins.values():
+            await n.stop()
+        await tracker.stop()
