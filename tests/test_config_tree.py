@@ -1,0 +1,75 @@
+"""The shipped config tree must stay loadable and internally consistent.
+
+Config rot is silent: a renamed constructor kwarg or a typo'd YAML key in
+`config/` breaks production boots without failing any code-path test.
+This loads every shipped file through the SAME loader the CLI uses
+(extends-merge included) and cross-checks the keys each component file
+carries against what the CLI/assembly actually consume.
+"""
+
+import inspect
+import os
+
+from kraken_tpu.configutil import load_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "config")
+
+# Keys the CLI layer itself consumes (kraken_tpu/cli.py `cfg.get` /
+# `pick(...)` sites) rather than forwarding to a constructor kwarg.
+CLI_KEYS = {
+    "host", "port", "store", "tracker", "p2p_port", "hasher",
+    "cluster", "cluster_dns", "self_addr", "max_replica", "backends",
+    "cleanup", "tls", "tls_client", "scheduler", "origins",
+    "announce_interval_seconds", "peer_ttl_seconds", "peerstore_redis",
+    "registry_port", "build_index", "spool", "remotes", "dedup_index",
+    "dedup_budget_bytes", "extends",
+}
+
+
+def _component_files():
+    for comp in ("agent", "origin", "tracker", "proxy", "build-index"):
+        d = os.path.join(CONFIG, comp)
+        for f in sorted(os.listdir(d)):
+            if f.endswith(".yaml"):
+                yield comp, os.path.join(d, f)
+
+
+def test_every_shipped_config_loads_with_extends():
+    seen = 0
+    for comp, path in _component_files():
+        cfg = load_config(path)
+        assert isinstance(cfg, dict) and cfg, path
+        # The extends-merge must have pulled the shared base in.
+        assert "host" in cfg, f"{path}: base.yaml extends-merge missing"
+        seen += 1
+    assert seen >= 5
+
+
+def test_shipped_config_keys_are_consumed():
+    """Every top-level key in every shipped file must be one the CLI
+    reads -- an unknown key is a typo or a renamed knob, and YAML has no
+    other way to tell the operator."""
+    for comp, path in _component_files():
+        cfg = load_config(path)
+        unknown = set(cfg) - CLI_KEYS
+        assert not unknown, f"{path}: unconsumed keys {sorted(unknown)}"
+
+
+def test_cleanup_watermarks_ordered():
+    for comp, path in _component_files():
+        cfg = load_config(path)
+        cl = cfg.get("cleanup")
+        if not cl:
+            continue
+        assert cl["low_watermark_bytes"] < cl["high_watermark_bytes"], path
+
+
+def test_cli_keys_match_cli_source():
+    """CLI_KEYS drifts too: every key this test whitelists must actually
+    appear in cli.py, so deleting a knob there fails here."""
+    src = inspect.getsource(__import__("kraken_tpu.cli", fromlist=["x"]))
+    for key in CLI_KEYS - {"extends"}:
+        assert (
+            f'"{key}"' in src or f"'{key}'" in src or f"args.{key}" in src
+        ), f"CLI_KEYS lists {key!r} but cli.py never mentions it"
