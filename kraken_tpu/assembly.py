@@ -492,6 +492,7 @@ class BuildIndexNode:
         remotes: list[str] | None = None,
         origin_cluster: ClusterClient | None = None,
         ssl_context=None,
+        immutable_tags: bool = False,
     ):
         from kraken_tpu.buildindex.server import TagServer
         from kraken_tpu.buildindex.tagstore import TagStore
@@ -507,6 +508,7 @@ class BuildIndexNode:
             retry=self.retry,
             remotes=remotes,
             origin_cluster=origin_cluster,
+            immutable=immutable_tags,
         )
         self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None

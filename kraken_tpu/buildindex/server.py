@@ -40,15 +40,31 @@ class TagServer:
         remotes: list[str] | None = None,  # remote build-index addrs
         resolver: DependencyResolver | None = None,
         origin_cluster=None,  # for pre-fetching replicated dependencies
+        immutable: bool = False,
     ):
         self.store = store
         self.retry = retry
         self.remotes = remotes or []
         self.resolver = resolver or DependencyResolver(origin_cluster)
         self.origin_cluster = origin_cluster
+        # immutable_tags YAML: a tag, once written, can never point at a
+        # DIFFERENT digest (re-putting the same digest stays idempotent --
+        # retried pushes must not fail). Conflicts answer 409. This is the
+        # guarantee that makes aggressive tag caching sound and prevents
+        # a re-tagged name from silently changing what hosts run.
+        self.immutable = immutable
         self._http = HTTPClient()
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
+
+    async def _check_mutation(self, tag: str, d: Digest) -> None:
+        if not self.immutable:
+            return
+        existing = self.store.get_local(tag)
+        if existing is not None and existing != d:
+            raise web.HTTPConflict(
+                text=f"tag is immutable: {tag} -> {existing}"
+            )
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=1 << 26)
@@ -71,11 +87,13 @@ class TagServer:
 
     async def _put(self, req: web.Request) -> web.Response:
         tag, d = self._parse(req)
+        await self._check_mutation(tag, d)
         await self.store.put(tag, d)
         return web.Response(status=200)
 
     async def _put_and_replicate(self, req: web.Request) -> web.Response:
         tag, d = self._parse(req)
+        await self._check_mutation(tag, d)
         await self.store.put(tag, d)
         if self.retry is not None:
             deps = await self.resolver.resolve(tag.rpartition(":")[0] or tag, tag, d)
@@ -116,6 +134,10 @@ class TagServer:
             deps = [Digest.from_hex(x) for x in doc.get("dependencies", [])]
         except (json.JSONDecodeError, KeyError, ValueError) as e:
             raise web.HTTPBadRequest(text=f"malformed replication: {e}")
+        # Two clusters minting the same tag differently is a config error;
+        # refusing (409) keeps it visible in the source's retry queue
+        # instead of letting last-writer-wins corrupt either side.
+        await self._check_mutation(tag, d)
         # Pre-fetch dependency blobs into this cluster's origins (repair
         # path pulls them from the remote cluster's backend on miss).
         if self.origin_cluster is not None:

@@ -342,6 +342,9 @@ def main(argv: list[str] | None = None) -> None:
             remotes=remotes or None,
             origin_cluster=origin_cluster(pick(args.origins, "origins", "")),
             ssl_context=ssl_context,
+            # YAML: immutable_tags: true -- a tag can never be re-pointed
+            # at a different digest (same-digest re-push stays idempotent).
+            immutable_tags=bool(cfg.get("immutable_tags", False)),
         )
         asyncio.run(_run_until_signal(node, {"component": "build-index"}))
 

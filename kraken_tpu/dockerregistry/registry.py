@@ -224,7 +224,21 @@ class RegistryServer:
                 )
         await self.transferer.upload(repo, d, data)
         if not ref.startswith("sha256:"):
-            await self.transferer.put_tag(f"{repo}:{ref}", d)
+            try:
+                await self.transferer.put_tag(f"{repo}:{ref}", d)
+            except Exception as e:
+                from kraken_tpu.utils import httputil
+
+                if httputil.is_conflict(e):
+                    # Immutable-tag cluster (build-index 409): refusing a
+                    # re-point is DENIED -- the client's credentials are
+                    # fine, the operation itself is forbidden. 404-family
+                    # codes would mislead push retry logic.
+                    raise v2_error(
+                        "DENIED", "tag is immutable and already exists",
+                        detail={"name": repo, "tag": ref},
+                    )
+                raise
         return web.Response(
             status=201, headers={"Docker-Content-Digest": str(d)}
         )

@@ -20,6 +20,7 @@ from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.p2p.scheduler import Scheduler
 from kraken_tpu.store import CAStore
 from kraken_tpu.utils import httputil
+from kraken_tpu.utils.dedup import TTLCache
 
 
 class ImageTransferer(Protocol):
@@ -44,10 +45,20 @@ class ImageTransferer(Protocol):
 class ReadOnlyTransferer:
     """Agent-side: pulls ride the swarm; pushes are rejected."""
 
-    def __init__(self, store: CAStore, scheduler: Scheduler, tags: TagClient):
+    def __init__(
+        self, store: CAStore, scheduler: Scheduler, tags: TagClient,
+        tag_cache_ttl: float = 30.0,
+    ):
         self.store = store
         self.scheduler = scheduler
         self.tags = tags
+        # Positive-only tag cache: the node-local dockerd re-resolves the
+        # same tag on every pull, and upstream caches tag lookups heavily
+        # (tags are near-immutable in practice). Misses are NOT cached --
+        # a tag pushed a moment ago must appear on the next request.
+        self._tag_cache: TTLCache[Digest] = TTLCache(
+            tag_cache_ttl, max_entries=4096
+        )
 
     async def _ensure_local(self, namespace: str, d: Digest) -> None:
         if not self.store.in_cache(d):
@@ -81,12 +92,18 @@ class ReadOnlyTransferer:
         # None means PROVEN absent (build-index said 404). A transient
         # build-index failure propagates so the registry surface can
         # answer a retryable 5xx instead of a definitive MANIFEST_UNKNOWN.
+        cached = self._tag_cache.get(tag)
+        if cached is not None:
+            return cached
         try:
-            return await self.tags.get(tag)
+            d = await self.tags.get(tag)
         except Exception as e:
             if httputil.is_not_found(e):
                 return None
             raise
+        if d is not None:
+            self._tag_cache.put(tag, d)
+        return d
 
     async def put_tag(self, tag: str, d: Digest) -> None:
         raise PermissionError("agent registry is read-only; push via the proxy")

@@ -45,10 +45,15 @@ class RequestCoalescer(Generic[T]):
 
 
 class TTLCache(Generic[T]):
-    """Tiny TTL cache for interval-style results (e.g. announce lists)."""
+    """Tiny TTL cache for interval-style results (e.g. announce lists).
 
-    def __init__(self, ttl_seconds: float):
+    ``max_entries`` bounds memory for open-ended key spaces (tag names,
+    digests): inserting into a full cache evicts the stalest entry.
+    """
+
+    def __init__(self, ttl_seconds: float, max_entries: int | None = None):
         self.ttl = ttl_seconds
+        self.max_entries = max_entries
         self._entries: dict[Hashable, tuple[float, T]] = {}
 
     def get(self, key: Hashable) -> T | None:
@@ -62,6 +67,13 @@ class TTLCache(Generic[T]):
         return value
 
     def put(self, key: Hashable, value: T) -> None:
+        if (
+            self.max_entries is not None
+            and key not in self._entries
+            and len(self._entries) >= self.max_entries
+        ):
+            oldest = min(self._entries, key=lambda k: self._entries[k][0])
+            del self._entries[oldest]
         self._entries[key] = (time.monotonic(), value)
 
     def invalidate(self, key: Hashable) -> None:

@@ -415,3 +415,69 @@ def test_mount_second_writeback_keeps_pin_until_both_land(tmp_path):
             await tracker.stop()
 
     asyncio.run(main())
+
+
+def test_immutable_tags(tmp_path):
+    """immutable_tags: a tag can never be re-pointed at a different
+    digest (409 from the build-index; the proxy's manifest PUT surfaces
+    the spec's DENIED envelope), while same-digest re-push stays
+    idempotent so docker push retries don't fail."""
+
+    async def main():
+        import json as _json
+
+        from kraken_tpu.buildindex.server import TagClient
+        from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+
+        origin = OriginNode(store_root=str(tmp_path / "o"), dedup=False)
+        await origin.start()
+        ring = Ring(HostList(static=[origin.addr]), max_replica=1)
+        cluster = ClusterClient(ring)
+        bindex = BuildIndexNode(
+            store_root=str(tmp_path / "bi"),
+            origin_cluster=cluster,
+            immutable_tags=True,
+        )
+        await bindex.start()
+        proxy = ProxyNode(origin_cluster=cluster, build_index_addr=bindex.addr)
+        await proxy.start()
+        http = HTTPClient()
+        try:
+            tags = TagClient(bindex.addr)
+            d1 = Digest.from_bytes(b"manifest-one")
+            d2 = Digest.from_bytes(b"manifest-two")
+            await tags.put("repo:v1", d1)
+            await tags.put("repo:v1", d1)  # idempotent re-put
+            with pytest.raises(HTTPError) as e:
+                await tags.put("repo:v1", d2)
+            assert e.value.status == 409
+            assert await tags.get("repo:v1") == d1
+            await tags.close()
+
+            # Registry surface: first push of a tag succeeds; re-pointing
+            # it is the spec's DENIED (403), which docker reports as a
+            # denied push rather than retrying forever.
+            m1 = _json.dumps({"mediaType": "x", "n": 1}).encode()
+            m2 = _json.dumps({"mediaType": "x", "n": 2}).encode()
+            url = f"http://{proxy.addr}/v2/repo/manifests/v2"
+            status, _h, _b = await http.request_full(
+                "PUT", url, data=m1, ok_statuses=(201,)
+            )
+            assert status == 201
+            status, _h, body = await http.request_full(
+                "PUT", url, data=m2, ok_statuses=(403,), retry_5xx=False
+            )
+            err = _json.loads(body)["errors"][0]
+            assert err["code"] == "DENIED", err
+            # Same manifest again: idempotent 201.
+            status, _h, _b = await http.request_full(
+                "PUT", url, data=m1, ok_statuses=(201,)
+            )
+        finally:
+            await http.close()
+            await proxy.stop()
+            await bindex.stop()
+            await origin.stop()
+            await cluster.close()
+
+    asyncio.run(main())

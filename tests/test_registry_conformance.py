@@ -438,7 +438,10 @@ def test_transferer_get_tag_classifies_dependency_errors():
 
     async def main():
         for cls in (ReadOnlyTransferer, ProxyTransferer):
-            t = cls.__new__(cls)  # seam test: only .tags is touched
+            t = cls.__new__(cls)  # seam test: only the tag path is touched
+            from kraken_tpu.utils.dedup import TTLCache
+
+            t._tag_cache = TTLCache(0)
             t.tags = Tags(HTTPError("GET", "http://bi/tags/x", 404))
             assert await t.get_tag("repo:v1") is None
             t.tags = Tags(HTTPError("GET", "http://bi/tags/x", 503))
