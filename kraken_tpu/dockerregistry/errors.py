@@ -150,6 +150,23 @@ async def api_version_middleware(req: web.Request, handler):
     try:
         resp = await handler(req)
     except web.HTTPException as e:
+        if e.status >= 400 and not (e.content_type or "").startswith(
+            "application/json"
+        ):
+            # Router-level errors (no route matched -> aiohttp's plain
+            # "404: Not Found", bad method -> bare 405) never went
+            # through v2_error; envelope them here. An unknown/unrouted
+            # v2 operation is the spec's UNSUPPORTED.
+            code = "UNSUPPORTED" if e.status in (404, 405) else "UNKNOWN"
+            headers = {API_VERSION_HEADER: API_VERSION}
+            if "Allow" in e.headers:
+                headers["Allow"] = e.headers["Allow"]
+            return web.Response(
+                status=e.status,
+                text=error_body(code),
+                content_type="application/json",
+                headers=headers,
+            )
         e.headers[API_VERSION_HEADER] = API_VERSION
         raise
     except Exception:
