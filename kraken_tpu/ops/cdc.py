@@ -157,22 +157,26 @@ def _gear_candidates(data_u8: jax.Array, mask_s: int, mask_l: int):
     ``strict[i]`` means the hash of the 32-byte window ending at ``i``
     (inclusive) hits the strict mask.
 
-    The windowed form: h_i = sum_{j=0..31} gear(b_{i-j}) << j. The gear
-    values come from the arithmetic mix (no gather -- see GEAR comment)
-    and the 32 shifted adds read a single zero-padded buffer at 32
-    offsets, which XLA fuses into one pass over memory (the previous
-    per-shift ``concatenate`` materialized 32 full copies).
+    The windowed form: h_i = sum_{j=0..31} gear(b_{i-j}) << j -- a 32-tap
+    correlation with weights 2^j. Evaluated by LOG-DOUBLING in 5 steps
+    instead of 31 shifted adds: after step k every position holds its
+    last-2^k-term partial sum H_k[i] = sum_{j<2^k} g[i-j] << j, and
+    H_{k+1}[i] = H_k[i] + (H_k[i - 2^k] << 2^k). Same uint32 wraparound
+    arithmetic, 6x fewer strided passes; measured 4.9 -> 9.8 GB/s/chip on
+    v5e (2x -- the remaining cost is the per-step buffer materialization,
+    not op count; PERF.md).
     """
     g = _gear_fn_vec(data_u8.astype(jnp.uint32))  # [L] uint32
     n = g.shape[0]
-    gp = jnp.concatenate([jnp.zeros(_WINDOW - 1, dtype=jnp.uint32), g])
-    h = g
-    for j in range(1, min(_WINDOW, n)):
-        # h_i += gear(b_{i-j}) << j ; slice of the one padded buffer.
-        h = h + (
-            jax.lax.dynamic_slice(gp, (_WINDOW - 1 - j,), (n,))
-            << np.uint32(j)
+    h = jnp.concatenate([jnp.zeros(_WINDOW - 1, dtype=jnp.uint32), g])
+    step = 1
+    while step < _WINDOW:
+        shifted = jnp.concatenate(
+            [jnp.zeros(step, dtype=jnp.uint32), h[:-step]]
         )
+        h = h + (shifted << np.uint32(step))
+        step *= 2
+    h = h[_WINDOW - 1 :]
     strict = (h & np.uint32(mask_s)) == 0
     loose = (h & np.uint32(mask_l)) == 0
     return strict, loose
