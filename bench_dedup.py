@@ -104,29 +104,35 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from kraken_tpu.ops.cdc import _gear_candidates
+    from kraken_tpu.ops.cdc_pallas import _BUF, _ROWS, _T_DISPATCH, _gear_pallas
 
-    n = 1 << 26  # 64 MiB resident
-    dev = jax.random.bits(jax.random.PRNGKey(0), (n,), dtype=jnp.uint8)
+    # The production large-blob path: the Pallas VMEM-doubling kernel,
+    # fed the [T, rows, 128] segment layout with data resident.
+    n = _T_DISPATCH * (_BUF - 1024)
+    dev = jax.random.bits(
+        jax.random.PRNGKey(0), (_T_DISPATCH, _ROWS, 128), dtype=jnp.uint8
+    )
     dev.block_until_ready()
     ms, ml = params.mask_strict, params.mask_loose
 
     def dispatch():
-        return _gear_candidates(dev, ms, ml)[0]
+        return _gear_pallas(dev, ms, ml)[0]
 
-    np.asarray(dispatch()[0])
+    np.asarray(dispatch()[0, 0])
     def timed(k):
         t0 = time.perf_counter()
         out = None
         for _ in range(k):
             out = dispatch()
-        np.asarray(out[0])
+        np.asarray(out[0, 0])
         return time.perf_counter() - t0
+    # The relay's latency jitter (~100s of ms) swamps small marginal
+    # windows; queue 40 extra 64 MiB dispatches (2.5 GB) per trial.
     rates = []
-    for _ in range(3):
-        t_s, t_l = timed(2), timed(12)
-        rates.append(10 * n / max(t_l - t_s, 1e-9) / 1e9)
-    gear_gbps = sorted(rates)[1]
+    for _ in range(5):
+        t_s, t_l = timed(2), timed(42)
+        rates.append(40 * n / max(t_l - t_s, 1e-9) / 1e9)
+    gear_gbps = sorted(rates)[len(rates) // 2]
 
     print(
         json.dumps(

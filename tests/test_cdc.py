@@ -102,3 +102,30 @@ def test_segmented_pass_matches_whole_blob(monkeypatch):
     segmented = chunk(data, P)
     assert segmented == whole
     assert segmented == chunk_reference(data, P)
+
+
+def test_pallas_candidates_match_xla_path():
+    """The Pallas gear kernel (the real-accelerator large-blob path) must
+    produce bit-identical candidate positions to the XLA path -- run here
+    in interpret mode on a buffer spanning segment boundaries, ragged
+    tail included."""
+    from kraken_tpu.ops.cdc import CDCParams, _gear_candidates
+    from kraken_tpu.ops.cdc_pallas import _SEG, candidate_indices_pallas
+
+    import jax.numpy as jnp
+
+    p = CDCParams()
+    rng = np.random.default_rng(11)
+    n = 2 * _SEG + 12_345  # 2 full segments + ragged tail
+    arr = rng.integers(0, 256, size=n, dtype=np.uint8)
+
+    s_idx, l_idx = candidate_indices_pallas(
+        arr, n, p.mask_strict, p.mask_loose, interpret=True
+    )
+    strict, loose = _gear_candidates(
+        jnp.asarray(arr), p.mask_strict, p.mask_loose
+    )
+    want_s = np.flatnonzero(np.asarray(strict))
+    want_l = np.flatnonzero(np.asarray(loose))
+    np.testing.assert_array_equal(s_idx, want_s)
+    np.testing.assert_array_equal(l_idx, want_l)
