@@ -137,6 +137,44 @@ def tpu_rates() -> tuple[float, float, float]:
     return natural, packed_rate, pack_gbps
 
 
+def cdc_gear_rate() -> float:
+    """The dedup plane's Pallas gear kernel (ops/cdc_pallas.py), data
+    resident; large queued batches because the relay's latency jitter
+    swamps small marginal windows."""
+    import jax
+    import jax.numpy as jnp
+
+    from kraken_tpu.ops.cdc import CDCParams
+    from kraken_tpu.ops.cdc_pallas import _ROWS, _T_DISPATCH, _gear_pallas
+
+    p = CDCParams()
+    dev = jax.random.bits(
+        jax.random.PRNGKey(0), (_T_DISPATCH, _ROWS, 128), dtype=jnp.uint8
+    )
+    dev.block_until_ready()
+
+    def dispatch():
+        return _gear_pallas(dev, p.mask_strict, p.mask_loose)[0]
+
+    np.asarray(dispatch()[0, 0])
+    n = _T_DISPATCH * (1 << 18)
+
+    def timed(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = dispatch()
+        np.asarray(out[0, 0])
+        return time.perf_counter() - t0
+
+    rates = []
+    for _ in range(5):
+        t_s, t_l = timed(2), timed(42)
+        rates.append(40 * n / max(t_l - t_s, 1e-9) / 1e9)
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
 def main() -> None:
     cpu = None
     if os.environ.get("BENCH_SKIP_CPU") != "1":
@@ -155,6 +193,7 @@ def main() -> None:
         ctx = contextlib.nullcontext()
     with ctx:
         natural, packed_rate, pack_gbps = tpu_rates()
+        cdc_gbps = cdc_gear_rate()
     print(
         json.dumps(
             {
@@ -164,6 +203,7 @@ def main() -> None:
                 "vs_baseline": round(natural / cpu, 3) if cpu else None,
                 "packed_kernel_gbps": round(packed_rate, 2),
                 "host_pack_gbps_core": round(pack_gbps, 2),
+                "cdc_gear_pallas_gbps": round(cdc_gbps, 2),
             }
         )
     )

@@ -345,9 +345,12 @@ def chunk_host(
         lo = max(0, s - (_WINDOW - 1))
         seg = arr[lo : min(s + _SEGMENT, n)]
         g = GEAR[seg]
+        # Same log-doubling as the device paths: 5 shifted adds, not 31.
         h = g.copy()
-        for j in range(1, min(_WINDOW, len(seg))):
-            h[j:] += g[:-j] << np.uint32(j)
+        step = 1
+        while step < min(_WINDOW, len(seg)):
+            h[step:] += h[:-step].copy() << np.uint32(step)
+            step *= 2
         local = h[s - lo :]
         strict_parts.append(np.flatnonzero((local & ms) == 0) + s)
         loose_parts.append(np.flatnonzero((local & ml) == 0) + s)
