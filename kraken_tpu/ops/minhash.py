@@ -60,6 +60,20 @@ def _score_kernel(query: jax.Array, corpus: jax.Array):
     return jnp.mean((corpus == query[None, :]).astype(jnp.float32), axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_kernel(query: jax.Array, corpus: jax.Array, n_live, k: int):
+    """Score + device-side top-k: only 2k scalars leave the chip instead
+    of the full [N] score vector (4 MB at a 1M corpus -- the transfer,
+    not the scan, dominates brute-query latency on thin links). Padding
+    rows (index >= n_live, a traced scalar: no retrace as the index
+    churns) are masked to -1 so they can never place."""
+    scores = jnp.mean((corpus == query[None, :]).astype(jnp.float32), axis=1)
+    scores = jnp.where(
+        jnp.arange(corpus.shape[0]) < n_live, scores, jnp.float32(-1.0)
+    )
+    return jax.lax.top_k(scores, k)
+
+
 _SCORE_DEVICE_MIN = 4096
 
 
@@ -278,11 +292,15 @@ class LSHIndex:
                 )
                 self._corpus_dev = jnp.asarray(_pad_pow2_rows(rows))
                 self._dev_gen = self._gen
-            scores = np.asarray(
-                _score_kernel(jnp.asarray(query), self._corpus_dev)
-            )[: len(live)]
-        else:
-            scores = _score(query, self._corpus[live])
+            kk = min(k, len(live))
+            top_v, top_i = _topk_kernel(
+                jnp.asarray(query), self._corpus_dev, len(live), kk
+            )
+            return [
+                (self._keys[live[i]], float(v))
+                for i, v in zip(np.asarray(top_i), np.asarray(top_v))
+            ]
+        scores = _score(query, self._corpus[live])
         order = np.argsort(-scores)[:k]
         return [(self._keys[live[i]], float(scores[i])) for i in order]
 
@@ -610,11 +628,15 @@ class CompactLSHIndex:
                 )
                 self._dev_gen = self._gen
             live = self._dev_live
-            scores = np.asarray(
-                _score_kernel(jnp.asarray(query), self._dev)
-            )[: len(live)]
-        else:
-            live = np.flatnonzero(self._alive[: self._n])
-            scores = _score(query, self._mat[live])
+            kk = min(k, len(live))
+            top_v, top_i = _topk_kernel(
+                jnp.asarray(query), self._dev, len(live), kk
+            )
+            return [
+                (self._keys[live[i]], float(v))
+                for i, v in zip(np.asarray(top_i), np.asarray(top_v))
+            ]
+        live = np.flatnonzero(self._alive[: self._n])
+        scores = _score(query, self._mat[live])
         order = np.argsort(-scores)[:k]
         return [(self._keys[live[i]], float(scores[i])) for i in order]

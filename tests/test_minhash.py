@@ -207,3 +207,38 @@ def test_compact_index_budget_evicts_oldest():
     tiny = CompactLSHIndex(mh, num_bands=16, budget_bytes=1000)
     with pytest.raises(BudgetExceeded):
         tiny.add(0, sk[0])
+
+
+def test_query_brute_device_topk_matches_host():
+    """Above _SCORE_DEVICE_MIN the brute scan runs on device with an
+    on-device top-k (only 2k scalars leave the chip). Results must equal
+    the host argsort ordering, tombstones and padded rows excluded."""
+    from kraken_tpu.ops.minhash import _SCORE_DEVICE_MIN, LSHIndex, MinHasher
+
+    rng = np.random.default_rng(3)
+    hasher = MinHasher(num_hashes=16, seed=1)
+    idx = LSHIndex(hasher, num_bands=4)
+    n = _SCORE_DEVICE_MIN + 700  # force the device path, non-pow2 live set
+    sketches = rng.integers(0, 1 << 32, size=(n, 16), dtype=np.uint64).astype(
+        np.uint32
+    )
+    for i in range(n):
+        idx.add(f"k{i}", sketches[i])
+    # Tombstone a few, including what would be a top hit.
+    query = sketches[123].copy()
+    idx.remove("k123")
+    idx.remove("k5000")
+
+    got = idx.query_brute(query, k=5)
+    # Host oracle over the live rows.
+    live_keys = [f"k{i}" for i in range(n) if i not in (123, 5000)]
+    live_rows = np.stack(
+        [sketches[i] for i in range(n) if i not in (123, 5000)]
+    )
+    scores = np.mean(live_rows == query[None, :], axis=1)
+    order = np.argsort(-scores, kind="stable")[:5]
+    want_scores = [float(scores[i]) for i in order]
+    got_scores = [s for _k, s in got]
+    assert got_scores == pytest.approx(want_scores)
+    # The top hit's key must match (ties below can legitimately reorder).
+    assert got[0][0] == live_keys[order[0]]
