@@ -53,18 +53,32 @@ class TagServer:
         # guarantee that makes aggressive tag caching sound and prevents
         # a re-tagged name from silently changing what hosts run.
         self.immutable = immutable
+        # One lock serializes check+put: without it two concurrent PUTs
+        # with different digests could both pass the immutability check
+        # in the await gap before either write lands.
+        self._put_lock = asyncio.Lock()
         self._http = HTTPClient()
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
 
-    async def _check_mutation(self, tag: str, d: Digest) -> None:
+    async def _checked_put(self, tag: str, d: Digest) -> None:
+        """store.put, guarded by the immutability check when enabled.
+
+        The check reads through to the BACKEND (store.get), not just
+        local disk: a build-index rescheduled onto a fresh volume must
+        still refuse to re-point a tag that exists durably -- the silent
+        re-tag is exactly what the feature prevents."""
         if not self.immutable:
+            await self.store.put(tag, d)
             return
-        existing = self.store.get_local(tag)
-        if existing is not None and existing != d:
-            raise web.HTTPConflict(
-                text=f"tag is immutable: {tag} -> {existing}"
-            )
+        ns = tag.rpartition(":")[0] or tag
+        async with self._put_lock:
+            existing = await self.store.get(tag, ns)
+            if existing is not None and existing != d:
+                raise web.HTTPConflict(
+                    text=f"tag is immutable: {tag} -> {existing}"
+                )
+            await self.store.put(tag, d)
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=1 << 26)
@@ -87,14 +101,12 @@ class TagServer:
 
     async def _put(self, req: web.Request) -> web.Response:
         tag, d = self._parse(req)
-        await self._check_mutation(tag, d)
-        await self.store.put(tag, d)
+        await self._checked_put(tag, d)
         return web.Response(status=200)
 
     async def _put_and_replicate(self, req: web.Request) -> web.Response:
         tag, d = self._parse(req)
-        await self._check_mutation(tag, d)
-        await self.store.put(tag, d)
+        await self._checked_put(tag, d)
         if self.retry is not None:
             deps = await self.resolver.resolve(tag.rpartition(":")[0] or tag, tag, d)
             for remote in self.remotes:
@@ -134,10 +146,7 @@ class TagServer:
             deps = [Digest.from_hex(x) for x in doc.get("dependencies", [])]
         except (json.JSONDecodeError, KeyError, ValueError) as e:
             raise web.HTTPBadRequest(text=f"malformed replication: {e}")
-        # Two clusters minting the same tag differently is a config error;
-        # refusing (409) keeps it visible in the source's retry queue
-        # instead of letting last-writer-wins corrupt either side.
-        await self._check_mutation(tag, d)
+
         # Pre-fetch dependency blobs into this cluster's origins (repair
         # path pulls them from the remote cluster's backend on miss).
         if self.origin_cluster is not None:
@@ -147,7 +156,10 @@ class TagServer:
                     await self.origin_cluster.stat(ns, dep)
                 except Exception:
                     pass  # best-effort preheat
-        await self.store.put(tag, d)
+        # Two clusters minting the same tag differently is a config
+        # error; refusing (409) keeps it visible in the source's retry
+        # queue instead of letting last-writer-wins corrupt either side.
+        await self._checked_put(tag, d)
         return web.Response(status=200)
 
     async def _get(self, req: web.Request) -> web.Response:

@@ -231,10 +231,12 @@ def _candidate_indices(
     arr: np.ndarray, n: int, params: CDCParams
 ) -> tuple[np.ndarray, np.ndarray]:
     """Global strict/loose candidate positions over ``arr[:n]``."""
-    if n > _SEGMENT and jax.default_backend() != "cpu":
-        # Real accelerator + enough bytes to amortize: the Pallas kernel
-        # (VMEM-resident doubling, ~55 GB/s/chip median vs ~10 for the
-        # XLA path on v5e; bit-identical candidates).
+    if n > _SEGMENT and jax.default_backend() == "tpu":
+        # TPU + enough bytes to amortize: the Pallas kernel (VMEM-
+        # resident doubling, ~55 GB/s/chip median vs ~10 for the XLA
+        # path on v5e; bit-identical candidates). Strictly "tpu": the
+        # kernel's pltpu BlockSpecs cannot lower on GPU backends, where
+        # the XLA path below works fine.
         from kraken_tpu.ops.cdc_pallas import candidate_indices_pallas
 
         return candidate_indices_pallas(

@@ -37,9 +37,24 @@ _T_DISPATCH = 256       # segments per pallas_call (64 MiB data, 1 jit
                         # entry; large groups amortize per-call overhead)
 
 
-def _make_kernel(mask_s: int, mask_l: int):
+def _make_kernel(mask_s: int, mask_l: int, first_group: bool):
     def kernel(d_ref, s_ref, l_ref):
         g = _gear_fn_vec(d_ref[0].astype(jnp.uint32))  # [_ROWS, 128]
+        # Padding lanes must contribute ZERO history in g-domain --
+        # gear(0) != 0, so zero BYTES are not enough (the XLA path pads
+        # with uint32 zeros after the gear map; matching it exactly is
+        # the bit-identity contract). Real history in the lead region is
+        # only its last 31 lanes -- and none at all in the blob's first
+        # segment.
+        flat = (
+            jax.lax.broadcasted_iota(jnp.int32, (_ROWS, 128), 0) * 128
+            + jax.lax.broadcasted_iota(jnp.int32, (_ROWS, 128), 1)
+        )
+        cut = jnp.where(
+            (pl.program_id(0) == 0) if first_group else False,
+            _LEAD, _LEAD - _PAD,
+        )
+        g = jnp.where(flat < cut, jnp.uint32(0), g)
         h = g
         step = 1
         while step < _WINDOW:
@@ -58,13 +73,20 @@ def _make_kernel(mask_s: int, mask_l: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l", "interpret"))
-def _gear_pallas(segs_u8, mask_s: int, mask_l: int, interpret: bool = False):
+@functools.partial(
+    jax.jit,
+    static_argnames=("mask_s", "mask_l", "first_group", "interpret"),
+)
+def _gear_pallas(
+    segs_u8, mask_s: int, mask_l: int,
+    first_group: bool = False, interpret: bool = False,
+):
     """segs_u8: [T, _ROWS, 128] uint8 -> (strict, loose) [T, _SEG/128, 128]
-    uint8 masks."""
+    uint8 masks. ``first_group``: this dispatch's segment 0 is the BLOB's
+    first segment (its whole lead region is padding, not overlap)."""
     t = segs_u8.shape[0]
     return pl.pallas_call(
-        _make_kernel(mask_s, mask_l),
+        _make_kernel(mask_s, mask_l, first_group),
         interpret=interpret,
         grid=(t,),
         in_specs=[
@@ -102,18 +124,27 @@ def candidate_indices_pallas(
     loose_parts: list[np.ndarray] = []
     for group in range(0, nseg, _T_DISPATCH):
         t = min(_T_DISPATCH, nseg - group)
-        segs = np.zeros((_T_DISPATCH, _BUF), dtype=np.uint8)
+        # Dispatch size buckets to powers of two (bounded jit cache, same
+        # trick as cdc.py's small-blob path): a 5 MiB blob must not pay a
+        # fixed 64 MiB staging + transfer + fetch-back round.
+        t_disp = 16
+        while t_disp < t:
+            t_disp *= 2
+        segs = np.zeros((t_disp, _BUF), dtype=np.uint8)
         for i in range(t):
             s = (group + i) * _SEG
             lo = max(0, s - _PAD)
             chunk = arr[lo : min(s + _SEG, n)]
             segs[i, _LEAD - (s - lo) : _LEAD - (s - lo) + len(chunk)] = chunk
         strict, loose = _gear_pallas(
-            jnp.asarray(segs.reshape(_T_DISPATCH, _ROWS, 128)),
-            mask_s, mask_l, interpret=interpret,
+            jnp.asarray(segs.reshape(t_disp, _ROWS, 128)),
+            mask_s, mask_l,
+            first_group=(group == 0), interpret=interpret,
         )
-        strict = np.asarray(strict).reshape(_T_DISPATCH, _SEG)
-        loose = np.asarray(loose).reshape(_T_DISPATCH, _SEG)
+        # Slice to live segments ON DEVICE: fetching the padded rows back
+        # would double the D2H bytes for ragged tails.
+        strict = np.asarray(strict[:t]).reshape(t, _SEG)
+        loose = np.asarray(loose[:t]).reshape(t, _SEG)
         for i in range(t):
             s = (group + i) * _SEG
             valid = min(_SEG, n - s)

@@ -109,7 +109,7 @@ def test_pallas_candidates_match_xla_path():
     produce bit-identical candidate positions to the XLA path -- run here
     in interpret mode on a buffer spanning segment boundaries, ragged
     tail included."""
-    from kraken_tpu.ops.cdc import CDCParams, _gear_candidates
+    from kraken_tpu.ops.cdc import CDCParams, _WINDOW, _gear_candidates
     from kraken_tpu.ops.cdc_pallas import _SEG, candidate_indices_pallas
 
     import jax.numpy as jnp
@@ -118,6 +118,22 @@ def test_pallas_candidates_match_xla_path():
     rng = np.random.default_rng(11)
     n = 2 * _SEG + 12_345  # 2 full segments + ragged tail
     arr = rng.integers(0, 256, size=n, dtype=np.uint8)
+    # Plant a prefix whose ZERO-HISTORY hash hits the loose mask inside
+    # the first 31 positions -- the window where the kernel's lead-
+    # padding handling could diverge from the XLA path's g-domain zero
+    # padding (it did, via gear(0) != 0, until round 4 masked the lead).
+    for seed in range(10_000):
+        prefix = np.random.default_rng(seed).integers(
+            0, 256, size=_WINDOW - 1, dtype=np.uint8
+        )
+        _s, early_loose = _gear_candidates(
+            jnp.asarray(prefix), p.mask_strict, p.mask_loose
+        )
+        if np.asarray(early_loose).any():
+            arr[: _WINDOW - 1] = prefix
+            break
+    else:
+        raise AssertionError("no early-candidate prefix found")
 
     s_idx, l_idx = candidate_indices_pallas(
         arr, n, p.mask_strict, p.mask_loose, interpret=True

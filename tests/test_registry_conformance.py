@@ -483,11 +483,6 @@ def test_error_envelope_on_randomized_garbage():
                 async with rig.http.request(
                     method, rig.base + path, data=body
                 ) as r:
-                    if r.status < 400 or r.status == 405 and not r.headers.get("Content-Type", "").startswith("application/json"):
-                        # 2xx/3xx fine; a 405 from aiohttp's ROUTER (not
-                        # our handlers) predates the middleware's scope
-                        # only if it lacked the envelope -- flagged below.
-                        pass
                     if r.status >= 400:
                         assert (
                             r.headers.get("Docker-Distribution-API-Version")

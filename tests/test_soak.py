@@ -99,9 +99,13 @@ async def _drive(tmp_path):
             await asyncio.sleep(0.25)
 
     async def puller(agent, name):
-        """Pull everything that exists, repeatedly, verifying bytes."""
+        """Pull everything that exists, repeatedly, verifying bytes.
+        Exits once the uploader has finished AND every blob that actually
+        landed has been pulled -- gating on BLOBS would spin until the
+        outer timeout if an upload failed, and that timeout would mask
+        the collected error details."""
         seen: set[str] = set()
-        while len(seen) < BLOBS or uploading.done() is False:
+        while not (uploading.done() and seen >= uploaded.keys()):
             for hexd, blob in list(uploaded.items()):
                 try:
                     got = await http.get(
