@@ -33,6 +33,7 @@ from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
+from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import FailureMeter, instrument_app
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
@@ -183,6 +184,7 @@ class OriginNode:
         health_interval_seconds: float = 5.0,
         health_fail_threshold: int = 3,
         scheduler_config_doc: dict | None = None,
+        p2p_bandwidth: dict | None = None,
         ssl_context=None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
@@ -230,6 +232,12 @@ class OriginNode:
         self.health_interval = health_interval_seconds
         self.health_fail_threshold = health_fail_threshold
         self._scheduler_doc = scheduler_config_doc
+        # YAML p2p_bandwidth: {egress_bps, ingress_bps[, burst]} -- one
+        # limiter shared by every conn shapes this HOST's piece traffic
+        # (the reference caps per-host agent bandwidth the same way).
+        self.p2p_bandwidth = (
+            BandwidthLimiter(**p2p_bandwidth) if p2p_bandwidth else None
+        )
         self.ssl_context = ssl_context
         self.monitor: Optional[ActiveMonitor] = None
         self.scheduler: Optional[Scheduler] = None
@@ -286,6 +294,7 @@ class OriginNode:
             is_origin=True,
             metainfo_resolver=self._resolve_metainfo,
             config=self.build_scheduler_config(self._scheduler_doc),
+            bandwidth=self.p2p_bandwidth,
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port
@@ -608,6 +617,7 @@ class AgentNode:
         hasher: str = "cpu",
         cleanup: CleanupConfig | None = None,
         scheduler_config: SchedulerConfig | None = None,
+        p2p_bandwidth: dict | None = None,
         ssl_context=None,
     ):
         self.host = host
@@ -624,6 +634,9 @@ class AgentNode:
             else None
         )
         self.scheduler_config = scheduler_config
+        self.p2p_bandwidth = (
+            BandwidthLimiter(**p2p_bandwidth) if p2p_bandwidth else None
+        )
         self.ssl_context = ssl_context
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[AgentServer] = None
@@ -671,6 +684,7 @@ class AgentNode:
             metainfo_client=self._tracker_client,
             announce_client=self._tracker_client,
             config=self.scheduler_config,
+            bandwidth=self.p2p_bandwidth,
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port

@@ -292,6 +292,7 @@ def main(argv: list[str] | None = None) -> None:
             dedup_index=cfg.get("dedup_index", "dict"),
             dedup_budget_bytes=cfg.get("dedup_budget_bytes"),
             scheduler_config_doc=cfg.get("scheduler"),
+            p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
         )
         asyncio.run(
@@ -322,6 +323,7 @@ def main(argv: list[str] | None = None) -> None:
                 SchedulerConfig.from_dict(scheduler_cfg)
                 if scheduler_cfg else None
             ),
+            p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
         )
         asyncio.run(

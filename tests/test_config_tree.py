@@ -23,7 +23,7 @@ CLI_KEYS = {
     "cleanup", "tls", "tls_client", "scheduler", "origins",
     "announce_interval_seconds", "peer_ttl_seconds", "peerstore_redis",
     "registry_port", "build_index", "spool", "remotes", "dedup_index",
-    "dedup_budget_bytes", "extends", "immutable_tags",
+    "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
 }
 
 
