@@ -137,6 +137,55 @@ def tpu_rates() -> tuple[float, float, float]:
     return natural, packed_rate, pack_gbps
 
 
+def natural_chained_gbps() -> float:
+    """Natural path, CHAINED: each dispatch's input folds in the previous
+    digest, so every execution is distinct and data-dependent. This
+    defeats two relay pathologies the plain marginal method is exposed
+    to (observed 2026-07-30: a 41.6 and a physically impossible 132
+    GB/s in consecutive runs -- the rounds-only ceiling is ~105):
+    queued-replay coalescing of identical executions, and latency jitter
+    between the timing fences. Chained runs cluster within ~3%."""
+    import jax
+    import jax.numpy as jnp
+
+    from kraken_tpu.ops.sha256 import _pad_block_for
+    from kraken_tpu.ops.sha256_pallas import N_TILE, sha256_tiles
+
+    pad = jnp.asarray(_pad_block_for(PIECE_LEN))
+
+    @jax.jit
+    def step(x):
+        d = sha256_tiles(x, pad, PIECE_LEN // 64)
+        first = jax.lax.bitcast_convert_type(d[0], jnp.uint8).reshape(-1)
+        return jax.lax.dynamic_update_slice(x, first[None, :], (0, 0)), d
+
+    x = jax.random.bits(
+        jax.random.PRNGKey(0), (N_TILE, PIECE_LEN), dtype=jnp.uint8
+    )
+    x.block_until_ready()
+    x, d = step(x)
+    jax.block_until_ready((x, d))
+
+    def timed(k: int, x):
+        t0 = time.perf_counter()
+        d = None
+        for _ in range(k):
+            x, d = step(x)
+        np.asarray(d[0, 0])
+        return time.perf_counter() - t0, x
+
+    rates = []
+    for _ in range(REPS):
+        t_s, x = timed(K_SMALL, x)
+        t_l, x = timed(K_LARGE, x)
+        rates.append(
+            (K_LARGE - K_SMALL) * N_TILE * PIECE_LEN
+            / max(t_l - t_s, 1e-9) / 1e9
+        )
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
 def cdc_gear_rate() -> float:
     """The dedup plane's Pallas gear kernel (ops/cdc_pallas.py), data
     resident; large queued batches because the relay's latency jitter
@@ -193,14 +242,24 @@ def main() -> None:
         ctx = contextlib.nullcontext()
     with ctx:
         natural, packed_rate, pack_gbps = tpu_rates()
+        chained = natural_chained_gbps()
         cdc_gbps = cdc_gear_rate()
+    # The plain marginal `natural` is kept as `value` for round-over-round
+    # comparability, but it is exposed to relay replay-coalescing /
+    # jitter (see natural_chained_gbps); when the two disagree by >25%,
+    # report the robust chained number as the headline instead.
+    headline = natural
+    if chained > 0 and abs(natural - chained) / chained > 0.25:
+        headline = chained
     print(
         json.dumps(
             {
                 "metric": "batched_sha256_metainfo_gen",
-                "value": round(natural, 3),
+                "value": round(headline, 3),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(natural / cpu, 3) if cpu else None,
+                "vs_baseline": round(headline / cpu, 3) if cpu else None,
+                "natural_marginal_gbps": round(natural, 2),
+                "natural_chained_gbps": round(chained, 2),
                 "packed_kernel_gbps": round(packed_rate, 2),
                 "host_pack_gbps_core": round(pack_gbps, 2),
                 "cdc_gear_pallas_gbps": round(cdc_gbps, 2),
