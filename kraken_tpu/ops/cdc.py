@@ -233,7 +233,7 @@ def _candidate_indices(
     """Global strict/loose candidate positions over ``arr[:n]``."""
     if n > _SEGMENT and jax.default_backend() == "tpu":
         # TPU + enough bytes to amortize: the Pallas kernel (VMEM-
-        # resident doubling, ~55 GB/s/chip median vs ~10 for the XLA
+        # resident doubling, ~43 GB/s/chip chained vs ~10 for the XLA
         # path on v5e; bit-identical candidates). Strictly "tpu": the
         # kernel's pltpu BlockSpecs cannot lower on GPU backends, where
         # the XLA path below works fine.

@@ -4,8 +4,9 @@ The XLA evaluation of the windowed gear sum (ops/cdc.py
 ``_gear_candidates``) round-trips every doubling step through HBM --
 ~40 B of HBM traffic per input byte -- capping it at ~10 GB/s/chip. This
 kernel keeps all five doubling steps in VMEM and measured
-**~55 GB/s/chip** median on v5e (5.6x; 44-62 band across runs on the
-jittery relay rig -- PERF.md), bit-identical output.
+**~43 GB/s/chip** with the robust chained method (44-62 with the
+jitter-exposed marginal method; either way ~4-5x the XLA path --
+PERF.md), bit-identical output.
 
 Layout: bytes ride as [rows, 128] lane tiles in flat row-major order, so
 a flat shift by ``step < 128`` is a lane-concat of each row's head with
