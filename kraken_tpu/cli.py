@@ -128,6 +128,19 @@ def main(argv: list[str] | None = None) -> None:
                       help="comma-separated remote build-index addrs"
                            " (cross-cluster tag replication)")
 
+    p_scrub = sub.add_parser(
+        "scrub", help="offline store integrity scrub (exit 1 on corruption)"
+    )
+    p_scrub.add_argument("--store", required=True)
+
+    p_locate = sub.add_parser(
+        "locate", help="print a digest's ring placement offline"
+    )
+    p_locate.add_argument("--cluster", required=True,
+                          help="comma-separated origin addrs")
+    p_locate.add_argument("--digest", required=True)
+    p_locate.add_argument("--max-replica", type=int, default=3)
+
     p_proxy = sub.add_parser("proxy")
     _common(p_proxy)
     p_proxy.add_argument("--origins", default=None,
@@ -139,6 +152,60 @@ def main(argv: list[str] | None = None) -> None:
                               " proxy restarts (docker push resumes)")
 
     args = parser.parse_args(argv)
+
+    # Offline operator tools: no config/logging machinery needed.
+    if args.component == "scrub":
+        # Offline store integrity scrub: re-hash every cached blob through
+        # the configured PieceHasher-backed digest path and report
+        # corruption. CAS semantics make this exact -- a blob's name IS
+        # its digest. Exit 1 if anything fails verification (cron-able).
+        import sys
+
+        from kraken_tpu.core.digest import Digest
+        from kraken_tpu.store import CAStore
+
+        store = CAStore(args.store)
+        bad: list[str] = []
+        digests = store.list_cache_digests()
+        for d in digests:
+            with open(store.cache_path(d), "rb") as f:
+                actual = Digest.from_reader(f)
+            if actual != d:
+                bad.append(d.hex)
+                print(json.dumps({
+                    "event": "corrupt", "digest": d.hex,
+                    "actual": actual.hex,
+                }), flush=True)
+        print(json.dumps({
+            "event": "scrub_done", "checked": len(digests),
+            "corrupt": len(bad),
+        }), flush=True)
+        if bad:
+            sys.exit(1)
+        return
+
+
+    if args.component == "locate":
+        # Where does the ring place a digest? The operator's "which
+        # origins own this blob" question, answered offline with the
+        # same rendezvous-hash code the cluster runs.
+        from kraken_tpu.core.digest import Digest
+        from kraken_tpu.placement import HostList, Ring
+
+        addrs = [a for a in (args.cluster or "").split(",") if a]
+        if not addrs:
+            parser.error("locate requires --cluster")
+        ring = Ring(
+            HostList(static=addrs), max_replica=args.max_replica
+        )
+        d = Digest.from_str(args.digest)
+        print(json.dumps({
+            "digest": d.hex,
+            "replicas": ring.locations(d),
+            "members": sorted(ring.members),
+        }))
+        return
+
     cfg = load_config(args.config) if args.config else {}
     setup_json_logging(args.component)
 
@@ -366,6 +433,7 @@ def main(argv: list[str] | None = None) -> None:
             spool_root=pick(args.spool, "spool", None),
         )
         asyncio.run(_run_until_signal(node, {"component": "proxy"}))
+
 
 
 if __name__ == "__main__":

@@ -302,3 +302,55 @@ def test_proxy_crash_resumes_upload_session(tmp_path):
                     assert body["errors"][0]["code"] == "BLOB_UPLOAD_UNKNOWN"
 
         asyncio.run(drive())
+
+
+def test_scrub_and_locate_tools(tmp_path):
+    """Operator tools: `scrub` re-hashes every cached blob (exit 1 +
+    corrupt-event line on bit rot), `locate` answers ring placement
+    offline with the production rendezvous code."""
+    import hashlib
+
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.store import CAStore
+
+    store = CAStore(str(tmp_path / "s"))
+    blobs = [os.urandom(10_000) for _ in range(3)]
+    for b in blobs:
+        store.create_cache_file(Digest.from_bytes(b), iter([b]))
+
+    def run(*cli_args):
+        return subprocess.run(
+            [sys.executable, "-m", "kraken_tpu.cli", *cli_args],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+        )
+
+    r = run("scrub", "--store", str(tmp_path / "s"))
+    assert r.returncode == 0, r.stderr
+    done = json.loads(r.stdout.strip().splitlines()[-1])
+    assert done == {"event": "scrub_done", "checked": 3, "corrupt": 0}
+
+    # Flip one byte of one cached blob: scrub must name it and exit 1.
+    victim = Digest.from_bytes(blobs[0])
+    path = store.cache_path(victim)
+    raw = bytearray(open(path, "rb").read())
+    raw[1234] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    r = run("scrub", "--store", str(tmp_path / "s"))
+    assert r.returncode == 1
+    events = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+    assert {"event": "corrupt", "digest": victim.hex,
+            "actual": Digest.from_bytes(bytes(raw)).hex} in events
+    assert events[-1]["corrupt"] == 1
+
+    # locate agrees with an in-process Ring over the same members.
+    from kraken_tpu.placement import HostList, Ring
+
+    addrs = ["a:1", "b:2", "c:3", "d:4"]
+    r = run("locate", "--cluster", ",".join(addrs),
+            "--digest", victim.hex, "--max-replica", "2")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    ring = Ring(HostList(static=addrs), max_replica=2)
+    assert out["replicas"] == ring.locations(victim)
+    assert len(out["replicas"]) == 2
