@@ -189,8 +189,10 @@ def main(argv: list[str] | None = None) -> None:
         # Where does the ring place a digest? The operator's "which
         # origins own this blob" question, answered offline with the
         # same rendezvous-hash code the cluster runs.
+        # NOTE: no local placement import here -- a function-local
+        # `from ... import Ring` would make Ring a LOCAL of main() and
+        # break every other branch's use of the module-level name.
         from kraken_tpu.core.digest import Digest
-        from kraken_tpu.placement import HostList, Ring
 
         addrs = [a for a in (args.cluster or "").split(",") if a]
         if not addrs:
