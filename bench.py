@@ -225,9 +225,11 @@ def cdc_gear_rate() -> float:
         return time.perf_counter() - t0, x
 
     rates = []
-    for _ in range(5):
-        # 200 extra 64 MiB dispatches (~13 GB) per trial: the work must
-        # dwarf the relay's 100s-of-ms fence jitter or trials go wild.
+    # Chain lengths sized to THIS kernel's 64 MiB dispatch (vs the SHA
+    # path's 256 MiB): 200 extra dispatches ≈ 13 GB per trial, enough to
+    # dwarf the relay's 100s-of-ms fence jitter. REPS is shared with the
+    # other measurements (BENCH_REPS).
+    for _ in range(REPS):
         t_s, x = timed(2, x)
         t_l, x = timed(202, x)
         rates.append(200 * n / max(t_l - t_s, 1e-9) / 1e9)
@@ -259,7 +261,7 @@ def main() -> None:
     # (~3% spread) on this relay; the plain marginal is exposed to
     # replay-coalescing / fence jitter (observed 31-132 GB/s swings on
     # unchanged code) and rides along for cross-round comparability.
-    headline = chained if chained > 0 else natural
+    headline = chained
     print(
         json.dumps(
             {

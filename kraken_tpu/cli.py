@@ -159,11 +159,21 @@ def main(argv: list[str] | None = None) -> None:
         # the configured PieceHasher-backed digest path and report
         # corruption. CAS semantics make this exact -- a blob's name IS
         # its digest. Exit 1 if anything fails verification (cron-able).
+        import os
         import sys
 
         from kraken_tpu.core.digest import Digest
         from kraken_tpu.store import CAStore
 
+        # Refuse a nonexistent root: CAStore would CREATE the directory
+        # tree, so a typo'd path would scrub an empty store, report
+        # "0 corrupt", exit 0 forever, and mask the misconfiguration.
+        if not os.path.isdir(args.store):
+            print(json.dumps({
+                "event": "error",
+                "message": f"store root does not exist: {args.store}",
+            }), flush=True)
+            sys.exit(2)
         store = CAStore(args.store)
         bad: list[str] = []
         digests = store.list_cache_digests()

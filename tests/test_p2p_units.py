@@ -506,8 +506,6 @@ def test_p2p_bandwidth_cap_shapes_transfer(tmp_path):
     ~1 MiB/s limiter cannot finish in well under a second (uncapped, this
     rig moves it in <100 ms). Wired exactly as the CLI does -- the
     scheduler's shared BandwidthLimiter shaping every conn."""
-    import numpy as np
-
     from kraken_tpu.utils.bandwidth import BandwidthLimiter
     from tests.test_swarm import (
         FakeTracker, NS, make_metainfo, make_peer, start_all, stop_all,
