@@ -128,6 +128,13 @@ def main(argv: list[str] | None = None) -> None:
                       help="comma-separated remote build-index addrs"
                            " (cross-cluster tag replication)")
 
+    p_testfs = sub.add_parser(
+        "testfs", help="the fake-backend HTTP file server as a process"
+        " (the reference's tools/bin/testfs)"
+    )
+    p_testfs.add_argument("--host", default="127.0.0.1")
+    p_testfs.add_argument("--port", type=int, default=0)
+
     p_scrub = sub.add_parser(
         "scrub", help="offline store integrity scrub (exit 1 on corruption)"
     )
@@ -152,6 +159,27 @@ def main(argv: list[str] | None = None) -> None:
                               " proxy restarts (docker push resumes)")
 
     args = parser.parse_args(argv)
+
+    if args.component == "testfs":
+        # The reference ships tools/bin/testfs: the fake backend as a
+        # standalone process, so herds in other languages/environments
+        # can point a `testfs` backend entry at it. READY-line contract
+        # matches the five components.
+        from kraken_tpu.backend.testfs import TestFSServer
+
+        async def _run_testfs() -> None:
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            async with TestFSServer(port=args.port, host=args.host) as srv:
+                print("READY " + json.dumps(
+                    {"component": "testfs", "addr": srv.addr}
+                ), flush=True)
+                await stop.wait()
+
+        asyncio.run(_run_testfs())
+        return
 
     # Offline operator tools: no config/logging machinery needed.
     if args.component == "scrub":

@@ -354,3 +354,54 @@ def test_scrub_and_locate_tools(tmp_path):
     ring = Ring(HostList(static=addrs), max_replica=2)
     assert out["replicas"] == ring.locations(victim)
     assert len(out["replicas"]) == 2
+
+
+def test_testfs_process_serves_origin_backend(tmp_path):
+    """tools/bin/testfs parity: the fake backend as a standalone process,
+    with an origin's `testfs` backend entry pointed at it -- writeback
+    lands there, and a locally-evicted blob restores from it."""
+    import asyncio as aio
+
+    from kraken_tpu.backend import Manager as BackendManager
+    from kraken_tpu.assembly import OriginNode
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.origin.client import BlobClient
+
+    with herd() as procs:
+        tfs, info = spawn(["testfs"])
+        procs.append(tfs)
+
+        async def drive():
+            backends = BackendManager([{
+                "namespace": ".*", "backend": "testfs",
+                "config": {"addr": info["addr"]},
+            }])
+            origin = OriginNode(
+                store_root=str(tmp_path / "o"), backends=backends,
+                dedup=False,
+            )
+            await origin.start()
+            oc = BlobClient(origin.addr)
+            try:
+                blob = os.urandom(64_000)
+                d = Digest.from_bytes(blob)
+                await oc.upload("ns", d, blob)
+                for _ in range(50):
+                    await origin.retry.run_once()
+                    be = backends.get_client("ns")
+                    try:
+                        if await be.download("ns", d.hex) == blob:
+                            break
+                    except Exception:
+                        pass
+                    await aio.sleep(0.05)
+                else:
+                    raise AssertionError("writeback to testfs never landed")
+                origin.store.delete_cache_file(d)
+                await origin.refresher.refresh("ns", d)
+                assert origin.store.read_cache_file(d) == blob
+            finally:
+                await oc.close()
+                await origin.stop()
+
+        asyncio.run(drive())
