@@ -250,6 +250,37 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
             charset="utf-8",
         )
 
+    async def stacks_endpoint(request):
+        # The pprof-goroutine-dump equivalent (the reference exposes Go
+        # pprof on its muxes -- SURVEY.md SS5): every thread's stack plus
+        # every live asyncio task, for diagnosing a wedged component
+        # WITHOUT restarting it. Text, greppable, no state mutated.
+        import asyncio
+        import sys
+        import traceback
+
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f"=== thread {tid} ===")
+            out.extend(
+                ln.rstrip() for ln in traceback.format_stack(frame)
+            )
+        try:
+            tasks = asyncio.all_tasks()
+        except RuntimeError:
+            tasks = set()
+        out.append(f"=== asyncio tasks: {len(tasks)} ===")
+        for t in sorted(tasks, key=lambda t: t.get_name()):
+            out.append(f"--- {t.get_name()} done={t.done()} ---")
+            stack = t.get_stack(limit=6)
+            for f in stack:
+                out.append(
+                    f"  {f.f_code.co_filename}:{f.f_lineno} "
+                    f"{f.f_code.co_name}"
+                )
+        return web.Response(text="\n".join(out), content_type="text/plain")
+
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/stacks", stacks_endpoint)
     return app

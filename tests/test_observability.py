@@ -218,3 +218,30 @@ def test_announce_failures_metered_when_tracker_dies(tmp_path):
             await teardown(tracker, origins, agents, cluster)
 
     asyncio.run(main())
+
+
+def test_debug_stacks_endpoint(tmp_path):
+    """/debug/stacks (the pprof-goroutine-dump equivalent) lists thread
+    stacks and live asyncio tasks on every instrumented component."""
+    import aiohttp
+
+    from kraken_tpu.assembly import TrackerNode
+
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                    f"http://{tracker.addr}/debug/stacks"
+                ) as r:
+                    assert r.status == 200
+                    text = await r.text()
+            assert "=== thread" in text
+            assert "=== asyncio tasks:" in text
+            # The serving task itself shows up with a file:line frame.
+            assert ".py:" in text
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())
