@@ -172,6 +172,10 @@ def main(argv: list[str] | None = None) -> None:
             loop = asyncio.get_running_loop()
             for sig in (signal.SIGINT, signal.SIGTERM):
                 loop.add_signal_handler(sig, stop.set)
+            # Herd-wide SIGHUP reloads must not kill the fake backend
+            # (unhandled SIGHUP's default action is termination; there
+            # is no config to reload here).
+            loop.add_signal_handler(signal.SIGHUP, lambda: None)
             async with TestFSServer(port=args.port, host=args.host) as srv:
                 print("READY " + json.dumps(
                     {"component": "testfs", "addr": srv.addr}
