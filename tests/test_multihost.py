@@ -43,8 +43,7 @@ def _spawn(proc: int, n: int, port: int) -> subprocess.Popen:
     )
 
 
-def test_two_host_hash_plane_collective():
-    n = 2
+def _drive(n: int, want_digests: int):
     port = _free_port()
     procs = [_spawn(p, n, port) for p in range(n)]
     outs = []
@@ -59,6 +58,16 @@ def test_two_host_hash_plane_collective():
     for rc, out, err in outs:
         assert rc == 0, f"rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
         assert "MULTIHOST-OK" in out, out
-    # Both hosts saw the same global digest count: 3 + 4 pieces.
-    for rc, out, err in outs:
-        assert "digests=7" in out, out
+        # Every host saw the same global digest count.
+        assert f"digests={want_digests}" in out, out
+
+
+def test_two_host_hash_plane_collective():
+    _drive(2, 3 + 4)
+
+
+def test_three_host_hash_plane_collective():
+    """Three processes, three distinct ragged batch sizes: the count
+    gather and padded digest gather must hold beyond the pairwise case
+    (gloo ring with >2 ranks)."""
+    _drive(3, 3 + 4 + 5)
