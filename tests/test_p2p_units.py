@@ -465,10 +465,16 @@ def test_duplicate_final_piece_is_benign(tmp_path):
 def test_verify_burst_does_not_stall_loop():
     """The batched hash runs off the event loop: during a 100-piece verify
     burst (~25 MB of SHA-256, ~100+ ms of CPU) a concurrently-ticking task
-    must never observe a loop stall > 50 ms. Guards the agent's wire
-    goodput -- an on-loop hash freezes every conn pump for the batch."""
+    must never observe a loop stall > 50 ms.
 
-    async def main():
+    Retried up to 3 attempts: on a loaded single-core box the OS can
+    schedule the (correctly off-loop) hashing thread over the loop
+    thread for >50 ms -- scheduler noise, not an on-loop hash. The
+    discriminating power survives the retries because a genuinely
+    ON-loop hash stalls DETERMINISTICALLY on every attempt (the batch's
+    ~100+ ms of hashing happens inside one callback)."""
+
+    async def attempt() -> float:
         import hashlib
 
         v = BatchedVerifier(max_delay_seconds=0.001)
@@ -496,9 +502,18 @@ def test_verify_burst_does_not_stall_loop():
         stop.set_result(None)
         await t
         assert all(oks)
-        assert max_stall < 0.05, f"event loop stalled {max_stall * 1e3:.0f} ms"
+        return max_stall
 
-    asyncio.run(main())
+    stalls = []
+    for _ in range(3):
+        stall = asyncio.run(attempt())
+        stalls.append(stall)
+        if stall < 0.05:
+            return
+    raise AssertionError(
+        "event loop stalled on every attempt: "
+        + ", ".join(f"{s * 1e3:.0f} ms" for s in stalls)
+    )
 
 
 def test_p2p_bandwidth_cap_shapes_transfer(tmp_path):
