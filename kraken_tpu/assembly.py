@@ -619,6 +619,7 @@ class AgentNode:
         scheduler_config: SchedulerConfig | None = None,
         p2p_bandwidth: dict | None = None,
         ssl_context=None,
+        tag_cache_ttl: float = 0.0,
     ):
         self.host = host
         self.http_port = http_port
@@ -638,6 +639,10 @@ class AgentNode:
             BandwidthLimiter(**p2p_bandwidth) if p2p_bandwidth else None
         )
         self.ssl_context = ssl_context
+        # 0 disables tag caching. Only raise this when the cluster declares
+        # immutable_tags on the build-index: with mutable tags, a positive
+        # cache serves a re-pointed tag's OLD digest for up to the TTL.
+        self.tag_cache_ttl = tag_cache_ttl
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[AgentServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -706,7 +711,10 @@ class AgentNode:
 
             self._tag_client = TagClient(self.build_index_addr)
             registry = RegistryServer(
-                ReadOnlyTransferer(self.store, self.scheduler, self._tag_client),
+                ReadOnlyTransferer(
+                    self.store, self.scheduler, self._tag_client,
+                    tag_cache_ttl=self.tag_cache_ttl,
+                ),
                 read_only=True,
             )
             self._registry_runner, self.registry_port = await _serve(

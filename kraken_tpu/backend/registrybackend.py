@@ -53,6 +53,21 @@ _MANIFEST_ACCEPT = ", ".join(
 _CHALLENGE_PARAM = re.compile(r'(\w+)="([^"]*)"')
 
 
+def _realm_safe_for_credentials(realm: str) -> bool:
+    """https always; plain http only to loopback (dev/test rigs)."""
+    from urllib.parse import urlsplit
+
+    try:
+        parts = urlsplit(realm)
+    except ValueError:  # e.g. unbalanced IPv6 bracket -- treat as unsafe
+        return False
+    if parts.scheme == "https":
+        return True
+    if parts.scheme != "http":
+        return False
+    return parts.hostname in ("127.0.0.1", "localhost", "::1")
+
+
 def _full_digest(name: str) -> str:
     return name if name.startswith("sha256:") else f"sha256:{name}"
 
@@ -158,6 +173,15 @@ class _AuthSession:
         realm = params.get("realm")
         if not realm:
             raise BackendError(f"bearer challenge without realm: {challenge!r}")
+        if self._username and not _realm_safe_for_credentials(realm):
+            # The 401 challenge names the token realm; a spoofed or
+            # misconfigured plain-http realm would receive our Basic
+            # credentials in cleartext. Never send secrets over the
+            # network unencrypted (loopback realms are fine: dev rigs).
+            raise BackendError(
+                f"refusing to send credentials to non-https token realm "
+                f"{realm!r} (upstream challenge may be spoofed)"
+            )
         # The challenge's own scope wins (the upstream knows what it wants
         # granted); the caller's is the fallback for terse challenges.
         use_scope = params.get("scope") or scope

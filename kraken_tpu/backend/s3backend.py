@@ -216,7 +216,23 @@ class S3Backend(BackendClient):
                     _ps, ph, _pb = await self._signed(
                         "PUT", part_url, data=chunk, ok=(200,)
                     )
-                    etags.append(ph.get("ETag", "").strip('"'))
+                    # Case-insensitive: the HTTP client hands back a plain
+                    # dict and servers spell it ETag/Etag/etag. The old
+                    # exact-key lookup silently embedded <ETag></ETag> --
+                    # real S3 rejects that at complete-time, far from here.
+                    etag = next(
+                        (v for k, v in ph.items() if k.lower() == "etag"),
+                        "",
+                    ).strip('"')
+                    if not etag:
+                        # Fail HERE, not at complete-time: an empty <ETag>
+                        # in CompleteMultipartUpload produces a confusing
+                        # S3 error far from the part that caused it.
+                        raise HTTPError(
+                            "PUT", part_url, 500,
+                            f"part {part_num}: no ETag in response".encode(),
+                        )
+                    etags.append(etag)
             complete = "<CompleteMultipartUpload>" + "".join(
                 f"<Part><PartNumber>{i + 1}</PartNumber>"
                 f"<ETag>{etag}</ETag></Part>"

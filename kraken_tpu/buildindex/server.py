@@ -73,7 +73,15 @@ class TagServer:
             return
         ns = tag.rpartition(":")[0] or tag
         async with self._put_lock:
-            existing = await self.store.get(tag, ns)
+            # get_strict: a backend outage must NOT look like "tag absent"
+            # -- that would fail open and allow the silent re-tag this
+            # feature exists to prevent. Answer retryable 503 instead.
+            try:
+                existing = await self.store.get_strict(tag, ns)
+            except Exception as e:
+                raise web.HTTPServiceUnavailable(
+                    text=f"immutability check unavailable: backend error: {e}"
+                )
             if existing is not None and existing != d:
                 raise web.HTTPConflict(
                     text=f"tag is immutable: {tag} -> {existing}"

@@ -12,11 +12,18 @@ import os
 import urllib.parse
 from typing import Optional
 
-from kraken_tpu.backend import Manager as BackendManager
+from kraken_tpu.backend import BlobNotFoundError, Manager as BackendManager
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
 
 WRITEBACK_KIND = "tag_writeback"
+
+
+class _BackendUnavailable(Exception):
+    """Transient backend failure during a read-through (NOT proven-absent).
+
+    get() degrades it to None; get_strict propagates it so the
+    immutability check can answer a retryable 503."""
 
 
 class TagStore:
@@ -70,7 +77,24 @@ class TagStore:
                 )
 
     async def get(self, tag: str, namespace: str = "") -> Optional[Digest]:
-        """Local first; on miss, fall through to the backend and cache."""
+        """Local first; on miss, fall through to the backend and cache.
+
+        A backend OUTAGE degrades to None (reads are best-effort), but a
+        corrupt backend payload (Digest.parse) still raises: a tag that
+        exists-but-is-broken must surface as a 5xx, not a definitive
+        'tag not found'."""
+        try:
+            return await self.get_strict(tag, namespace)
+        except _BackendUnavailable:
+            return None
+
+    async def get_strict(self, tag: str, namespace: str = "") -> Optional[Digest]:
+        """Like get(), but only a *proven-absent* tag returns None.
+
+        A backend outage raises instead of returning None, so callers that
+        need the distinction (the immutable-tags check) don't fail open:
+        a build-index on a fresh volume must not accept a re-point just
+        because the backend that holds the truth is temporarily down."""
         local = await asyncio.to_thread(self.get_local, tag)
         if local is not None:
             return local
@@ -81,10 +105,17 @@ class TagStore:
             return None
         try:
             raw = await client.download(namespace or tag, tag)
-        except Exception:
+        except BlobNotFoundError:
             return None
+        except Exception as e:
+            raise _BackendUnavailable(str(e)) from e
         d = Digest.parse(raw.decode().strip())
-        await asyncio.to_thread(self.put_local, tag, d)
+        try:
+            await asyncio.to_thread(self.put_local, tag, d)
+        except OSError:
+            # Cache write is best-effort: a full/read-only volume must
+            # not turn a successfully fetched tag into an error.
+            pass
         return d
 
     async def _execute_writeback(self, task: Task) -> None:

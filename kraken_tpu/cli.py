@@ -436,6 +436,7 @@ def main(argv: list[str] | None = None) -> None:
             ),
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
+            tag_cache_ttl=float(cfg.get("tag_cache_ttl", 0.0)),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

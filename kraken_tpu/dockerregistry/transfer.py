@@ -47,17 +47,20 @@ class ReadOnlyTransferer:
 
     def __init__(
         self, store: CAStore, scheduler: Scheduler, tags: TagClient,
-        tag_cache_ttl: float = 30.0,
+        tag_cache_ttl: float = 0.0,
     ):
         self.store = store
         self.scheduler = scheduler
         self.tags = tags
         # Positive-only tag cache: the node-local dockerd re-resolves the
-        # same tag on every pull, and upstream caches tag lookups heavily
-        # (tags are near-immutable in practice). Misses are NOT cached --
-        # a tag pushed a moment ago must appear on the next request.
-        self._tag_cache: TTLCache[Digest] = TTLCache(
-            tag_cache_ttl, max_entries=4096
+        # same tag on every pull. Misses are NOT cached -- a tag pushed a
+        # moment ago must appear on the next request. Default is OFF
+        # (ttl=0): with mutable tags a positive cache serves a re-pointed
+        # tag's old digest for up to the TTL. Turn it on (agent YAML
+        # tag_cache_ttl) only when the build-index declares immutable_tags.
+        self._tag_cache: TTLCache[Digest] | None = (
+            TTLCache(tag_cache_ttl, max_entries=4096)
+            if tag_cache_ttl > 0 else None
         )
 
     async def _ensure_local(self, namespace: str, d: Digest) -> None:
@@ -92,16 +95,17 @@ class ReadOnlyTransferer:
         # None means PROVEN absent (build-index said 404). A transient
         # build-index failure propagates so the registry surface can
         # answer a retryable 5xx instead of a definitive MANIFEST_UNKNOWN.
-        cached = self._tag_cache.get(tag)
-        if cached is not None:
-            return cached
+        if self._tag_cache is not None:
+            cached = self._tag_cache.get(tag)
+            if cached is not None:
+                return cached
         try:
             d = await self.tags.get(tag)
         except Exception as e:
             if httputil.is_not_found(e):
                 return None
             raise
-        if d is not None:
+        if d is not None and self._tag_cache is not None:
             self._tag_cache.put(tag, d)
         return d
 

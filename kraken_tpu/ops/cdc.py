@@ -231,12 +231,15 @@ def _candidate_indices(
     arr: np.ndarray, n: int, params: CDCParams
 ) -> tuple[np.ndarray, np.ndarray]:
     """Global strict/loose candidate positions over ``arr[:n]``."""
-    if n > _SEGMENT and jax.default_backend() == "tpu":
+    if n > _SEGMENT and jax.devices()[0].platform == "tpu":
         # TPU + enough bytes to amortize: the Pallas kernel (VMEM-
         # resident doubling, ~43 GB/s/chip chained vs ~10 for the XLA
-        # path on v5e; bit-identical candidates). Strictly "tpu": the
-        # kernel's pltpu BlockSpecs cannot lower on GPU backends, where
-        # the XLA path below works fine.
+        # path on v5e; bit-identical candidates). Allowlist on the
+        # DEVICE platform (like parallel/hashplane.py's
+        # mesh.devices.flat[0].platform): experimental TPU PJRT plugins
+        # still report device platform "tpu" (verified live on the axon
+        # rig), while non-TPU accelerators (gpu, neuron, ...) -- where
+        # the pltpu BlockSpecs cannot lower -- fall through to XLA.
         from kraken_tpu.ops.cdc_pallas import candidate_indices_pallas
 
         return candidate_indices_pallas(

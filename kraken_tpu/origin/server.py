@@ -41,7 +41,7 @@ from kraken_tpu.placement.hashring import Ring
 from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
 from kraken_tpu.store.metadata import NamespaceMetadata, pin, unpin
-from kraken_tpu.utils.metrics import REGISTRY
+from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 
 _log = logging.getLogger("kraken.origin")
 
@@ -87,6 +87,13 @@ class OriginServer:
         self.dedup = dedup
         self.cleanup = cleanup
         self._dedup_tasks: set[asyncio.Task] = set()
+        # A dedup plane that dies per-blob (sqlite sidecar corruption,
+        # kernel fault) must be visible on /metrics, not silent.
+        self._dedup_failures = FailureMeter(
+            "origin_dedup_failures_total",
+            "background dedup add_blob failures",
+            _log,
+        )
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
             # Earlier builds keyed tasks '{addr}:{ns}:{hex}'; rewrite any
@@ -202,8 +209,8 @@ class OriginServer:
         async def run():
             try:
                 await self.dedup.add_blob(d)
-            except Exception:
-                pass
+            except Exception as e:
+                self._dedup_failures.record(f"dedup add_blob {d.hex[:8]}", e)
 
         task = asyncio.create_task(run())
         self._dedup_tasks.add(task)

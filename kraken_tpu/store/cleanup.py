@@ -16,12 +16,16 @@ Policy, in order:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import time
 
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.store.castore import CAStore
 from kraken_tpu.store.metadata import PersistMetadata, TTIMetadata
+from kraken_tpu.utils.metrics import FailureMeter
+
+_log = logging.getLogger("kraken.cleanup")
 
 
 @dataclasses.dataclass
@@ -57,21 +61,29 @@ class CleanupManager:
         # most one sweep interval of recency.
         self._touched: dict[str, float] = {}
         self._flushed: dict[str, float] = {}
+        # Evict callbacks (dedup-index removal, scheduler unseed) must not
+        # block eviction, but a callback that dies every sweep must show
+        # on /metrics rather than rot silently.
+        self._evict_failures = FailureMeter(
+            "store_cleanup_evict_callback_failures_total",
+            "cleanup evict-callback failures (on_evict/after_evict)",
+            _log,
+        )
 
     def _evict(self, d: Digest) -> None:
         if self.on_evict is not None:
             try:
                 self.on_evict(d)
-            except Exception:
-                pass
+            except Exception as e:
+                self._evict_failures.record(f"on_evict {d.hex[:8]}", e)
         self._touched.pop(d.hex, None)
         self._flushed.pop(d.hex, None)
         self.store.delete_cache_file(d)
         if self.after_evict is not None:
             try:
                 self.after_evict(d)
-            except Exception:
-                pass
+            except Exception as e:
+                self._evict_failures.record(f"after_evict {d.hex[:8]}", e)
 
     def touch(self, d: Digest, now: float | None = None) -> None:
         """Record an access (callers: every blob read path). Memory-only --

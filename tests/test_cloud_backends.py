@@ -108,6 +108,15 @@ class FakeS3:
                 )
             ]
             assert got_nums == want_nums, (got_nums, want_nums)
+            # Real S3 rejects a complete whose ETags don't match the
+            # stored parts (InvalidPart) -- enforce it so an empty or
+            # wrong <ETag> fails here like it would in production.
+            got_etags = _re.findall(r"<ETag>([^<]*)</ETag>", body.decode())
+            want_etags = [
+                hashlib.md5(parts[n]).hexdigest() for n in want_nums
+            ]
+            if got_etags != want_etags:
+                return web.Response(status=400, text="InvalidPart")
             self.objects[key] = b"".join(parts[n] for n in want_nums)
             return web.Response(
                 text=(

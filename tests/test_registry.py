@@ -481,3 +481,49 @@ def test_immutable_tags(tmp_path):
             await cluster.close()
 
     asyncio.run(main())
+
+
+def test_immutable_tags_fail_closed_on_backend_outage(tmp_path):
+    """ADVICE r4 (medium): the immutability check reads through to the
+    backend; a backend OUTAGE must answer a retryable 503, not silently
+    accept the put (failing open is the exact re-tag the feature
+    prevents). A proven-absent tag (backend 404) still accepts."""
+    from aiohttp import web
+
+    from kraken_tpu.backend import BlobNotFoundError, BackendError
+    from kraken_tpu.buildindex.server import TagServer
+    from kraken_tpu.buildindex.tagstore import TagStore
+
+    class FakeClient:
+        def __init__(self):
+            self.mode = "outage"
+
+        async def download(self, ns, name):
+            if self.mode == "outage":
+                raise BackendError("backend down")
+            raise BlobNotFoundError(name)
+
+    class FakeBackends:
+        def __init__(self):
+            self.client = FakeClient()
+
+        def try_get_client(self, ns):
+            return self.client
+
+    async def main():
+        backends = FakeBackends()
+        # Fresh volume: nothing local, so the check MUST consult the
+        # backend -- and the backend is down.
+        store = TagStore(str(tmp_path / "tags"), backends=backends)
+        srv = TagServer(store, immutable=True)
+        d = Digest.from_bytes(b"m1")
+        with pytest.raises(web.HTTPServiceUnavailable):
+            await srv._checked_put("repo:v1", d)
+        assert store.get_local("repo:v1") is None  # nothing written
+
+        # Backend answers definitively absent -> the put goes through.
+        backends.client.mode = "absent"
+        await srv._checked_put("repo:v1", d)
+        assert store.get_local("repo:v1") == d
+
+    asyncio.run(main())
