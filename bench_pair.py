@@ -1,0 +1,101 @@
+"""Single-pair data-plane benchmark: 1 origin seeder -> 1 agent leecher
+over loopback TCP, one process.
+
+VERDICT r4 next-round #1: the swarm bench proved the *policies* scale; this
+measures (and profiles) what one conn pair can MOVE -- the harness ceiling
+every aggregate number divides into. Run with --profile to get a cProfile
+table of the combined event loop (both endpoints + both pumps), which is
+what localized the round-5 rebuild targets (per-piece file opens, per-piece
+bitfield sidecar writes, 64 KiB StreamReader chunking, frame-copy framing).
+
+Usage:
+    python bench_pair.py [--blob-mb 256] [--piece-kb 1024] [--profile]
+                         [--repeats 3]
+
+Prints one JSON line {"metric": "pair_goodput_mbps", ...} last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import cProfile
+import io
+import json
+import pstats
+import tempfile
+import time
+
+import numpy as np
+
+from bench_swarm import InMemoryTracker, make_peer, NS
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.metainfo import MetaInfo
+
+
+async def run_pair(blob_mb: int, piece_kb: int, root: str) -> dict:
+    rng = np.random.default_rng(0)
+    blob = rng.integers(0, 256, size=blob_mb << 20, dtype=np.uint8).tobytes()
+    d = Digest.from_bytes(blob)
+    piece_len = piece_kb << 10
+    hashes = get_hasher("cpu").hash_pieces(blob, piece_len)
+    metainfo = MetaInfo(d, len(blob), piece_len, hashes.tobytes())
+
+    tracker = InMemoryTracker()
+    tracker.metainfos[d.hex] = metainfo
+    origin = make_peer(root, "origin", tracker, seed_blobs=[blob])
+    agent = make_peer(root, "agent", tracker)
+    await origin.start()
+    origin.seed(metainfo, NS)
+    await agent.start()
+
+    t0 = time.perf_counter()
+    await agent.download(NS, d)
+    wall = time.perf_counter() - t0
+
+    await origin.stop()
+    await agent.stop()
+    return {
+        "blob_mb": blob_mb,
+        "piece_kb": piece_kb,
+        "pieces": metainfo.num_pieces,
+        "wall_s": round(wall, 4),
+        "goodput_mbps": round(len(blob) / wall / 1e6, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blob-mb", type=int, default=256)
+    ap.add_argument("--piece-kb", type=int, default=1024)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--profile", action="store_true")
+    args = ap.parse_args()
+
+    results = []
+    for _ in range(args.repeats):
+        with tempfile.TemporaryDirectory() as root:
+            if args.profile:
+                prof = cProfile.Profile()
+                prof.enable()
+            r = asyncio.run(run_pair(args.blob_mb, args.piece_kb, root))
+            if args.profile:
+                prof.disable()
+                s = io.StringIO()
+                pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(40)
+                print(s.getvalue())
+            results.append(r)
+            print(json.dumps(r))
+
+    best = max(results, key=lambda r: r["goodput_mbps"])
+    print(json.dumps({
+        "metric": "pair_goodput_mbps",
+        "value": best["goodput_mbps"],
+        "unit": "MB/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

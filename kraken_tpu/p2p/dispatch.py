@@ -129,6 +129,9 @@ class Dispatcher:
             t.cancel()
         if not self.done.done():
             self.done.cancel()
+        # Releases the torrent's cached fd + flushes its debounced
+        # bitfield so crash-resume sees the freshest persisted progress.
+        self.torrent.close()
 
     # -- the pump ----------------------------------------------------------
 

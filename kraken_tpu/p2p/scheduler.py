@@ -46,6 +46,12 @@ from kraken_tpu.utils.metrics import FailureMeter
 
 _log = logging.getLogger("kraken.p2p")
 
+# StreamReader buffer high-water mark for P2P conns. asyncio's 64 KiB
+# default pauses the transport ~16x inside one 1 MiB piece frame
+# (pause/resume flow-control round-trips cost ~20% pair goodput,
+# measured -- PERF.md round-5 pair profile); 4 MiB holds a whole piece.
+_WIRE_BUF = 4 << 20
+
 _announce_failures = FailureMeter(
     "announce_failures_total",
     "Tracker announce attempts that raised (retried next interval)",
@@ -209,7 +215,7 @@ class Scheduler:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._accept, host=self.ip, port=self.port
+            self._accept, host=self.ip, port=self.port, limit=_WIRE_BUF
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -399,7 +405,7 @@ class Scheduler:
         h = ctl.torrent.info_hash
         try:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(peer.ip, peer.port),
+                asyncio.open_connection(peer.ip, peer.port, limit=_WIRE_BUF),
                 self.config.dial_timeout,
             )
             theirs = await handshake_outbound(

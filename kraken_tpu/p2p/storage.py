@@ -15,6 +15,8 @@ batched TPU dispatch (per BASELINE.json's agent-verify config).
 from __future__ import annotations
 
 import asyncio
+import os
+import threading
 from typing import Optional
 
 from kraken_tpu.core.digest import Digest
@@ -41,8 +43,14 @@ class BatchedVerifier:
         self,
         hasher: PieceHasher | None = None,
         max_batch: int = 1024,
-        max_delay_seconds: float = 0.002,
+        max_delay_seconds: float = 0.0,
     ):
+        # max_delay 0 = one event-loop tick: every _on_payload task already
+        # scheduled this tick enqueues before the flusher runs, so a burst
+        # (pipeline-depth frames landing in one recv buffer) still batches,
+        # while a trickle no longer pays a fixed 2 ms per piece -- at
+        # 1 MiB pieces that tax alone capped a pair at ~500 MB/s (round-5
+        # pair profile). Raise it only to build bigger TPU batches.
         self._hasher = hasher or get_hasher("cpu")
         self._max_batch = max_batch
         self._max_delay = max_delay_seconds
@@ -137,6 +145,28 @@ class Torrent:
         # Serializes bitfield updates + completion check.
         self._lock = asyncio.Lock()
         self._full_bits: Optional[bytes] = None  # memoized complete bitfield
+        # One long-lived fd + os.pread/pwrite replace the per-piece
+        # open/seek/read/close of earlier rounds: positional IO is
+        # thread-safe (no shared file offset), so piece reads and writes
+        # from worker threads need no lock and no file-table churn. The
+        # pair-profile (PERF.md round 5) localized ~35% of the wall to
+        # exactly this machinery.
+        self._fd: Optional[int] = None
+        self._fd_lock = threading.Lock()
+        self._fd_refs = 0  # in-flight pread/pwrite count (teardown gate)
+        self._fd_closed = False
+        # Bitfield persistence is DEBOUNCED (the round-5 pair profile's
+        # single largest cost was one sidecar rename per piece, on the
+        # event loop): pieces mark the bitfield dirty, a per-torrent
+        # flusher persists it at most every BITS_FLUSH_SECONDS, and
+        # close()/completion flush what remains. Crash window: pieces
+        # landed since the last flush are re-downloaded on resume -- the
+        # persisted bitfield may UNDERstate progress, never overstate it
+        # (bits are set only after their piece's data write returns).
+        self._bits_dirty = False
+        self._bits_flusher: Optional[asyncio.Task] = None
+
+    BITS_FLUSH_SECONDS = 0.2
 
     # -- introspection -----------------------------------------------------
 
@@ -179,14 +209,59 @@ class Torrent:
 
     # -- pieces ------------------------------------------------------------
 
+    def _with_fd(self, op):
+        """Run ``op(fd)`` (a pread/pwrite) with the fd ref-counted.
+
+        Teardown races are real: cancelling an _io_task does NOT stop a
+        worker thread already inside os.pwrite, and closing the fd under
+        it risks EBADF -- or, via fd-number reuse, a multi-MiB write into
+        whatever file grabbed the number. So close() only marks closed;
+        the LAST in-flight op (or close() itself when none are) actually
+        closes, and new ops after close are refused."""
+        with self._fd_lock:
+            if self._fd_closed:
+                raise PieceError("torrent closed")
+            if self._fd is None:
+                # O_RDWR while incomplete (piece writes land here); a
+                # committed blob is read-only. Completion does NOT
+                # reopen: commit is a rename, so the fd keeps addressing
+                # the same inode the cache path now names.
+                flags = os.O_RDONLY if self._status is None else os.O_RDWR
+                self._fd = os.open(self._path, flags)
+            self._fd_refs += 1
+            fd = self._fd
+        try:
+            return op(fd)
+        finally:
+            with self._fd_lock:
+                self._fd_refs -= 1
+                if self._fd_closed and self._fd_refs == 0 and self._fd is not None:
+                    os.close(self._fd)
+                    self._fd = None
+
+    def close(self) -> None:
+        """Flush any unpersisted bitfield and retire the fd. Sync --
+        callable from dispatcher teardown. Only incomplete torrents flush
+        (a complete torrent has no sidecar; re-writing one after eviction
+        would orphan a ._md file beside a deleted blob)."""
+        if self._bits_flusher is not None:
+            self._bits_flusher.cancel()
+            self._bits_flusher = None
+        if self._status is not None and self._bits_dirty:
+            self.store.set_metadata(self.metainfo.digest, self._status)
+            self._bits_dirty = False
+        with self._fd_lock:
+            self._fd_closed = True
+            if self._fd_refs == 0 and self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
     def read_piece(self, i: int) -> bytes:
         if not self.has_piece(i):
             raise PieceError(f"piece {i} not present")
         off = i * self.metainfo.piece_length
         ln = self.metainfo.piece_length_of(i)
-        with open(self._path, "rb") as f:
-            f.seek(off)
-            data = f.read(ln)
+        data = self._with_fd(lambda fd: os.pread(fd, ln, off))
         if len(data) != ln:
             raise PieceError(f"short read on piece {i}")
         return data
@@ -208,27 +283,52 @@ class Torrent:
             )
         if not await self._verifier.verify(data, self.metainfo.piece_hash(i)):
             raise PieceError(f"piece {i}: digest mismatch")
+        if self._status is None or self._status.has(i):
+            return False  # duplicate arrival (endgame copies are benign)
+        # The data write runs OUTSIDE the lock: pieces occupy disjoint
+        # offsets, so concurrent pwrites never conflict, and serializing
+        # 4 MiB disk writes behind one asyncio.Lock was the round-4
+        # pair-throughput cap. A duplicate slipping past the pre-check
+        # rewrites identical bytes -- benign. Completion cannot race this
+        # write: it requires every bit set, and piece i's bit is only set
+        # below, after this write returns.
+        await asyncio.to_thread(self._write_at, i, data)
         async with self._lock:
             # Re-check under the lock: a concurrent writer of the same
             # final piece may have completed the torrent (set _status to
-            # None) while this task parked on verify or the lock.
+            # None) while this task parked on verify or the write.
             if self._status is None or self._status.has(i):
-                return False  # duplicate arrival
-            await asyncio.to_thread(self._write_at, i, data)
+                return False
             self._status.set(i)
-            self.store.set_metadata(self.metainfo.digest, self._status)
             if self._status.complete():
+                if self._bits_flusher is not None:
+                    self._bits_flusher.cancel()
+                    self._bits_flusher = None
+                self._bits_dirty = False
                 self.store.commit_partial_file(self.metainfo.digest)
                 self.store.delete_metadata(self.metainfo.digest, PieceStatusMetadata)
                 self._status = None
                 self._path = self.store.cache_path(self.metainfo.digest)
                 return True
+            self._mark_bits_dirty()
             return False
 
     def _write_at(self, i: int, data: bytes) -> None:
-        with open(self._path, "r+b") as f:
-            f.seek(i * self.metainfo.piece_length)
-            f.write(data)
+        self._with_fd(
+            lambda fd: os.pwrite(fd, data, i * self.metainfo.piece_length)
+        )
+
+    def _mark_bits_dirty(self) -> None:
+        self._bits_dirty = True
+        if self._bits_flusher is None or self._bits_flusher.done():
+            self._bits_flusher = asyncio.create_task(self._flush_bits_later())
+
+    async def _flush_bits_later(self) -> None:
+        await asyncio.sleep(self.BITS_FLUSH_SECONDS)
+        async with self._lock:
+            if self._status is not None and self._bits_dirty:
+                self.store.set_metadata(self.metainfo.digest, self._status)
+                self._bits_dirty = False
 
     async def read_piece_async(self, i: int) -> bytes:
         """Off-loop :meth:`read_piece` for pump-context reads."""

@@ -55,18 +55,29 @@ class PieceStatusMetadata(Metadata):
             raise ValueError(
                 f"bitfield length {len(self.bits)} != expected {nbytes}"
             )
+        # Stray padding bits in the last byte (corrupt/hand-built sidecar)
+        # must not count: complete() comparing against num_pieces would
+        # otherwise declare a torrent done with a real piece missing.
+        if num_pieces % 8 and self.bits:
+            self.bits[-1] &= (1 << (num_pieces % 8)) - 1
+        # Cached popcount: complete() runs once per received piece, and an
+        # O(pieces) scan there is O(pieces^2) per blob -- real loop time
+        # on a 10k-piece layer.
+        self._count = sum(int(b).bit_count() for b in self.bits)
 
     def has(self, i: int) -> bool:
         return bool(self.bits[i // 8] >> (i % 8) & 1)
 
     def set(self, i: int) -> None:
-        self.bits[i // 8] |= 1 << (i % 8)
+        if not self.has(i):
+            self.bits[i // 8] |= 1 << (i % 8)
+            self._count += 1
 
     def complete(self) -> bool:
-        return all(self.has(i) for i in range(self.num_pieces))
+        return self._count == self.num_pieces
 
     def count(self) -> int:
-        return sum(self.has(i) for i in range(self.num_pieces))
+        return self._count
 
     def missing(self) -> list[int]:
         return [i for i in range(self.num_pieces) if not self.has(i)]

@@ -550,3 +550,44 @@ def test_p2p_bandwidth_cap_shapes_transfer(tmp_path):
             await stop_all(seeder, leecher)
 
     asyncio.run(main())
+
+
+def test_piece_status_ignores_padding_bits():
+    """A corrupt sidecar with stray padding bits in the last byte must not
+    make complete() lie: only bits < num_pieces count."""
+    from kraken_tpu.store import PieceStatusMetadata
+
+    # 9 pieces -> 2 bytes; pieces 0-7 set plus a stray padding bit (bit 7
+    # of byte 1, piece index 15 which does not exist).
+    raw = PieceStatusMetadata(9)
+    md = PieceStatusMetadata(9, bytearray([0xFF, 0x80]))
+    assert md.count() == 8
+    assert not md.complete()
+    assert not md.has(8)
+    assert raw.count() == 0
+
+
+def test_torrent_close_refuses_new_io_and_is_idempotent(tmp_path):
+    """After close(), piece IO raises PieceError (typed peer failure, not
+    EBADF/fd-reuse corruption) and close() can run again safely."""
+    import numpy as np
+
+    from kraken_tpu.core.hasher import get_hasher
+    from kraken_tpu.core.metainfo import MetaInfo
+    from kraken_tpu.p2p.storage import (
+        BatchedVerifier, OriginTorrentArchive, PieceError,
+    )
+    from kraken_tpu.store import CAStore
+
+    blob = bytes(np.random.default_rng(0).integers(0, 256, 8192, np.uint8))
+    d = Digest.from_bytes(blob)
+    store = CAStore(str(tmp_path / "s"))
+    store.create_cache_file(d, iter([blob]))
+    hashes = get_hasher("cpu").hash_pieces(blob, 4096)
+    mi = MetaInfo(d, len(blob), 4096, hashes.tobytes())
+    t = OriginTorrentArchive(store, BatchedVerifier()).create_torrent(mi)
+    assert t.read_piece(0) == blob[:4096]
+    t.close()
+    t.close()  # idempotent
+    with pytest.raises(PieceError):
+        t.read_piece(1)
