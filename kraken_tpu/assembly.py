@@ -186,6 +186,7 @@ class OriginNode:
         scheduler_config_doc: dict | None = None,
         p2p_bandwidth: dict | None = None,
         ssl_context=None,
+        durability: str = "rename",
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -193,7 +194,8 @@ class OriginNode:
         self.http_port = http_port
         self.p2p_port = p2p_port
         self.tracker_addr = tracker_addr
-        self.store = CAStore(store_root)
+        self.store = CAStore(store_root, durability=durability)
+        self.hasher_name = hasher
         self.generator = Generator(
             self.store,
             hasher=get_hasher(hasher),
@@ -309,6 +311,10 @@ class OriginNode:
             scheduler=self.scheduler,
             dedup=self.dedup,
             cleanup=self.cleanup,
+            # TPU origins piece-hash in one batched device pass at commit
+            # (stream-time hashlib would bypass the device); CPU origins
+            # piece-hash while the bytes stream in -- no re-read.
+            stream_piece_hash=self.hasher_name == "cpu",
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -620,6 +626,7 @@ class AgentNode:
         p2p_bandwidth: dict | None = None,
         ssl_context=None,
         tag_cache_ttl: float = 0.0,
+        durability: str = "rename",
     ):
         self.host = host
         self.http_port = http_port
@@ -627,8 +634,16 @@ class AgentNode:
         self.registry_port = registry_port
         self.build_index_addr = build_index_addr
         self.tracker_addr = tracker_addr
-        self.store = CAStore(store_root)
-        self.verifier = BatchedVerifier(hasher=get_hasher(hasher))
+        self.store = CAStore(store_root, durability=durability)
+        # CPU verify: one-tick batching (per-piece hashlib is cheap; a
+        # fixed window only adds latency). TPU verify: keep a 2 ms window
+        # so arrivals coalesce into real device batches -- a batch-of-1
+        # blocking dispatch per piece is what BatchedVerifier exists to
+        # avoid.
+        self.verifier = BatchedVerifier(
+            hasher=get_hasher(hasher),
+            max_delay_seconds=0.0 if hasher == "cpu" else 0.002,
+        )
         self.cleanup = (
             CleanupManager(self.store, cleanup, after_evict=self._after_evict)
             if cleanup

@@ -405,6 +405,7 @@ def main(argv: list[str] | None = None) -> None:
             scheduler_config_doc=cfg.get("scheduler"),
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
+            durability=cfg.get("durability", "rename"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -437,6 +438,7 @@ def main(argv: list[str] | None = None) -> None:
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
             tag_cache_ttl=float(cfg.get("tag_cache_ttl", 0.0)),
+            durability=cfg.get("durability", "rename"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -98,13 +98,22 @@ class Generator:
         piece_length = self.piece_lengths.piece_length(size)
         window = max(piece_length, self.window_bytes // piece_length * piece_length)
         parts = []
-        with self.store.open_cache_file(d) as f:
+        # One-window lookahead: the read of window i+1 runs in a side
+        # thread while the hasher chews window i, so a TPU dispatch never
+        # waits on disk (and a cold page cache never waits on the device).
+        # generate() already runs off-loop, so blocking on the prefetch
+        # here is fine.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self.store.open_cache_file(d) as f, ThreadPoolExecutor(1) as ex:
+            data = f.read(window)
             while True:
-                data = f.read(window)
-                if not data and parts:
-                    break
+                prefetch = ex.submit(f.read, window)
                 parts.append(self.hasher.hash_pieces(data, piece_length))
                 if len(data) < window:
+                    break
+                data = prefetch.result()
+                if not data:
                     break
         hashes = parts[0] if len(parts) == 1 else np.concatenate(parts)
         metainfo = MetaInfo(d, size, piece_length, hashes.tobytes())
@@ -114,3 +123,20 @@ class Generator:
     async def generate(self, d: Digest) -> MetaInfo:
         """Off-loop :meth:`generate_sync` (reads + hashes a whole blob)."""
         return await asyncio.to_thread(self.generate_sync, d)
+
+    def adopt(
+        self, d: Digest, size: int, piece_length: int, piece_hashes: bytes
+    ) -> MetaInfo:
+        """Persist a MetaInfo whose piece hashes the CALLER computed while
+        the bytes streamed in (origin stream-time piece hashing) -- the
+        blob is never re-read. The piece length must match this
+        generator's config for ``size`` so agents and the re-generate
+        path agree bit-for-bit."""
+        if piece_length != self.piece_lengths.piece_length(size):
+            raise ValueError(
+                f"piece_length {piece_length} != configured "
+                f"{self.piece_lengths.piece_length(size)} for size {size}"
+            )
+        metainfo = MetaInfo(d, size, piece_length, piece_hashes)
+        self.store.set_metadata(d, TorrentMetaMetadata(metainfo))
+        return metainfo

@@ -121,6 +121,11 @@ class Dispatcher:
             peer.pump.cancel()
         if reason:
             self._on_peer_failure(peer_id, reason)
+        if not self._peers:
+            # No live conns -> shed the cached fd (reopened on the next
+            # conn's first piece IO). Bounds steady-state fd usage on
+            # origins seeding many blobs.
+            self.torrent.release_fd()
 
     def close(self) -> None:
         for pid in list(self._peers):

@@ -239,6 +239,19 @@ class Torrent:
                     os.close(self._fd)
                     self._fd = None
 
+    def release_fd(self) -> None:
+        """Drop the cached fd if no IO is in flight; the next piece IO
+        reopens it. The dispatcher calls this when a torrent's last peer
+        leaves, so a long-lived origin seeding thousands of blobs holds
+        fds only for torrents with LIVE conns -- without this, steady-
+        state fd usage grows with every blob ever served until EMFILE
+        (and conn churn already guarantees idle torrents shed their
+        peers). Best-effort: in-flight IO keeps the fd until close()."""
+        with self._fd_lock:
+            if self._fd_refs == 0 and self._fd is not None and not self._fd_closed:
+                os.close(self._fd)
+                self._fd = None
+
     def close(self) -> None:
         """Flush any unpersisted bitfield and retire the fd. Sync --
         callable from dispatcher teardown. Only incomplete torrents flush
@@ -305,8 +318,17 @@ class Torrent:
                     self._bits_flusher.cancel()
                     self._bits_flusher = None
                 self._bits_dirty = False
-                self.store.commit_partial_file(self.metainfo.digest)
-                self.store.delete_metadata(self.metainfo.digest, PieceStatusMetadata)
+
+                def _commit() -> None:
+                    # Off-loop: in durability=fsync mode this fsyncs the
+                    # WHOLE blob -- seconds for multi-GiB, which on the
+                    # loop would stall every conn pump on the agent.
+                    self.store.commit_partial_file(self.metainfo.digest)
+                    self.store.delete_metadata(
+                        self.metainfo.digest, PieceStatusMetadata
+                    )
+
+                await asyncio.to_thread(_commit)
                 self._status = None
                 self._path = self.store.cache_path(self.metainfo.digest)
                 return True
@@ -327,7 +349,11 @@ class Torrent:
         await asyncio.sleep(self.BITS_FLUSH_SECONDS)
         async with self._lock:
             if self._status is not None and self._bits_dirty:
-                self.store.set_metadata(self.metainfo.digest, self._status)
+                # Off-loop: a sidecar write is small, but in fsync mode
+                # it pays fsync+dirsync every flush.
+                await asyncio.to_thread(
+                    self.store.set_metadata, self.metainfo.digest, self._status
+                )
                 self._bits_dirty = False
 
     async def read_piece_async(self, i: int) -> bytes:

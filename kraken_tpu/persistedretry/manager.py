@@ -35,6 +35,13 @@ class TaskStore:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._db = sqlite3.connect(path)
+        # WAL + synchronous=NORMAL: commits survive process crash always
+        # and power loss up to the last WAL checkpoint sync -- the right
+        # durability/cost point for a retry queue (a lost row re-enqueues
+        # on the next trigger; a corrupt rollback journal would not).
+        # ":memory:" (tests) doesn't support WAL; it reports its mode.
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.execute(
             """CREATE TABLE IF NOT EXISTS tasks (
                 id INTEGER PRIMARY KEY AUTOINCREMENT,

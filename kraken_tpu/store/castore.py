@@ -58,13 +58,43 @@ class DigestMismatchError(StoreError):
 class CAStore:
     """Content-addressable store rooted at a directory."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, durability: str = "rename"):
+        """``durability`` states the crash contract (docs/OPERATIONS.md):
+
+        - ``"rename"`` (default): atomic rename only. Process crash never
+          observes partial blobs; on POWER LOSS a just-committed blob or
+          sidecar can be empty/partial (the rename may be journaled
+          before the data hits the platter).
+        - ``"fsync"``: fsync the file before rename and the directory
+          after, on every blob commit and sidecar write. Power-loss
+          durable; costs one fdatasync+dirsync per commit (measured in
+          bench_ingest.py).
+        """
+        if durability not in ("rename", "fsync"):
+            raise ValueError(f"unknown durability mode: {durability!r}")
         self.root = root
+        self.durability = durability
         self.upload_dir = os.path.join(root, "upload")
         self.cache_dir = os.path.join(root, "cache")
         os.makedirs(self.upload_dir, exist_ok=True)
         os.makedirs(self.cache_dir, exist_ok=True)
         self._lock = threading.Lock()
+
+    def _commit_file(self, src: str, dst: str) -> None:
+        """Move ``src`` into place at ``dst`` under the durability mode."""
+        if self.durability == "fsync":
+            fd = os.open(src, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        os.replace(src, dst)
+        if self.durability == "fsync":
+            dfd = os.open(os.path.dirname(dst), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
     # -- paths -------------------------------------------------------------
 
@@ -114,19 +144,32 @@ class CAStore:
             raise UploadNotFoundError(uid)
         return os.path.getsize(path)
 
-    def commit_upload(self, uid: str, d: Digest, verify: bool = True) -> None:
+    def commit_upload(
+        self,
+        uid: str,
+        d: Digest,
+        verify: bool = True,
+        precomputed: Optional[Digest] = None,
+    ) -> None:
         """Atomically move an upload into the cache under its digest.
 
-        With ``verify`` the content is re-hashed and must match ``d``.
-        Committing a digest that is already cached discards the upload and
-        raises :class:`FileExistsInCacheError` (callers usually swallow it).
+        With ``verify`` the content is re-hashed and must match ``d``;
+        ``precomputed`` (a digest the CALLER computed over the streamed
+        bytes, e.g. the origin's running upload hash) substitutes for the
+        re-read -- committing a 1 GiB blob then costs a rename, not a
+        second full read+hash pass. Committing a digest that is already
+        cached discards the upload and raises
+        :class:`FileExistsInCacheError` (callers usually swallow it).
         """
         src = self._upload_path(uid)
         if not os.path.exists(src):
             raise UploadNotFoundError(uid)
         if verify:
-            with open(src, "rb") as f:
-                actual = Digest.from_reader(f)
+            if precomputed is not None:
+                actual = precomputed
+            else:
+                with open(src, "rb") as f:
+                    actual = Digest.from_reader(f)
             if actual != d:
                 os.unlink(src)
                 raise DigestMismatchError(f"expected {d}, got {actual}")
@@ -136,7 +179,7 @@ class CAStore:
                 os.unlink(src)
                 raise FileExistsInCacheError(str(d))
             os.makedirs(os.path.dirname(dst), exist_ok=True)
-            os.replace(src, dst)
+            self._commit_file(src, dst)
 
     def abort_upload(self, uid: str) -> None:
         with contextlib.suppress(FileNotFoundError):
@@ -182,7 +225,7 @@ class CAStore:
         with self._lock:
             if not os.path.exists(self.cache_path(d)):
                 os.makedirs(os.path.dirname(self.cache_path(d)), exist_ok=True)
-                os.replace(self.partial_path(d), self.cache_path(d))
+                self._commit_file(self.partial_path(d), self.cache_path(d))
             else:
                 with contextlib.suppress(FileNotFoundError):
                     os.unlink(self.partial_path(d))
@@ -261,7 +304,7 @@ class CAStore:
         tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(md.serialize())
-        os.replace(tmp, path)
+        self._commit_file(tmp, path)
 
     def get_metadata(self, d: Digest, cls: Type[M]) -> Optional[M]:
         path = self._md_path(self.cache_path(d), cls.name)
