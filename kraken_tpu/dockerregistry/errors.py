@@ -38,6 +38,12 @@ CODES: dict[str, tuple[int, str]] = {
     "UNAUTHORIZED": (401, "authentication required"),
     "DENIED": (403, "requested access to the resource is denied"),
     "UNSUPPORTED": (405, "the operation is unsupported"),
+    # Extension: the distribution spec's error table has no 406 code (the
+    # reference implementation answers content-negotiation misses with a
+    # bare 404), but a typed 406 tells a schema-pinned client exactly why
+    # the stored manifest cannot be served to it (API.md).
+    "MANIFEST_NOT_ACCEPTABLE": (
+        406, "stored manifest media type not covered by Accept"),
     "TOOMANYREQUESTS": (429, "too many requests"),
     "PAGINATION_NUMBER_INVALID": (400, "invalid number of results requested"),
     # Spec catch-all for server-side faults: clients retry 5xx but treat
@@ -51,6 +57,7 @@ _STATUS_EXC: dict[int, type[web.HTTPException]] = {
     401: web.HTTPUnauthorized,
     403: web.HTTPForbidden,
     404: web.HTTPNotFound,
+    406: web.HTTPNotAcceptable,
     416: web.HTTPRequestRangeNotSatisfiable,
     429: web.HTTPTooManyRequests,
     500: web.HTTPInternalServerError,

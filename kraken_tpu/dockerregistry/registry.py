@@ -56,6 +56,22 @@ _MANIFEST_TYPES = (
 )
 
 
+def _accepts(req: web.Request, media: str) -> bool:
+    """RFC 7231-shaped Accept check, scoped to what registries need: no
+    header and wildcards (``*/*``, ``application/*``) accept anything;
+    otherwise the stored type must appear among the listed types
+    (parameters like ``q=`` stripped, case-insensitive)."""
+    values = req.headers.getall("Accept", [])
+    if not values:
+        return True
+    for header in values:
+        for part in header.split(","):
+            t = part.split(";", 1)[0].strip().lower()
+            if t in ("*/*", "application/*") or t == media.lower():
+                return True
+    return False
+
+
 class RegistryServer:
     """v2 API; ``read_only`` distinguishes agent (pull) from proxy (push)."""
 
@@ -187,8 +203,28 @@ class RegistryServer:
             media = parsed.get("mediaType") if isinstance(parsed, dict) else None
         except ValueError:
             media = None
-        if not isinstance(media, str):
+        guessed = not isinstance(media, str)
+        if guessed:
             media = "application/vnd.docker.distribution.manifest.v2+json"
+        # Content negotiation (VERDICT r4 #7): serve the stored type when
+        # the client lists it (or sends no Accept / a wildcard); a client
+        # pinned to types we don't have gets a typed 406 instead of bytes
+        # it would reject with a confusing schema error. No conversion is
+        # attempted -- converting between schema versions changes the
+        # digest, which breaks by-digest pulls. A GUESSED type never
+        # 406s: OCI 1.0 manifests may legally omit mediaType, and
+        # refusing an OCI-pinned client over our docker-typed guess would
+        # fail a pull the client could parse fine.
+        if not guessed and not _accepts(req, media):
+            raise v2_error(
+                "MANIFEST_NOT_ACCEPTABLE",
+                detail={
+                    "name": repo,
+                    "reference": ref,
+                    "stored": media,
+                    "accept": ",".join(req.headers.getall("Accept", [])),
+                },
+            )
         headers = {
             "Docker-Content-Digest": str(d),
             "Content-Type": media,

@@ -298,17 +298,21 @@ def chunk(data: bytes | memoryview, params: CDCParams = CDCParams()) -> list[int
     return _host_select_cuts(strict_idx, loose_idx, n, params)
 
 
+def spans_from_cuts(cuts) -> list[tuple[int, int]]:
+    """Cut end-offsets (exclusive, ascending) -> (start, end) spans."""
+    spans = []
+    start = 0
+    for end in cuts:
+        spans.append((start, int(end)))
+        start = int(end)
+    return spans
+
+
 def chunk_spans(
     data: bytes | memoryview, params: CDCParams = CDCParams()
 ) -> list[tuple[int, int]]:
     """(start, end) spans for each chunk."""
-    cuts = chunk(data, params)
-    spans = []
-    start = 0
-    for end in cuts:
-        spans.append((start, end))
-        start = end
-    return spans
+    return spans_from_cuts(chunk(data, params))
 
 
 def chunk_host(

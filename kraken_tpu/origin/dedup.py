@@ -24,9 +24,13 @@ import threading
 
 import numpy as np
 
+import time
+
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.hasher import PieceHasher, get_hasher
-from kraken_tpu.ops.cdc import CDCParams, chunk_spans
+from kraken_tpu.ops.cdc import (
+    CDCParams, chunk_host, chunk_spans, spans_from_cuts,
+)
 from kraken_tpu.ops.minhash import (
     CompactLSHIndex,
     LSHIndex,
@@ -34,6 +38,80 @@ from kraken_tpu.ops.minhash import (
     fingerprints_from_digests,
 )
 from kraken_tpu.store import CAStore, Metadata, register_metadata
+
+class ChunkRouter:
+    """Routes a blob's CDC pass to the host C chunker or the device gear
+    kernel by MEASURED rate, not a guessed threshold (VERDICT r4 #4).
+
+    Small blobs always chunk on host (a device dispatch's fixed cost
+    dwarfs the work). The first blob at/above ``min_device_bytes`` runs a
+    one-time calibration: both paths chunk the same leading sample and
+    the faster one wins for the rest of the process lifetime. This makes
+    the policy correct on BOTH kinds of rig: on a host with a thin
+    device link (this bench rig's ~25 MB/s relay) the host C chunker
+    (~1.5 GB/s/core) wins and the device is never touched; on production
+    PCIe the device pass wins for large blobs. Calibration costs one
+    extra pass over <= ``sample_bytes``, once.
+    """
+
+    def __init__(
+        self,
+        params: CDCParams,
+        min_device_bytes: int = 8 << 20,
+        sample_bytes: int = 8 << 20,
+    ):
+        self.params = params
+        self.min_device_bytes = min_device_bytes
+        self.sample_bytes = sample_bytes
+        self.decision: str | None = None  # "host" | "device" once measured
+        self.measured: dict[str, float] = {}  # path -> bytes/s
+        self._calibrate_lock = threading.Lock()
+
+    def _host_spans(self, data) -> list[tuple[int, int]]:
+        return spans_from_cuts(chunk_host(data, self.params).tolist())
+
+    def _calibrate(self, data) -> None:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            self.decision = "host"
+            return
+        sample = np.array(
+            memoryview(data)[: self.sample_bytes], copy=True
+        )
+        # Warm BOTH paths untimed first: the first device call pays
+        # Pallas/XLA compilation (hundreds of ms) and the first host call
+        # pays the cc build check -- timing either cold would lock in the
+        # wrong decision for the process lifetime.
+        self._host_spans(sample)
+        chunk_spans(sample, self.params)
+        t0 = time.perf_counter()
+        self._host_spans(sample)
+        host_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        chunk_spans(sample, self.params)  # device path (incl. transfer)
+        device_s = time.perf_counter() - t0
+        self.measured = {
+            "host_bps": len(sample) / max(host_s, 1e-9),
+            "device_bps": len(sample) / max(device_s, 1e-9),
+        }
+        self.decision = "device" if device_s < host_s else "host"
+
+    def spans(self, data) -> list[tuple[int, int]]:
+        n = len(data)
+        if n < self.min_device_bytes:
+            return self._host_spans(data)
+        if self.decision is None:
+            with self._calibrate_lock:
+                # Re-check: a concurrent ingest may have calibrated while
+                # we waited (two racing calibrations would time contended
+                # transfers and could lock in opposite decisions).
+                if self.decision is None:
+                    self._calibrate(data)
+        if self.decision == "device":
+            return chunk_spans(data, self.params)
+        return self._host_spans(data)
+
 
 _MAGIC = 0xC5
 # v2: ledger fingerprints widened to 64-bit (first 8 digest bytes). The v1
@@ -120,6 +198,7 @@ class DedupIndex:
             self._index = LSHIndex(self.minhasher, num_bands=num_bands)
         else:
             raise ValueError(f"unknown dedup index kind: {index_kind!r}")
+        self._router = ChunkRouter(self.params)
         self._lock = threading.Lock()
         # Insertion-ordered (dict keys): beyond max_blobs the OLDEST
         # indexed blob leaves the in-memory index (its sidecar stays on
@@ -151,6 +230,10 @@ class DedupIndex:
                 "total_bytes": self.total_bytes,
                 "duplicate_bytes": self.duplicate_bytes,
                 "dedup_ratio": round(self.dedup_ratio, 4),
+                "chunk_route": self._router.decision or "host(<min)",
+                "chunk_route_measured": {
+                    k: round(v) for k, v in self._router.measured.items()
+                },
             }
 
     # -- ingest ------------------------------------------------------------
@@ -158,7 +241,7 @@ class DedupIndex:
     def _compute_record(
         self, data: bytes | memoryview
     ) -> ChunkSketchMetadata:
-        spans = chunk_spans(data, self.params)
+        spans = self._router.spans(data)
         view = memoryview(data)
         chunks = [view[s:e] for s, e in spans]
         digests = self.hasher.hash_batch(chunks)  # batched TPU dispatch

@@ -494,3 +494,92 @@ def test_error_envelope_on_randomized_garbage():
                             assert "errors" in body_json, (method, path, text)
 
     asyncio.run(main())
+
+
+def test_manifest_accept_negotiation():
+    """VERDICT r4 #7: manifest GET/HEAD honors Accept. Stored-type
+    listed, no header, or a wildcard -> 200 with the stored type; a
+    client pinned to types we don't hold -> typed 406 (extension code
+    MANIFEST_NOT_ACCEPTABLE -- see API.md), never bytes it would choke
+    on. Covered for docker-schema2, OCI manifest, and list types."""
+
+    DOCKER2 = "application/vnd.docker.distribution.manifest.v2+json"
+    OCI = "application/vnd.oci.image.manifest.v1+json"
+    LIST = "application/vnd.docker.distribution.manifest.list.v2+json"
+    OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+
+    async def main():
+        async with Rig() as rig:
+            stored = {}
+            for tag, media in (
+                ("docker2", DOCKER2), ("oci", OCI), ("list", LIST),
+            ):
+                body = json.dumps({"mediaType": media, "t": tag}).encode()
+                d = Digest.from_bytes(body)
+                rig.transferer.blobs[str(d)] = body
+                rig.transferer.tags[f"repo:{tag}"] = d
+                stored[tag] = (d, media, body)
+
+            async def get(tag, accept, expect_status):
+                headers = {"Accept": accept} if accept is not None else {}
+                async with rig.http.get(
+                    f"{rig.base}/v2/repo/manifests/{tag}", headers=headers
+                ) as r:
+                    assert r.status == expect_status, (
+                        tag, accept, r.status, await r.text()
+                    )
+                    return r
+
+            for tag, (_d, media, body) in stored.items():
+                # exact type, wildcard, application/*, and no header serve
+                r = await get(tag, media, 200)
+                assert r.headers["Content-Type"] == media
+                await get(tag, "*/*", 200)
+                await get(tag, "application/*", 200)
+                await get(tag, None, 200)
+                # docker-style multi-type Accept including the stored one
+                await get(tag, f"{OCI_INDEX}, {media};q=0.9", 200)
+
+            # Pinned to the WRONG type: enveloped 406.
+            err = await rig.expect(
+                "GET", "/v2/repo/manifests/docker2", "MANIFEST_NOT_ACCEPTABLE",
+                406, headers={"Accept": OCI},
+            )
+            assert err["detail"]["stored"] == DOCKER2
+            await rig.expect(
+                "GET", "/v2/repo/manifests/oci", "MANIFEST_NOT_ACCEPTABLE",
+                406, headers={"Accept": f"{DOCKER2}, {LIST}"},
+            )
+            await rig.expect(
+                "GET", "/v2/repo/manifests/list", "MANIFEST_NOT_ACCEPTABLE",
+                406, headers={"Accept": OCI},
+            )
+            # HEAD negotiates identically (406, empty-body-safe).
+            async with rig.http.head(
+                f"{rig.base}/v2/repo/manifests/docker2",
+                headers={"Accept": OCI},
+            ) as r:
+                assert r.status == 406
+
+    asyncio.run(main())
+
+
+def test_manifest_without_media_type_never_406s():
+    """OCI 1.0 manifests may omit mediaType; our docker-typed GUESS must
+    not be grounds for refusing a pinned client -- the stored bytes may
+    well be what the client wants."""
+    OCI = "application/vnd.oci.image.manifest.v1+json"
+
+    async def main():
+        async with Rig() as rig:
+            body = json.dumps({"schemaVersion": 2, "config": {}}).encode()
+            d = Digest.from_bytes(body)
+            rig.transferer.blobs[str(d)] = body
+            rig.transferer.tags["repo:untyped"] = d
+            async with rig.http.get(
+                f"{rig.base}/v2/repo/manifests/untyped",
+                headers={"Accept": OCI},
+            ) as r:
+                assert r.status == 200, await r.text()
+
+    asyncio.run(main())
