@@ -24,6 +24,14 @@ def main():
     ap.add_argument("--piece-mb", type=int, default=4)
     ap.add_argument("--origins", type=int, default=1)
     ap.add_argument("--seed", type=int, default=1)
+    # Round-5 production shapes (VERDICT r4 #8):
+    ap.add_argument("--downlink-mbps", type=float, default=0.0,
+                    help="per-host downlink cap; 0 = uplink-only model")
+    ap.add_argument("--layers", type=str, default="",
+                    help="comma-separated pieces per layer: image-shaped "
+                         "pull (overrides --pieces)")
+    ap.add_argument("--restart-at", type=float, default=0.0)
+    ap.add_argument("--restart-frac", type=float, default=0.0)
     args = ap.parse_args()
 
     t0 = time.time()
@@ -33,6 +41,13 @@ def main():
         piece_bytes=args.piece_mb << 20,
         n_origins=args.origins,
         seed=args.seed,
+        downlink_bps=args.downlink_mbps * 1e6,
+        blob_pieces=(
+            tuple(int(x) for x in args.layers.split(",")) if args.layers
+            else None
+        ),
+        restart_at_s=args.restart_at,
+        restart_frac=args.restart_frac,
     )
     r["bench_wall_s"] = round(time.time() - t0, 2)
     print(json.dumps({

@@ -287,6 +287,14 @@ class DedupIndex:
                         f.fileno(), 0, access=mmap.ACCESS_READ
                     ) as mm:
                         record = self._compute_record(memoryview(mm))
+            if not self.store.in_cache(d):
+                # Eviction (or DELETE) raced this add: the open fd/mmap
+                # kept the bytes readable past the unlink, but indexing
+                # now would plant a ghost entry remove_sync already ran
+                # for -- /similar would hand out a blob nobody can fetch
+                # -- and the sidecar write would orphan a ._md file
+                # beside a deleted blob.
+                raise KeyError(d.hex)
             self.store.set_metadata(d, record)
         self._admit(d, record)
         self._evict_over_cap(keep=d.hex)
@@ -310,6 +318,12 @@ class DedupIndex:
         with self._lock:
             if d.hex in self._indexed:
                 return
+            if not self.store.in_cache(d):
+                # Eviction raced this add between the compute and here
+                # (on_evict's remove_sync shares this lock, so checking
+                # inside it leaves only the remove_sync->delete sliver):
+                # indexing would plant a ghost /similar could hand out.
+                raise KeyError(d.hex)
             self._indexed[d.hex] = None
             self._index.add(d.hex, record.sketch)
             for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):

@@ -34,6 +34,7 @@ from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.backend import BlobNotFoundError
 from kraken_tpu.origin.blobrefresh import Refresher
 from kraken_tpu.origin.client import BlobClient
+from kraken_tpu.core.hasher import record_hash_metrics
 from kraken_tpu.origin.metainfogen import Generator
 from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
@@ -58,7 +59,7 @@ class _UploadDigest:
     TPU origins leave piece hashing to the batched device pass."""
 
     __slots__ = (
-        "_hash", "_pos", "_active", "_valid", "created",
+        "_hash", "_pos", "_active", "_valid", "created", "hash_seconds",
         "_plen", "_piece", "_piece_len", "_piece_digests",
     )
 
@@ -67,6 +68,7 @@ class _UploadDigest:
         import time
 
         self.created = time.monotonic()
+        self.hash_seconds = 0.0  # cumulative time inside sha updates
         self._hash = hashlib.sha256()
         self._pos = 0
         self._active = False
@@ -88,7 +90,10 @@ class _UploadDigest:
         self._active = False
 
     def write_and_update(self, f, chunk: bytes) -> None:
+        import time
+
         f.write(chunk)
+        t0 = time.perf_counter()
         self._hash.update(chunk)
         self._pos += len(chunk)
         if self._plen:
@@ -104,6 +109,7 @@ class _UploadDigest:
                     self._piece_digests.append(self._piece.digest())
                     self._piece = hashlib.sha256()
                     self._piece_len = 0
+        self.hash_seconds += time.perf_counter() - t0
 
     def result(self, upload_size: int) -> Digest | None:
         """The digest, or None when tracking was invalidated or the bytes
@@ -332,7 +338,13 @@ class OriginServer:
         metainfo = None
         if piece_hashes is not None:
             # Stream-time piece hashes cover the final size at the final
-            # piece length: the MetaInfo is free, no re-read pass.
+            # piece length: the MetaInfo is free, no re-read pass. The
+            # north-star hasher gauges still move (the stream path IS the
+            # piece-hash plane on cpu origins).
+            record_hash_metrics(
+                "cpu", size, len(piece_hashes) // 32,
+                tracker.hash_seconds,
+            )
             metainfo = await asyncio.to_thread(
                 self.generator.adopt, d, size,
                 self.generator.piece_lengths.piece_length(size), piece_hashes,

@@ -13,9 +13,15 @@ SS6 row 6) -- upstream testing strategy, unverified.
 Model (deliberately simple, stated so results are interpretable):
 
 - Each peer has one uplink of ``uplink_bps``; piece serves queue FIFO on
-  it (``busy_until``). Downlinks are not modeled separately -- swarm
-  goodput is uplink-bound, and modeling both would double event count for
-  a second-order effect.
+  it (``busy_until``). ``downlink_bps`` > 0 additionally FIFO-queues the
+  receive side at the transfer rate min(uplink, downlink) -- the per-host
+  bandwidth-cap shape production ships (utils/bandwidth.py YAML knobs).
+  0 keeps the round-3 uplink-only model.
+- ``blob_pieces`` with several entries simulates an image-shaped pull:
+  every agent pulls ALL blobs concurrently (one conn budget per blob, as
+  production conns are per-torrent; one shared uplink/downlink pair per
+  host), and an agent's pull latency is when its LAST blob completes --
+  what ``docker pull`` wall time means.
 - Every message hop pays ``latency_s``.
 - Conns are bidirectional, with the dispatcher's idle churn: a conn that
   carries nothing useful for ``churn_idle_s`` is dropped from both ends.
@@ -27,6 +33,12 @@ Model (deliberately simple, stated so results are interpretable):
   (complete agents too, as real seeders do); the tracker answers with the
   production handout policy. Announce LOAD is reported, the pacing
   driven through one production :class:`AnnounceQueue`.
+- ``restart_frac`` > 0 kills that fraction of agents at ``restart_at_s``:
+  conns drop from both ends, in-flight requests are lost, up to
+  ``restart_lose_pieces`` most-recent pieces per blob are forgotten (the
+  debounced-bitfield crash window), and the agent rejoins after
+  ``restart_down_s`` via a fresh announce -- the mid-swarm agent-restart
+  chaos shape.
 
 Determinism: one seeded ``random.Random`` drives every stochastic choice
 (handout shuffle + selection tiebreaks route through ``random`` module
@@ -57,6 +69,7 @@ class SimConfig:
     piece_bytes: int = 4 << 20
     uplink_bps: float = 1.25e9  # ~10 GbE
     origin_uplink_bps: float = 1.25e9
+    downlink_bps: float = 0.0  # 0 = uplink-only model (round-3 shape)
     latency_s: float = 0.001
     announce_interval_s: float = 3.0
     handout_limit: int = 20
@@ -67,28 +80,51 @@ class SimConfig:
     churn_tick_s: float = 1.0
     seed: int = 0
     max_sim_s: float = 600.0
+    # Image-shaped pulls: pieces per blob (None = one blob of num_pieces).
+    blob_pieces: tuple[int, ...] | None = None
+    # Mid-swarm restart chaos (0 = off).
+    restart_at_s: float = 0.0
+    restart_frac: float = 0.0
+    restart_down_s: float = 1.0
+    restart_lose_pieces: int = 1
+
+    def blobs(self) -> tuple[int, ...]:
+        return self.blob_pieces or (self.num_pieces,)
 
 
 class _Peer:
-    """Sim-side agent or origin. Policy objects are the production ones."""
+    """Sim-side agent or origin. Policy objects are the production ones.
+
+    Per-torrent state (``has``/``avail``/``conns``/``requests``) is a
+    list indexed by blob; the uplink/downlink queues and the ConnState
+    (which natively tracks per-torrent AND global budgets, as production
+    does) are per-host."""
 
     __slots__ = (
-        "pid", "origin", "join_t", "done_t", "has", "avail", "conns",
-        "requests", "cs", "bl", "busy_until", "uplink_bps",
+        "pid", "origin", "join_t", "done_t", "blob_done_t", "has", "avail",
+        "conns", "requests", "cs", "bl", "busy_until", "recv_until",
+        "uplink_bps", "offline_until", "order", "incarnation",
     )
 
     def __init__(self, pid: PeerID, cfg: SimConfig, origin: bool, join_t: float):
+        blobs = cfg.blobs()
         self.pid = pid
         self.origin = origin
         self.join_t = join_t
         self.done_t: Optional[float] = None
-        self.has: set[int] = set(range(cfg.num_pieces)) if origin else set()
-        self.avail: dict[int, int] = {}  # piece -> count over conns
-        self.conns: dict[PeerID, float] = {}  # peer -> last_useful
-        self.requests = RequestManager(
-            pipeline_limit=cfg.pipeline_limit,
-            timeout_seconds=cfg.piece_timeout_s,
-        )
+        self.blob_done_t: list[Optional[float]] = [None] * len(blobs)
+        self.has: list[set[int]] = [
+            set(range(n)) if origin else set() for n in blobs
+        ]
+        self.avail: list[dict[int, int]] = [{} for _ in blobs]
+        self.conns: list[dict[PeerID, float]] = [{} for _ in blobs]
+        self.requests = [
+            RequestManager(
+                pipeline_limit=cfg.pipeline_limit,
+                timeout_seconds=cfg.piece_timeout_s,
+            )
+            for _ in blobs
+        ]
         cs_config = ConnStateConfig(
             max_open_conns_per_torrent=cfg.max_conns_per_torrent,
             # Global cap can't bind with one torrent; keep it out of the way.
@@ -105,18 +141,34 @@ class _Peer:
 
         self.bl = Blacklist(cs_config)
         self.busy_until = 0.0
+        self.recv_until = 0.0
         self.uplink_bps = cfg.origin_uplink_bps if origin else cfg.uplink_bps
+        self.offline_until = 0.0  # restart chaos: no serve/dial while down
+        self.order: list[list[int]] = [[] for _ in blobs]  # arrival order
+        # Bumped on every restart: events scheduled against the OLD
+        # process (queued serves, in-flight pieces) must not charge or
+        # feed the reborn one.
+        self.incarnation = 0
+
+    def offline(self, now: float) -> bool:
+        return now < self.offline_until
 
     def complete(self) -> bool:
         return self.done_t is not None or self.origin
 
+    def blob_complete(self, t: int) -> bool:
+        return self.origin or self.blob_done_t[t] is not None
+
 
 class SwarmSim:
-    """One blob, ``n_agents`` leechers, ``n_origins`` seeders."""
+    """``n_agents`` leechers x ``blobs()`` torrents, ``n_origins`` seeders."""
 
     def __init__(self, cfg: SimConfig):
         self.cfg = cfg
-        self.h = InfoHash("ab" * 32)
+        self.blobs = cfg.blobs()
+        self.hs = [
+            InfoHash(f"{t:02x}" + "ab" * 31) for t in range(len(self.blobs))
+        ]
         self.now = 0.0
         self._heap: list[tuple[float, int, Callable[[], None]]] = []
         self._seq = 0
@@ -126,13 +178,14 @@ class SwarmSim:
         self.transfers = 0
         self.duplicates = 0
         self.busy_rejects = 0
-        self._remaining = cfg.n_agents  # incomplete agents
-        # Tracker swarm membership (each pid once, append-only: the sim
-        # has no TTL churn). Handouts SAMPLE this, as the production
-        # peerstore does; completeness is read from live peer state, a
-        # one-interval-fresher view than the tracker's announce records.
-        self._members: list[PeerID] = []
-        self._member_set: set[PeerID] = set()
+        self.restarts = 0
+        self._remaining = cfg.n_agents  # agents with >= 1 incomplete blob
+        # Tracker swarm membership per torrent (each pid once, append-only:
+        # the sim has no TTL churn). Handouts SAMPLE this, as the
+        # production peerstore does; completeness is read from live peer
+        # state, a one-interval-fresher view than the tracker's records.
+        self._members: list[list[PeerID]] = [[] for _ in self.blobs]
+        self._member_set: list[set[PeerID]] = [set() for _ in self.blobs]
 
     # -- event plumbing ----------------------------------------------------
 
@@ -146,16 +199,20 @@ class SwarmSim:
         for i in range(cfg.n_origins):
             pid = PeerID("ff" * 2 + f"{i:036x}")
             self.peers[pid] = _Peer(pid, cfg, origin=True, join_t=0.0)
-            self._members.append(pid)
-            self._member_set.add(pid)
+            for t in range(len(self.blobs)):
+                self._members[t].append(pid)
+                self._member_set[t].add(pid)
         for i in range(cfg.n_agents):
             pid = PeerID(f"{i:040x}")
             self.peers[pid] = _Peer(pid, cfg, origin=False, join_t=0.0)
-            self.announce_q.schedule(pid, 0.0)
+            for t in range(len(self.blobs)):
+                self.announce_q.schedule((pid, t), 0.0)
         # One announce pump, as in the production scheduler: drain due
         # announces in batches rather than a timer per peer.
         self._at(0.0, self._announce_pump)
         self._at(cfg.churn_tick_s, self._churn_tick)
+        if cfg.restart_frac > 0 and cfg.restart_at_s > 0:
+            self._at(cfg.restart_at_s, self._restart_wave)
 
         while self._heap and self.now <= cfg.max_sim_s and self._remaining:
             t, _seq, fn = heapq.heappop(self._heap)
@@ -166,157 +223,232 @@ class SwarmSim:
     # -- announce plane ----------------------------------------------------
 
     def _announce_pump(self) -> None:
-        for pid in self.announce_q.pop_ready(self.now, limit=10 ** 6):
-            self._announce(self.peers[pid])
+        for key in self.announce_q.pop_ready(self.now, limit=10 ** 6):
+            pid, t = key
+            p = self.peers[pid]
+            if p.offline(self.now):
+                # Down agents re-announce when they come back.
+                self.announce_q.schedule(key, p.offline_until)
+                continue
+            self._announce(p, t)
         if self._remaining:
             self._at(self.now + 0.05, self._announce_pump)
 
-    def _info(self, pid: PeerID) -> PeerInfo:
+    def _info(self, pid: PeerID, t: int) -> PeerInfo:
         p = self.peers[pid]
-        return PeerInfo(pid, "sim", 0, origin=p.origin, complete=p.complete())
+        return PeerInfo(
+            pid, "sim", 0, origin=p.origin, complete=p.blob_complete(t)
+        )
 
-    def _announce(self, p: _Peer) -> None:
+    def _announce(self, p: _Peer, t: int) -> None:
         self.announces += 1
         # Tracker side: record membership, sample candidates (as the
         # production peerstore does), order with the production policy.
-        if p.pid not in self._member_set:
-            self._member_set.add(p.pid)
-            self._members.append(p.pid)
+        if p.pid not in self._member_set[t]:
+            self._member_set[t].add(p.pid)
+            self._members[t].append(p.pid)
         limit = self.cfg.handout_limit
-        k = min(len(self._members), limit + 1)
-        candidates = random.sample(self._members, k)
-        others = [self._info(q) for q in candidates if q != p.pid][:limit]
+        k = min(len(self._members[t]), limit + 1)
+        candidates = random.sample(self._members[t], k)
+        others = [self._info(q, t) for q in candidates if q != p.pid][:limit]
         handout = default_priority(others)
         self.announce_q.schedule(
-            p.pid, self.now + self.cfg.announce_interval_s
+            (p.pid, t), self.now + self.cfg.announce_interval_s
         )
-        if p.complete():
+        if p.blob_complete(t):
             return  # seeders announce for discoverability, don't dial
         for info in handout:
-            self._try_dial(p, info.peer_id)
+            self._try_dial(p, info.peer_id, t)
 
     # -- conn plane --------------------------------------------------------
 
-    def _try_dial(self, a: _Peer, bid: PeerID) -> None:
+    def _try_dial(self, a: _Peer, bid: PeerID, t: int) -> None:
         # Sim-time blacklist check against the peer's standalone
         # Blacklist (see _Peer.bl for why it is not cs.blacklist).
-        if a.bl.blocked(bid, self.h, now=self.now):
+        if a.bl.blocked(bid, self.hs[t], now=self.now):
             return
-        if not a.cs.add_pending(bid, self.h):
+        if not a.cs.add_pending(bid, self.hs[t]):
             return
         self._at(self.now + self.cfg.latency_s,
-                 lambda: self._dial_arrives(a, bid))
+                 lambda: self._dial_arrives(a, bid, t))
 
-    def _dial_arrives(self, a: _Peer, bid: PeerID) -> None:
+    def _dial_arrives(self, a: _Peer, bid: PeerID, t: int) -> None:
         b = self.peers[bid]
-        if b.cs.at_capacity(self.h):
+        if b.offline(self.now) or b.cs.at_capacity(self.hs[t]):
             # Polite busy frame -> soft blacklist, as the production
-            # scheduler does on a busy rejection (scheduler.py:412).
+            # scheduler does on a busy rejection (scheduler.py:412). A
+            # down host answers nothing; connection refused takes the
+            # same soft-blacklist path in production.
             self.busy_rejects += 1
             self._at(self.now + self.cfg.latency_s, lambda: (
-                a.cs.remove_pending(bid, self.h),
-                a.bl.add(bid, self.h, now=self.now, soft=True),
+                a.cs.remove_pending(bid, self.hs[t]),
+                a.bl.add(bid, self.hs[t], now=self.now, soft=True),
             ))
             return
-        b.cs.promote(a.pid, self.h)  # inbound: promote directly
+        b.cs.promote(a.pid, self.hs[t])  # inbound: promote directly
         self._at(self.now + self.cfg.latency_s,
-                 lambda: self._established(a, b))
+                 lambda: self._established(a, b, t))
 
-    def _established(self, a: _Peer, b: _Peer) -> None:
-        a.cs.promote(b.pid, self.h)
+    def _established(self, a: _Peer, b: _Peer, t: int) -> None:
+        a.cs.promote(b.pid, self.hs[t])
         for x, y in ((a, b), (b, a)):
-            if y.pid not in x.conns:
-                x.conns[y.pid] = self.now
-                for i in y.has:
-                    x.avail[i] = x.avail.get(i, 0) + 1
-        self._select(a, b)
-        self._select(b, a)
+            if y.pid not in x.conns[t]:
+                x.conns[t][y.pid] = self.now
+                for i in y.has[t]:
+                    x.avail[t][i] = x.avail[t].get(i, 0) + 1
+        self._select(a, b, t)
+        self._select(b, a, t)
 
-    def _drop_conn(self, x: _Peer, y: _Peer) -> None:
-        if y.pid not in x.conns:
+    def _drop_conn(self, x: _Peer, y: _Peer, t: int) -> None:
+        if y.pid not in x.conns[t]:
             return
         for a, b in ((x, y), (y, x)):
-            del a.conns[b.pid]
-            a.cs.remove(b.pid, self.h)
-            a.requests.clear_peer(b.pid)
+            del a.conns[t][b.pid]
+            a.cs.remove(b.pid, self.hs[t])
+            a.requests[t].clear_peer(b.pid)
             # Clamped decrement: an announce in flight when the conn drops
             # was never counted, so subtracting b's full has-set can
             # transiently undercount by one -- bounded by the latency
             # window, and preferable to per-conn piece snapshots (O(conns
             # x pieces) memory at 10k agents).
-            for i in b.has:
-                n = a.avail.get(i, 0) - 1
+            for i in b.has[t]:
+                n = a.avail[t].get(i, 0) - 1
                 if n > 0:
-                    a.avail[i] = n
+                    a.avail[t][i] = n
                 else:
-                    a.avail.pop(i, None)
+                    a.avail[t].pop(i, None)
 
     def _churn_tick(self) -> None:
         cutoff = self.cfg.churn_idle_s
         for p in self.peers.values():
-            for qid, last in list(p.conns.items()):
-                if self.now - last > cutoff:
-                    self._drop_conn(p, self.peers[qid])
+            for t in range(len(self.blobs)):
+                for qid, last in list(p.conns[t].items()):
+                    if self.now - last > cutoff:
+                        self._drop_conn(p, self.peers[qid], t)
         if self._remaining:
             self._at(self.now + self.cfg.churn_tick_s, self._churn_tick)
 
+    # -- restart chaos -----------------------------------------------------
+
+    def _restart_wave(self) -> None:
+        cfg = self.cfg
+        agents = [
+            p for p in self.peers.values()
+            if not p.origin and not p.offline(self.now)
+        ]
+        victims = random.sample(
+            agents, int(len(agents) * cfg.restart_frac)
+        )
+        for p in victims:
+            self.restarts += 1
+            was_complete = p.done_t is not None
+            p.offline_until = self.now + cfg.restart_down_s
+            p.incarnation += 1
+            # The reborn process has an EMPTY receive queue: bytes queued
+            # toward the dead one were never delivered and must not
+            # phantom-saturate the downlink bucket after rejoin.
+            p.recv_until = 0.0
+            for t in range(len(self.blobs)):
+                for qid in list(p.conns[t]):
+                    self._drop_conn(p, self.peers[qid], t)
+                # The debounced-bitfield crash window: the most recent
+                # pieces may not have hit the sidecar.
+                for i in reversed(p.order[t][-cfg.restart_lose_pieces:]):
+                    if i in p.has[t]:
+                        p.has[t].discard(i)
+                        p.order[t].remove(i)
+                        if p.blob_done_t[t] is not None:
+                            p.blob_done_t[t] = None
+                # In-flight requests died with the process.
+                p.requests[t] = RequestManager(
+                    pipeline_limit=cfg.pipeline_limit,
+                    timeout_seconds=cfg.piece_timeout_s,
+                )
+                self.announce_q.schedule((p.pid, t), p.offline_until)
+            if was_complete and any(
+                p.blob_done_t[t] is None for t in range(len(self.blobs))
+            ):
+                p.done_t = None
+                self._remaining += 1
+
     # -- piece plane -------------------------------------------------------
 
-    def _select(self, a: _Peer, b: _Peer) -> None:
+    def _select(self, a: _Peer, b: _Peer, t: int) -> None:
         """``a`` asks the production RequestManager what to fetch from
         ``b`` and schedules the transfers."""
-        if a.origin or a.done_t is not None or b.pid not in a.conns:
+        if (
+            a.origin or a.blob_done_t[t] is not None
+            or b.pid not in a.conns[t] or a.offline(self.now)
+        ):
             return
-        missing = [i for i in range(self.cfg.num_pieces) if i not in a.has]
+        missing = [i for i in range(self.blobs[t]) if i not in a.has[t]]
         if not missing:
             return
-        chosen = a.requests.select(
-            b.pid, b.has, missing, a.avail, now=self.now
+        chosen = a.requests[t].select(
+            b.pid, b.has[t], missing, a.avail[t], now=self.now
         )
         for i in chosen:
             self._at(self.now + self.cfg.latency_s,
-                     lambda i=i: self._serve(b, a, i))
+                     lambda i=i: self._serve(b, a, i, t))
 
-    def _serve(self, b: _Peer, a: _Peer, i: int) -> None:
+    def _serve(self, b: _Peer, a: _Peer, i: int, t: int) -> None:
         """Request for piece ``i`` arrives at ``b``: FIFO-queue it on b's
-        uplink."""
-        if i not in b.has:
-            return  # raced ahead of an announce; timeout will re-request
-        if a.pid in b.conns:
-            b.conns[a.pid] = self.now  # a request is useful traffic
-        start = max(self.now, b.busy_until)
-        done = start + self.cfg.piece_bytes / b.uplink_bps
-        b.busy_until = done
+        uplink (and a's downlink when caps are modeled)."""
+        if i not in b.has[t] or b.offline(self.now) or a.offline(self.now):
+            return  # raced ahead of an announce / host down; timeout re-requests
+        if a.pid in b.conns[t]:
+            b.conns[t][a.pid] = self.now  # a request is useful traffic
+        # Sender and receiver each FIFO on their OWN bucket; completion is
+        # when both have passed the bytes. Holding the sender's queue for
+        # a slow receiver's duration instead (the first model tried)
+        # head-of-line-blocks every other download behind one capped
+        # receiver -- a wedge real multiplexed TCP does not have (a 10k
+        # capped run completed 0 agents in 600 sim-seconds under it).
+        up_start = max(self.now, b.busy_until)
+        up_done = up_start + self.cfg.piece_bytes / b.uplink_bps
+        b.busy_until = up_done
+        done = up_done
+        if self.cfg.downlink_bps > 0:
+            dn_start = max(up_start, a.recv_until)
+            dn_done = dn_start + self.cfg.piece_bytes / self.cfg.downlink_bps
+            a.recv_until = dn_done
+            done = max(done, dn_done)
+        inc = a.incarnation
         self._at(done + self.cfg.latency_s,
-                 lambda: self._on_piece(a, b, i))
+                 lambda: self._on_piece(a, b, i, t, inc))
 
-    def _on_piece(self, a: _Peer, b: _Peer, i: int) -> None:
+    def _on_piece(self, a: _Peer, b: _Peer, i: int, t: int, inc: int) -> None:
+        if a.offline(self.now) or inc != a.incarnation:
+            return  # arrived at a dead (or since-restarted) process
         self.transfers += 1
-        if b.pid in a.conns:
-            a.conns[b.pid] = self.now  # payload is useful traffic
-        a.requests.clear_piece(i, now=self.now)
-        if i in a.has or a.done_t is not None:
+        if b.pid in a.conns[t]:
+            a.conns[t][b.pid] = self.now  # payload is useful traffic
+        a.requests[t].clear_piece(i, now=self.now)
+        if i in a.has[t] or a.blob_done_t[t] is not None:
             self.duplicates += 1
-            self._select(a, b)  # endgame duplicate: just keep pulling
+            self._select(a, b, t)  # endgame duplicate: just keep pulling
             return
-        a.has.add(i)
+        a.has[t].add(i)
+        a.order[t].append(i)
         # Announce the new piece to every conn (metadata hop).
-        for cid in a.conns:
+        for cid in a.conns[t]:
             c = self.peers[cid]
             self._at(self.now + self.cfg.latency_s,
-                     lambda a=a, c=c, i=i: self._on_announce_piece(c, a, i))
-        if len(a.has) == self.cfg.num_pieces:
-            a.done_t = self.now
-            self._remaining -= 1
+                     lambda a=a, c=c, i=i: self._on_announce_piece(c, a, i, t))
+        if len(a.has[t]) == self.blobs[t]:
+            a.blob_done_t[t] = self.now
+            if all(d is not None for d in a.blob_done_t):
+                a.done_t = self.now
+                self._remaining -= 1
             return
-        self._select(a, b)
+        self._select(a, b, t)
 
-    def _on_announce_piece(self, c: _Peer, a: _Peer, i: int) -> None:
-        if a.pid not in c.conns:
+    def _on_announce_piece(self, c: _Peer, a: _Peer, i: int, t: int) -> None:
+        if a.pid not in c.conns[t]:
             return
-        c.conns[a.pid] = self.now  # progress announce is useful traffic
-        c.avail[i] = c.avail.get(i, 0) + 1
-        self._select(c, a)
+        c.conns[t][a.pid] = self.now  # progress announce is useful traffic
+        c.avail[t][i] = c.avail[t].get(i, 0) + 1
+        self._select(c, a, t)
 
     # -- reporting ---------------------------------------------------------
 
@@ -331,6 +463,7 @@ class SwarmSim:
         q = (lambda f: lat[min(n - 1, int(f * n))]) if n else (lambda f: None)
         return {
             "agents": self.cfg.n_agents,
+            "blobs": len(self.blobs),
             "completed": n,
             "incomplete": incomplete,
             "p50_s": q(0.50),
@@ -343,6 +476,7 @@ class SwarmSim:
             "transfers": self.transfers,
             "duplicate_transfers": self.duplicates,
             "busy_rejects": self.busy_rejects,
+            "restarts": self.restarts,
         }
 
 

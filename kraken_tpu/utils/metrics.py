@@ -17,6 +17,10 @@ import threading
 import time
 from typing import Iterable
 
+# One jax.profiler capture at a time, process-wide (the profiler itself
+# is global state).
+_profile_lock = threading.Lock()
+
 _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
@@ -280,7 +284,61 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
                 )
         return web.Response(text="\n".join(out), content_type="text/plain")
 
+    async def jax_profile_endpoint(request):
+        # SURVEY SS5 tracing, TPU half: capture a jax.profiler trace
+        # (XPlane/TensorBoard format) of whatever the device is doing for
+        # ?seconds=N (default 2, max 60). One capture at a time -- the
+        # profiler is process-global. ?dir= must resolve under the
+        # capture root (KRAKEN_PROFILE_DIR or the system tempdir): this
+        # is a debug mux, but it must not be a write-anywhere primitive.
+        import asyncio
+        import os
+        import tempfile
+
+        try:
+            import jax
+        except Exception:  # pragma: no cover - jax is a hard dep in prod
+            return web.Response(status=501, text="jax unavailable")
+        try:
+            seconds = min(60.0, max(0.1, float(request.query.get("seconds", 2))))
+        except ValueError:
+            return web.Response(status=400, text="malformed seconds")
+        root = os.path.realpath(
+            os.environ.get("KRAKEN_PROFILE_DIR") or tempfile.gettempdir()
+        )
+        requested = request.query.get("dir")
+        if requested:
+            out_dir = os.path.realpath(requested)
+            if os.path.commonpath([out_dir, root]) != root:
+                return web.Response(
+                    status=400,
+                    text=f"dir must live under the capture root {root}",
+                )
+        else:
+            # One fixed parent, reused: jax writes a timestamped subtree
+            # per capture, and a single parent keeps cleanup one rm -rf.
+            out_dir = os.path.join(root, "kraken-jaxprof")
+        if not _profile_lock.acquire(blocking=False):
+            return web.Response(status=409, text="capture already running")
+        try:
+            # start/stop serialize the XPlane tree -- off the loop, and
+            # stop_trace MUST run even if the client disconnects mid-
+            # sleep (cancellation between start and stop would leave the
+            # process-global profiler running forever, failing every
+            # later capture).
+            await asyncio.to_thread(jax.profiler.start_trace, out_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                await asyncio.shield(
+                    asyncio.to_thread(jax.profiler.stop_trace)
+                )
+        finally:
+            _profile_lock.release()
+        return web.json_response({"trace_dir": out_dir, "seconds": seconds})
+
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)
+    app.router.add_get("/debug/jax-profile", jax_profile_endpoint)
     return app

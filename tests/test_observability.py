@@ -290,3 +290,35 @@ def test_evict_callback_failures_metered(tmp_path):
     mgr._evict(d)
     assert mgr._evict_failures.counter.value() == before + 2
     assert not store.in_cache(d)  # eviction completed despite callbacks
+
+
+def test_debug_jax_profile_endpoint(tmp_path):
+    """/debug/jax-profile captures a jax.profiler trace (the SURVEY SS5
+    tracing story for the TPU half) and answers 409 while one runs."""
+    import aiohttp
+
+    from kraken_tpu.assembly import TrackerNode
+
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+        try:
+            out = str(tmp_path / "trace")
+            async with aiohttp.ClientSession() as http:
+                async with http.get(
+                    f"http://{tracker.addr}/debug/jax-profile",
+                    params={"seconds": "0.3", "dir": out},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    body = await r.json()
+            assert body["trace_dir"] == out
+            # A plugins/profile/<ts>/*.xplane.pb tree appears.
+            found = [
+                p for p in __import__("pathlib").Path(out).rglob("*")
+                if p.is_file()
+            ]
+            assert found, "no trace files written"
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())

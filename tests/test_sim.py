@@ -6,6 +6,8 @@ invariants, and determinism so the 10k-agent bench numbers are trustable
 as regression signals.
 """
 
+import pytest
+
 from kraken_tpu.p2p.sim import SimConfig, SwarmSim, run_sim
 
 
@@ -54,3 +56,72 @@ def test_incomplete_is_reported_not_hidden():
     r = run_sim(n_agents=100, num_pieces=64, seed=2, max_sim_s=0.5)
     assert r["incomplete"] > 0
     assert r["completed"] + r["incomplete"] == 100
+
+
+def test_downlink_caps_slow_but_complete():
+    """Per-host bandwidth caps (the YAML p2p_bandwidth knob's shape): a
+    capped downlink lowers goodput but must not wedge the swarm."""
+    free = run_sim(n_agents=100, num_pieces=16, seed=9)
+    # Cap low enough that the per-agent downlink is the binding resource:
+    # 16 x 4 MiB through 2.5 MB/s has an analytic floor of ~26.8 s.
+    capped = run_sim(
+        n_agents=100, num_pieces=16, seed=9, downlink_bps=2.5e6,
+    )
+    floor = 16 * (4 << 20) / 2.5e6
+    assert capped["completed"] == free["completed"] == 100
+    assert capped["p99_s"] >= floor  # the cap models real bandwidth
+    assert capped["p99_s"] < floor * 5  # ...without wedging the swarm
+    assert free["p99_s"] < floor  # and the free run proves it was the cap
+
+
+def test_image_shaped_multi_blob_pull():
+    """Multi-blob image pulls: every agent pulls all layers concurrently
+    over per-torrent conn budgets; latency is the LAST layer's finish.
+    Piece conservation holds per-corpus."""
+    layers = (16, 8, 4)
+    r = run_sim(n_agents=80, seed=4, blob_pieces=layers)
+    assert r["blobs"] == 3
+    assert r["completed"] == 80 and r["incomplete"] == 0
+    assert r["transfers"] == 80 * sum(layers) + r["duplicate_transfers"]
+    # A single-blob pull of the same total pieces for comparison: the
+    # image shape must not collapse throughput (layers share the uplink
+    # but parallelize the swarm).
+    single = run_sim(n_agents=80, seed=4, num_pieces=sum(layers))
+    assert r["p99_s"] < single["p99_s"] * 3
+
+
+def test_restart_wave_recovers():
+    """Mid-swarm restart chaos: a third of agents die mid-pull, lose
+    their in-flight requests and the debounced-bitfield tail, rejoin,
+    and the swarm still completes deterministically."""
+    base = run_sim(n_agents=150, num_pieces=32, seed=6)
+    r = run_sim(
+        n_agents=150, num_pieces=32, seed=6,
+        restart_at_s=base["p50_s"] / 2, restart_frac=0.33,
+        restart_down_s=1.0, restart_lose_pieces=2,
+    )
+    assert r["restarts"] == pytest.approx(150 * 0.33, abs=1)
+    assert r["completed"] == 150 and r["incomplete"] == 0
+    # NOTE: no p99-vs-base assertion -- measured, the wave can IMPROVE
+    # the tail (dropping a third of the conns mid-swarm reshuffles
+    # endgame topology, the same mechanism that makes churn load-bearing)
+    # and the sign of the effect is seed-dependent. Bounded is what
+    # matters:
+    assert r["p99_s"] < base["p99_s"] * 3
+    # Determinism holds with every feature on.
+    r2 = run_sim(
+        n_agents=150, num_pieces=32, seed=6,
+        restart_at_s=base["p50_s"] / 2, restart_frac=0.33,
+        restart_down_s=1.0, restart_lose_pieces=2,
+    )
+    assert r == r2
+
+
+def test_1k_regression_band():
+    """CI regression gate (VERDICT r4 #8): p99 at 1k agents stays within
+    +/-5% of the recorded golden (12.43 s, round 5; cross-seed spread
+    measured <1%). A policy change that shifts swarm behavior by more
+    than the noise floor must update this number CONSCIOUSLY."""
+    r = run_sim(n_agents=1000, num_pieces=64, seed=0)
+    assert r["completed"] == 1000
+    assert r["p99_s"] == pytest.approx(12.433, rel=0.05)
