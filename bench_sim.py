@@ -25,8 +25,9 @@ def main():
     ap.add_argument("--origins", type=int, default=1)
     ap.add_argument("--seed", type=int, default=1)
     # Round-5 production shapes (VERDICT r4 #8):
-    ap.add_argument("--downlink-mbps", type=float, default=0.0,
-                    help="per-host downlink cap; 0 = uplink-only model")
+    ap.add_argument("--downlink-mbytes", type=float, default=0.0,
+                    help="per-host downlink cap in MEGABYTES/s (matches "
+                         "SimConfig's bytes/s fields); 0 = uplink-only")
     ap.add_argument("--layers", type=str, default="",
                     help="comma-separated pieces per layer: image-shaped "
                          "pull (overrides --pieces)")
@@ -41,7 +42,7 @@ def main():
         piece_bytes=args.piece_mb << 20,
         n_origins=args.origins,
         seed=args.seed,
-        downlink_bps=args.downlink_mbps * 1e6,
+        downlink_bps=args.downlink_mbytes * 1e6,
         blob_pieces=(
             tuple(int(x) for x in args.layers.split(",")) if args.layers
             else None

@@ -1,0 +1,174 @@
+"""Bound the natural-vs-packed gap with a transpose-only kernel.
+
+VERDICT r4 weak #5: the ~20% gap between the natural path (in-kernel u8
+relayout + rounds) and the packed path (pure rounds) was *declared*
+irreducible ("bounded below by Mosaic's relayout throughput") but never
+isolated. This measures the missing leg: a kernel that performs ONLY the
+u8 transpose + byte-plane word recombination (with a 1-xor-per-word fold
+so Mosaic cannot dead-code it -- the fold slightly inflates the cost,
+making the bound conservative), then checks the serial composition:
+
+    1/R_natural_predicted = 1/R_transpose_only + 1/R_rounds_only
+
+All three rates use the CHAINED method (each dispatch folds the previous
+output into its input; PERF.md documents why the plain marginal method is
+untrustworthy on this relay). If measured R_natural matches the
+prediction, the gap IS the relayout and no scheduling fix inside the
+current kernel structure can recover it; a shortfall would mean overlap
+headroom. Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PIECE_LEN = int(os.environ.get("BENCH_PIECE_LEN", 256 * 1024))  # = bench.py
+REPS = int(os.environ.get("BENCH_REPS", 3))
+K_SMALL, K_LARGE = 1, 5
+
+
+def main() -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from kraken_tpu.native import pack_tiles
+    from kraken_tpu.ops.sha256 import _pad_block_for
+    from kraken_tpu.ops.sha256_pallas import (
+        _KB, _LANES, _SUB, N_TILE, packed_nb, sha256_packed_tiles,
+        sha256_tiles,
+    )
+
+    nb = PIECE_LEN // 64
+    ngroups = nb // _KB
+
+    def transpose_only_kernel(blk_ref, out_ref):
+        b = pl.program_id(1)
+
+        @pl.when(b == 0)
+        def _init():
+            for i in range(8):
+                out_ref[0, i, :, :] = jnp.zeros((_SUB, _LANES), jnp.uint32)
+
+        acc = [out_ref[0, i, :, :] for i in range(8)]
+        t8 = jnp.transpose(blk_ref[0], (1, 0)).reshape(
+            _KB, 16, 4, _SUB, _LANES
+        )
+        for kb in range(_KB):
+            for j in range(16):
+                b0 = t8[kb, j, 0].astype(jnp.uint32)
+                b1 = t8[kb, j, 1].astype(jnp.uint32)
+                b2 = t8[kb, j, 2].astype(jnp.uint32)
+                b3 = t8[kb, j, 3].astype(jnp.uint32)
+                w = (
+                    (b0 << np.uint32(24)) | (b1 << np.uint32(16))
+                    | (b2 << np.uint32(8)) | b3
+                )
+                acc[j % 8] = acc[j % 8] ^ w
+        for i in range(8):
+            out_ref[0, i, :, :] = acc[i]
+
+    @functools.partial(jax.jit)
+    def transpose_only(data_u8):
+        slabs = data_u8.reshape(1, N_TILE, nb * 64)
+        return pl.pallas_call(
+            transpose_only_kernel,
+            grid=(1, ngroups),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, N_TILE, _KB * 64), lambda ti, bi: (ti, 0, bi),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 8, _SUB, _LANES), lambda ti, bi: (ti, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((1, 8, _SUB, _LANES), jnp.uint32),
+        )(slabs)
+
+    pad = jnp.asarray(_pad_block_for(PIECE_LEN))
+
+    def chained_rate(step, x0) -> float:
+        x, out = step(x0)
+        jax.block_until_ready((x, out))
+
+        def timed(k, x):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(k):
+                x, out = step(x)
+            np.asarray(out).reshape(-1)[0]
+            return time.perf_counter() - t0, x
+
+        rates = []
+        x = x0
+        for _ in range(REPS):
+            t_s, x = timed(K_SMALL, x)
+            t_l, x = timed(K_LARGE, x)
+            rates.append(
+                (K_LARGE - K_SMALL) * N_TILE * PIECE_LEN
+                / max(t_l - t_s, 1e-9) / 1e9
+            )
+        rates.sort()
+        return rates[len(rates) // 2]
+
+    x0 = jax.random.bits(
+        jax.random.PRNGKey(0), (N_TILE, PIECE_LEN), dtype=jnp.uint8
+    )
+    x0.block_until_ready()
+
+    @jax.jit
+    def step_transpose(x):
+        out = transpose_only(x)
+        first = jax.lax.bitcast_convert_type(
+            out[0, :, 0, 0], jnp.uint8
+        ).reshape(-1)
+        return jax.lax.dynamic_update_slice(x, first[None, :32], (0, 0)), out
+
+    @jax.jit
+    def step_natural(x):
+        d = sha256_tiles(x, pad, nb)
+        first = jax.lax.bitcast_convert_type(d[0], jnp.uint8).reshape(-1)
+        return jax.lax.dynamic_update_slice(x, first[None, :], (0, 0)), d
+
+    r_transpose = chained_rate(step_transpose, x0)
+    r_natural = chained_rate(step_natural, x0)
+
+    # Packed path: chain by folding the digest into the packed words.
+    nbp = packed_nb(nb)
+    packed_np = np.zeros((1, nbp, 16, 1024), dtype=np.uint32)
+    pack_tiles(np.asarray(x0), nbp, packed_np)
+    packed0 = jnp.asarray(packed_np.reshape(1, nbp, 16, _SUB, _LANES))
+
+    @jax.jit
+    def step_packed(p):
+        d = sha256_packed_tiles(p, nb)
+        fold = d[0].astype(jnp.uint32)  # [8] words
+        return p.at[0, 0, :8, 0, 0].set(fold), d
+
+    r_packed = chained_rate(step_packed, packed0)
+
+    predicted = 1.0 / (1.0 / r_transpose + 1.0 / r_packed)
+    print(json.dumps({
+        "metric": "natural_gap_decomposition",
+        "value": round(r_natural / predicted, 3),
+        "unit": "measured_natural / serial(transpose+rounds) prediction",
+        "vs_baseline": None,
+        "transpose_only_gbps": round(r_transpose, 2),
+        "rounds_only_packed_gbps": round(r_packed, 2),
+        "natural_gbps": round(r_natural, 2),
+        "predicted_natural_gbps": round(predicted, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

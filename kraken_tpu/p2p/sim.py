@@ -344,16 +344,22 @@ class SwarmSim:
             was_complete = p.done_t is not None
             p.offline_until = self.now + cfg.restart_down_s
             p.incarnation += 1
-            # The reborn process has an EMPTY receive queue: bytes queued
-            # toward the dead one were never delivered and must not
-            # phantom-saturate the downlink bucket after rejoin.
+            # The reborn process has EMPTY transfer queues: bytes queued
+            # toward (or from) the dead one were never delivered and must
+            # not phantom-saturate either bucket after rejoin.
             p.recv_until = 0.0
+            p.busy_until = 0.0
             for t in range(len(self.blobs)):
                 for qid in list(p.conns[t]):
                     self._drop_conn(p, self.peers[qid], t)
                 # The debounced-bitfield crash window: the most recent
-                # pieces may not have hit the sidecar.
-                for i in reversed(p.order[t][-cfg.restart_lose_pieces:]):
+                # pieces may not have hit the sidecar. (Guarded: a -0
+                # slice would mean "lose everything", not "lose none".)
+                lost = (
+                    p.order[t][-cfg.restart_lose_pieces:]
+                    if cfg.restart_lose_pieces > 0 else []
+                )
+                for i in reversed(lost):
                     if i in p.has[t]:
                         p.has[t].discard(i)
                         p.order[t].remove(i)
@@ -414,12 +420,17 @@ class SwarmSim:
             a.recv_until = dn_done
             done = max(done, dn_done)
         inc = a.incarnation
+        sinc = b.incarnation
         self._at(done + self.cfg.latency_s,
-                 lambda: self._on_piece(a, b, i, t, inc))
+                 lambda: self._on_piece(a, b, i, t, inc, sinc))
 
-    def _on_piece(self, a: _Peer, b: _Peer, i: int, t: int, inc: int) -> None:
+    def _on_piece(
+        self, a: _Peer, b: _Peer, i: int, t: int, inc: int, sinc: int
+    ) -> None:
         if a.offline(self.now) or inc != a.incarnation:
             return  # arrived at a dead (or since-restarted) process
+        if sinc != b.incarnation:
+            return  # the SENDER died mid-serve: its socket died with it
         self.transfers += 1
         if b.pid in a.conns[t]:
             a.conns[t][b.pid] = self.now  # payload is useful traffic
