@@ -34,6 +34,10 @@ class CleanupConfig:
     high_watermark_bytes: int = 0  # 0 = no size pressure eviction
     low_watermark_bytes: int = 0
     interval_seconds: float = 300.0
+    # Abandoned upload spool files (client started a chunked upload and
+    # died before commit; commit/abort remove the file themselves) age
+    # out after this long without a write. 0 disables.
+    upload_ttl_seconds: float = 6 * 3600
 
 
 class CleanupManager:
@@ -123,11 +127,39 @@ class CleanupManager:
         md = self.store.get_metadata(d, PersistMetadata)
         return md is None or not md.persist
 
+    def _sweep_abandoned_uploads(self, now: float) -> None:
+        """Unlink upload-spool files idle past upload_ttl_seconds.
+
+        A live chunked upload keeps a fresh mtime with every PATCH;
+        commit renames the file out and abort unlinks it -- only uploads
+        whose client died uncommitted age to the TTL. Without this, the
+        origin's ``upload/`` dir grows forever (the proxy's upload
+        sessions have their own TTL purge; the origin's spool had none)."""
+        ttl = self.config.upload_ttl_seconds
+        if ttl <= 0:
+            return
+        try:
+            names = os.listdir(self.store.upload_dir)
+        except FileNotFoundError:
+            return
+        for name in names:
+            path = os.path.join(self.store.upload_dir, name)
+            try:
+                if now - os.path.getmtime(path) > ttl:
+                    os.unlink(path)
+            except OSError:
+                # FileNotFoundError: committed/aborted under us -- gone.
+                # Anything else (stray subdir, permission artifact): skip
+                # THIS entry, never abort the sweep -- an unremovable
+                # spool entry must not disable cache eviction forever.
+                continue
+
     def run_once(self, now: float | None = None) -> list[Digest]:
         """One eviction sweep; returns evicted digests."""
         now = time.time() if now is None else now
         cfg = self.config
         self._flush_touches()
+        self._sweep_abandoned_uploads(now)
         evicted: list[Digest] = []
 
         entries = [

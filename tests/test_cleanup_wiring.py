@@ -171,3 +171,31 @@ def test_delete_and_eviction_unseed(tmp_path):
             await tracker.stop()
 
     asyncio.run(main())
+
+
+def test_abandoned_upload_spool_ages_out(tmp_path):
+    """An upload whose client died before commit leaves a spool file; the
+    sweep removes it after upload_ttl_seconds while sparing fresh (live)
+    uploads. Commit/abort files are untouched (already gone)."""
+    import os
+    import time
+
+    from kraken_tpu.store import CAStore
+    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+
+    store = CAStore(str(tmp_path / "s"))
+    dead = store.create_upload()
+    store.write_upload_chunk(dead, 0, b"abandoned")
+    live = store.create_upload()
+    store.write_upload_chunk(live, 0, b"active")
+
+    # Age only the dead one.
+    old = time.time() - 7200
+    os.utime(store.upload_path(dead), (old, old))
+
+    mgr = CleanupManager(
+        store, CleanupConfig(tti_seconds=0, upload_ttl_seconds=3600)
+    )
+    mgr.run_once()
+    assert not store.upload_exists(dead)
+    assert store.upload_exists(live)
