@@ -237,6 +237,45 @@ def cdc_gear_rate() -> float:
     return rates[len(rates) // 2]
 
 
+def data_plane_extras() -> dict:
+    """Round-5 data-plane numbers folded into the headline line,
+    best-effort: a failure here must NEVER break the primary metric
+    (BENCH_EXTRAS=0 skips). Short configs -- the full sweeps live in
+    bench_pair.py / bench_ingest.py."""
+    if os.environ.get("BENCH_EXTRAS") == "0":
+        return {}
+    import asyncio
+    import tempfile
+
+    out: dict = {}
+    try:
+        from bench_pair import run_pair
+
+        rates = []
+        for _ in range(2):
+            with tempfile.TemporaryDirectory(dir=".") as root:
+                rates.append(
+                    asyncio.run(run_pair(128, 1024, root))["goodput_mbps"]
+                )
+        out["pair_goodput_mbps"] = max(rates)
+    except Exception as e:  # pragma: no cover - diagnostics only
+        out["pair_goodput_error"] = repr(e)[:200]
+    try:
+        from bench_ingest import make_blob, run_ingest
+
+        blob = make_blob(512)
+        rates = []
+        for _ in range(2):
+            with tempfile.TemporaryDirectory(dir=".") as root:
+                rates.append(asyncio.run(
+                    run_ingest(blob, root, "cpu", "rename", 0)
+                )["ingest_gbps"])
+        out["origin_ingest_gbps"] = max(rates)
+    except Exception as e:  # pragma: no cover - diagnostics only
+        out["origin_ingest_error"] = repr(e)[:200]
+    return out
+
+
 def main() -> None:
     cpu = None
     if os.environ.get("BENCH_SKIP_CPU") != "1":
@@ -257,6 +296,7 @@ def main() -> None:
         natural, packed_rate, pack_gbps = tpu_rates()
         chained = natural_chained_gbps()
         cdc_gbps = cdc_gear_rate()
+    extras = data_plane_extras()
     # Headline = the CHAINED number: the only method that stays stable
     # (~3% spread) on this relay; the plain marginal is exposed to
     # replay-coalescing / fence jitter (observed 31-132 GB/s swings on
@@ -274,6 +314,7 @@ def main() -> None:
                 "packed_kernel_gbps": round(packed_rate, 2),
                 "host_pack_gbps_core": round(pack_gbps, 2),
                 "cdc_gear_pallas_gbps": round(cdc_gbps, 2),
+                **extras,
             }
         )
     )
