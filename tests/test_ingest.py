@@ -205,3 +205,58 @@ def test_fsync_durability_mode(tmp_path):
         from kraken_tpu.store import CAStore
 
         CAStore(str(tmp_path / "bad"), durability="paranoid")
+
+
+def test_agent_pull_with_fsync_durability(tmp_path):
+    """durability='fsync' on the AGENT: the whole-blob fsync at torrent
+    completion runs off the event loop and the pull completes normally
+    (the swarm path, not just the origin upload path)."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_swarm import FakeTracker, make_metainfo, make_peer, NS
+
+    from kraken_tpu.p2p.scheduler import Scheduler
+
+    async def main():
+        import os
+
+        from kraken_tpu.core.peer import PeerID
+        from kraken_tpu.p2p.storage import (
+            AgentTorrentArchive, BatchedVerifier,
+        )
+        from kraken_tpu.store import CAStore
+
+        blob = os.urandom(300_000)
+        mi = make_metainfo(blob, piece_length=16384)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+        seeder, _ = make_peer(tmp_path, "seeder", tracker, seed_blob=blob)
+
+        store = CAStore(str(tmp_path / "leech"), durability="fsync")
+        ref: dict = {}
+        client = tracker.client_for(ref)
+        from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+        leecher = Scheduler(
+            peer_id=PeerID(os.urandom(20).hex()),
+            ip="127.0.0.1", port=0,
+            archive=AgentTorrentArchive(store, BatchedVerifier()),
+            metainfo_client=client, announce_client=client,
+            config=SchedulerConfig(
+                announce_interval_seconds=0.1,
+                retry_tick_seconds=0.2,
+            ),
+        )
+        ref["s"] = leecher
+        await seeder.start()
+        await leecher.start()
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 15)
+            assert store.read_cache_file(mi.digest) == blob
+        finally:
+            await seeder.stop()
+            await leecher.stop()
+
+    asyncio.run(main())
