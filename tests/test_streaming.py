@@ -23,8 +23,9 @@ from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.store import CAStore
 
 # 96 MiB keeps the suite fast; KT_STREAM_TEST_MB=1024 runs the full
-# >=1 GiB validation (verified passing 2026-07-30: peak stays under the
-# same 32 MiB bound -- 32x margin -- in ~57 s).
+# >=1 GiB validation (verified passing 2026-07-30 post round-5 ingest
+# rebuild: peak stays under the same 32 MiB bound -- 32x margin -- in
+# ~24 s, was ~57 s before stream-time hashing removed the re-read pass).
 BLOB_MB = int(os.environ.get("KT_STREAM_TEST_MB", "96"))
 PIECE = 1 << 20  # 1 MiB pieces keep the in-flight bound tight
 PEAK_BOUND = 32 << 20  # blob is 3x this (default): whole-blob buffering fails
