@@ -180,6 +180,7 @@ class OriginNode:
         dedup: bool = True,
         dedup_index: str = "dict",  # "compact" for million-blob corpora
         dedup_budget_bytes: int | None = None,
+        dedup_low_j_bands: int | None = None,  # None = default tier; 0 = off
         hash_window_bytes: int = 256 * 1024 * 1024,
         health_interval_seconds: float = 5.0,
         health_fail_threshold: int = 3,
@@ -207,6 +208,7 @@ class OriginNode:
                 self.store, hasher=get_hasher(hasher),
                 index_kind=dedup_index,
                 index_budget_bytes=dedup_budget_bytes,
+                low_j_bands=dedup_low_j_bands,
             )
             if dedup else None
         )

@@ -402,6 +402,7 @@ def main(argv: list[str] | None = None) -> None:
             cleanup=cleanup,
             dedup_index=cfg.get("dedup_index", "dict"),
             dedup_budget_bytes=cfg.get("dedup_budget_bytes"),
+            dedup_low_j_bands=cfg.get("dedup_low_j_bands"),
             scheduler_config_doc=cfg.get("scheduler"),
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,

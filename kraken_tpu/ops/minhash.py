@@ -181,6 +181,8 @@ class LSHIndex:
             )
         if low_j_bands is None:  # as many 2-row bands as the sketch allows
             low_j_bands = min(32, hasher.num_hashes // 2)
+        if low_j_bands < 0:
+            raise ValueError(f"low_j_bands must be >= 0: {low_j_bands}")
         if low_j_bands * 2 > hasher.num_hashes:
             raise ValueError(
                 f"low_j_bands {low_j_bands} needs {low_j_bands * 2} hashes, "
@@ -394,6 +396,8 @@ class CompactLSHIndex:
             )
         if low_j_bands is None:  # as many 2-row bands as the sketch allows
             low_j_bands = min(32, hasher.num_hashes // 2)
+        if low_j_bands < 0:
+            raise ValueError(f"low_j_bands must be >= 0: {low_j_bands}")
         if low_j_bands * 2 > hasher.num_hashes:
             raise ValueError(
                 f"low_j_bands {low_j_bands} needs {low_j_bands * 2} hashes, "

@@ -181,6 +181,7 @@ class DedupIndex:
         max_blobs: int = 200_000,
         index_kind: str = "dict",
         index_budget_bytes: int | None = None,
+        low_j_bands: int | None = None,  # None = index default; 0 = off
     ):
         self.store = store
         self.hasher = hasher or get_hasher("cpu")
@@ -193,9 +194,13 @@ class DedupIndex:
             self._index = CompactLSHIndex(
                 self.minhasher, num_bands=num_bands,
                 budget_bytes=index_budget_bytes,
+                low_j_bands=low_j_bands,
             )
         elif index_kind == "dict":
-            self._index = LSHIndex(self.minhasher, num_bands=num_bands)
+            self._index = LSHIndex(
+                self.minhasher, num_bands=num_bands,
+                low_j_bands=low_j_bands,
+            )
         else:
             raise ValueError(f"unknown dedup index kind: {index_kind!r}")
         self._router = ChunkRouter(self.params)

@@ -242,3 +242,18 @@ def test_chunk_router_host_and_device_paths_agree(tmp_path):
     # device path outright (no transfer benchmarking against a fake
     # device) and record the host decision.
     assert r.decision == "host"
+
+
+def test_low_j_bands_config_reaches_both_indexes(tmp_path):
+    """The dedup_low_j_bands knob flows OriginNode -> DedupIndex -> index
+    implementation; 0 disables the tier."""
+    from kraken_tpu.origin.dedup import DedupIndex
+    from kraken_tpu.store import CAStore
+
+    store = CAStore(str(tmp_path / "s"))
+    on = DedupIndex(store)
+    off = DedupIndex(store, low_j_bands=0)
+    compact_off = DedupIndex(store, index_kind="compact", low_j_bands=0)
+    assert on._index.low_j_bands == 32
+    assert off._index.low_j_bands == 0
+    assert compact_off._index.low_j_bands == 0

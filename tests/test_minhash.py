@@ -297,3 +297,15 @@ def test_low_j_tier_lifts_below_knee_retrieval():
         # and mostly-hit.
         assert hits[32][0.3] >= 0.8, hits
         assert hits[32][0.3] > hits[0][0.3] + 0.2, hits
+
+
+def test_negative_low_j_bands_rejected():
+    """A negative tier size must fail at construction, not silently drop
+    primary bands (dict index) or crash on first ingest (compact)."""
+    from kraken_tpu.ops.minhash import CompactLSHIndex, LSHIndex, MinHasher
+
+    h = MinHasher(num_hashes=128)
+    with pytest.raises(ValueError):
+        LSHIndex(h, low_j_bands=-5)
+    with pytest.raises(ValueError):
+        CompactLSHIndex(h, low_j_bands=-5)
