@@ -14,7 +14,12 @@ Variants: --hasher cpu|tpu (tpu on this rig pushes blob bytes through the
 production-shaped TPU statement is the service floor below + the
 device-resident kernel rate from bench.py), --durability rename|fsync
 (the fsync column prices the power-loss-durable mode), --no-hash
-(knocks out both hash passes to expose the pure service floor).
+(knocks out both hash passes to expose the pure service floor),
+--hash-workers N (host piece-hash pool size; default sweeps 1 and 2
+and cross-checks every variant's metainfo against the serial oracle --
+parallel hashing must be BIT-IDENTICAL, and emits a direct piece-pass
+row per worker count so pool overhead and scaling are visible without
+the HTTP client's CPU billed in).
 
 Prints one JSON line per run; `origin_ingest_gbps` last.
 """
@@ -48,14 +53,16 @@ def make_blob(size_mb: int) -> bytes:
 
 
 async def run_ingest(
-    blob: bytes, root: str, hasher: str, durability: str, chunk_mb: int
+    blob: bytes, root: str, hasher: str, durability: str, chunk_mb: int,
+    hash_workers: int = 1,
 ) -> dict:
     import aiohttp
 
     from kraken_tpu.assembly import OriginNode
 
     node = OriginNode(
-        store_root=root, hasher=hasher, dedup=False, durability=durability
+        store_root=root, hasher=hasher, dedup=False, durability=durability,
+        hash_workers=hash_workers,
     )
     await node.start()
     d = Digest(SHA256, hashlib.sha256(blob).hexdigest())
@@ -94,7 +101,7 @@ async def run_ingest(
             t0 = time.perf_counter()
             async with http.get(f"{base}/metainfo") as r:
                 assert r.status == 200, r.status
-                await r.read()
+                metainfo_body = await r.read()
             timings["metainfo_s"] = time.perf_counter() - t0
     finally:
         await node.stop()
@@ -102,11 +109,115 @@ async def run_ingest(
     total = sum(timings.values())
     return {
         "hasher": hasher,
+        "hash_workers": hash_workers,
         "durability": durability,
         "blob_mb": len(blob) // MB,
         **{k: round(v, 3) for k, v in timings.items()},
         "total_s": round(total, 3),
         "ingest_gbps": round(len(blob) / total / 1e9, 3),
+        # Bit-identity probe: parallel piece hashing must serve the SAME
+        # metainfo bytes as the serial path (compared in main()).
+        "metainfo_sha256": hashlib.sha256(metainfo_body).hexdigest(),
+    }
+
+
+def measure_piece_pass(blob: bytes, workers_list: list[int],
+                       repeats: int) -> tuple[list[dict], bytes]:
+    """The piece pass alone -- hash_pieces over the whole blob, no HTTP
+    client billing the core, no blob digest competing. workers=0 is the
+    strictly serial pre-pool oracle; the workers=1 row prices pure pool
+    overhead; workers=2 shows the scaling on this rig.
+
+    Trials INTERLEAVE the worker configs round-robin and report per-
+    config medians: this shared rig's throughput drifts tens of percent
+    on minute scales (the same pathology the TPU benches chain around,
+    PERF.md), and back-to-back sweeps ascribe that drift to whichever
+    config ran last."""
+    import statistics
+
+    from kraken_tpu.core.hasher import CPUPieceHasher
+    from kraken_tpu.origin.metainfogen import PieceLengthConfig
+
+    plen = PieceLengthConfig().piece_length(len(blob))
+    workers_list = list(dict.fromkeys(workers_list))  # --hash-workers 0 dedup
+    hashers = {w: CPUPieceHasher(workers=w) for w in workers_list}
+    digests: dict[int, str] = {}
+    hashes_bytes: dict[int, bytes] = {}
+    walls: dict[int, list[float]] = {w: [] for w in workers_list}
+    for w, h in hashers.items():  # warm: pool thread spawn off the clock
+        hashes_bytes[w] = h.hash_pieces(blob, plen).tobytes()
+        digests[w] = hashlib.sha256(hashes_bytes[w]).hexdigest()
+    for r in range(repeats):
+        # Rotate the order each round: slot-in-cycle effects (turbo
+        # ramps, hypervisor steal) otherwise bias whichever config
+        # always runs in the same position.
+        order = workers_list[r % len(workers_list):] + \
+            workers_list[:r % len(workers_list)]
+        for w in order:
+            t0 = time.perf_counter()
+            hashes = hashers[w].hash_pieces(blob, plen)
+            walls[w].append(time.perf_counter() - t0)
+            # Digest-gate EVERY timed run, not just the warm pass: an
+            # intermittent sharding bug under timing variation is the
+            # exact class this would catch. (The sha of 32 B/piece is
+            # off the clock and costs ~nothing.)
+            got = hashlib.sha256(hashes.tobytes()).hexdigest()
+            assert got == digests[w], f"timed run diverged (workers={w})"
+    rows = [
+        {
+            "piece_pass_workers": w,
+            "piece_length": plen,
+            "median_s": round(statistics.median(walls[w]), 3),
+            "piece_pass_gbps": round(
+                len(blob) / statistics.median(walls[w]) / 1e9, 3
+            ),
+            "median_of": repeats,
+            "hashes_sha256": digests[w],
+        }
+        for w in workers_list
+    ]
+    # Hand the first config's piece hashes back so the caller's metainfo
+    # oracle doesn't pay a second full serial pass over the blob.
+    return rows, hashes_bytes[workers_list[0]]
+
+
+def measure_thread_envelope(blob: bytes, repeats: int = 5) -> dict:
+    """What raw 2-thread hashlib delivers on this rig RIGHT NOW -- two
+    monolithic half-blob digests, no piece loop, no pool. This is the
+    hardware ceiling the pooled piece pass is judged against: on this
+    shared VM the second core's yield drifts between ~1.4x and ~1.6x on
+    minute scales, so a workers=2 ratio only reads correctly beside the
+    envelope measured in the same run."""
+    import statistics
+    import threading
+
+    view = memoryview(blob)
+    half = len(blob) // 2
+
+    def hash_range(lo: int, hi: int) -> None:
+        hashlib.sha256(view[lo:hi]).digest()
+
+    serial: list[float] = []
+    para: list[float] = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        hash_range(0, len(blob))
+        serial.append(time.perf_counter() - t0)
+        ts = [
+            threading.Thread(target=hash_range, args=(0, half)),
+            threading.Thread(target=hash_range, args=(half, len(blob))),
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        para.append(time.perf_counter() - t0)
+    s, p = statistics.median(serial), statistics.median(para)
+    return {
+        "raw_serial_gbps": round(len(blob) / s / 1e9, 3),
+        "raw_2thread_gbps": round(len(blob) / p / 1e9, 3),
+        "thread_envelope": round(s / p, 2),
     }
 
 
@@ -126,6 +237,8 @@ def main() -> None:
     ap.add_argument("--blob-mb", type=int, default=1024)
     ap.add_argument("--chunk-mb", type=int, default=1)
     ap.add_argument("--hasher", default="cpu")
+    ap.add_argument("--hash-workers", type=int, default=None,
+                    help="host piece-hash pool size; default sweeps 1 and 2")
     ap.add_argument("--durability", default="rename")
     ap.add_argument("--no-hash", action="store_true",
                     help="knock out both hash passes (service floor)")
@@ -154,23 +267,78 @@ def main() -> None:
         )
         args.hasher = "noop"
 
-    results = []
-    for _ in range(args.repeats):
-        with tempfile.TemporaryDirectory(dir=".") as root:
-            r = asyncio.run(run_ingest(
-                blob, root, args.hasher, args.durability, args.chunk_mb
-            ))
-            results.append(r)
-            print(json.dumps(r))
+    # Direct piece-pass rows (cpu hasher only): serial oracle, then the
+    # pooled pool sizes -- pool overhead (workers=1 vs 0) and scaling
+    # (workers=2 vs 1) without HTTP noise, digests cross-checked.
+    expected_metainfo_sha = None
+    if args.hasher == "cpu" and not args.no_hash:
+        sweep = (
+            [args.hash_workers] if args.hash_workers is not None else [1, 2]
+        )
+        pp_rows, serial_hashes = measure_piece_pass(
+            blob, [0, *sweep], args.repeats
+        )
+        serial = pp_rows[0]
+        for row in pp_rows:
+            row["matches_serial"] = (
+                row["hashes_sha256"] == serial["hashes_sha256"]
+            )
+            print(json.dumps(row))
+            assert row["matches_serial"], "parallel hashing diverged!"
+        print(json.dumps(measure_thread_envelope(blob)))
+        from kraken_tpu.core.metainfo import MetaInfo
 
-    best = max(results, key=lambda r: r["ingest_gbps"])
+        d = Digest(SHA256, hashlib.sha256(blob).hexdigest())
+        expected_metainfo_sha = hashlib.sha256(MetaInfo(
+            d, len(blob), serial["piece_length"], serial_hashes,
+        ).serialize()).hexdigest()
+    else:
+        sweep = [args.hash_workers if args.hash_workers is not None else 1]
+
+    results = []
+    for workers in sweep:
+        for _ in range(args.repeats):
+            with tempfile.TemporaryDirectory(dir=".") as root:
+                r = asyncio.run(run_ingest(
+                    blob, root, args.hasher, args.durability, args.chunk_mb,
+                    hash_workers=workers,
+                ))
+                if expected_metainfo_sha is not None:
+                    r["metainfo_matches_serial"] = (
+                        r["metainfo_sha256"] == expected_metainfo_sha
+                    )
+                results.append(r)
+                print(json.dumps(r))
+                assert r.get("metainfo_matches_serial", True), (
+                    "served metainfo diverged from the serial oracle!"
+                )
+
+    # Median WITHIN each workers config (cancels run noise -- best-of was
+    # the bench_pair cherry-picking this round removes), best config BY
+    # median across the sweep (config comparison is the point).
+    import statistics
+
+    per_config = []
+    for workers in sweep:
+        vals = sorted(
+            r["ingest_gbps"] for r in results if r["hash_workers"] == workers
+        )
+        med = statistics.median(vals)
+        per_config.append({
+            "hash_workers": workers,
+            "median_gbps": round(med, 3),
+            "median_of": len(vals),
+            "min": vals[0],
+            "max": vals[-1],
+        })
+    best = max(per_config, key=lambda c: c["median_gbps"])
     name = "origin_ingest_gbps" if not args.no_hash else "origin_ingest_service_gbps"
     print(json.dumps({
         "metric": name,
-        "value": best["ingest_gbps"],
+        "value": best["median_gbps"],
         "unit": "GB/s",
         "vs_baseline": None,
-        "detail": best,
+        "detail": {"per_config": per_config, "best_config": best},
     }))
 
 

@@ -88,11 +88,22 @@ def main() -> None:
             results.append(r)
             print(json.dumps(r))
 
-    best = max(results, key=lambda r: r["goodput_mbps"])
+    # Median +/- spread of N runs (VERDICT r5 next #3): single best-of
+    # runs on this shared core produced BENCH-vs-PERF discrepancies
+    # (282.9 recorded vs a "301-371" band); the median is the honest
+    # central number and the spread is the honest error bar.
+    import statistics
+
+    vals = sorted(r["goodput_mbps"] for r in results)
+    med = statistics.median(vals)
     print(json.dumps({
         "metric": "pair_goodput_mbps",
-        "value": best["goodput_mbps"],
+        "value": round(med, 1),
         "unit": "MB/s",
+        "median_of": len(vals),
+        "min": vals[0],
+        "max": vals[-1],
+        "spread_pct": round(100 * (vals[-1] - vals[0]) / med, 1) if med else None,
         "vs_baseline": None,
     }))
 

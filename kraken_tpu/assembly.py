@@ -171,6 +171,7 @@ class OriginNode:
         http_port: int = 0,
         p2p_port: int = 0,
         hasher: str = "cpu",
+        hash_workers: int = 1,
         backends: BackendManager | None = None,
         ring: Ring | None = None,
         self_addr: str = "",
@@ -197,15 +198,21 @@ class OriginNode:
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root, durability=durability)
         self.hasher_name = hasher
+        # hash_workers sizes the HOST piece-hash pool (cpu hasher only;
+        # device hashers parallelize over the batch axis instead). 1 =
+        # one pool worker -- piece hashing already overlaps the serial
+        # blob digest at stream time; raise toward the core count on
+        # multi-core origins (docs/OPERATIONS.md). 0 = strictly serial.
+        self.hash_workers = hash_workers
         self.generator = Generator(
             self.store,
-            hasher=get_hasher(hasher),
+            hasher=get_hasher(hasher, workers=hash_workers),
             piece_lengths=piece_lengths,
             window_bytes=hash_window_bytes,
         )
         self.dedup = (
             DedupIndex(
-                self.store, hasher=get_hasher(hasher),
+                self.store, hasher=get_hasher(hasher, workers=hash_workers),
                 index_kind=dedup_index,
                 index_budget_bytes=dedup_budget_bytes,
                 low_j_bands=dedup_low_j_bands,
@@ -414,7 +421,9 @@ class OriginNode:
                         TorrentMetaMetadata,
                     )
 
-                    self.store.delete_metadata(d, TorrentMetaMetadata)
+                    await asyncio.to_thread(
+                        self.store.delete_metadata, d, TorrentMetaMetadata
+                    )
                     continue
                 self.scheduler.seed(metainfo, "startup")
             except Exception:
@@ -623,6 +632,7 @@ class AgentNode:
         registry_port: int = 0,
         build_index_addr: str = "",
         hasher: str = "cpu",
+        hash_workers: int = 1,
         cleanup: CleanupConfig | None = None,
         scheduler_config: SchedulerConfig | None = None,
         p2p_bandwidth: dict | None = None,
@@ -642,8 +652,11 @@ class AgentNode:
         # so arrivals coalesce into real device batches -- a batch-of-1
         # blocking dispatch per piece is what BatchedVerifier exists to
         # avoid.
+        # hash_workers: the same host hash pool the origin uses, here
+        # feeding BatchedVerifier.hash_batch -- a multi-core agent
+        # verifies a piece batch across cores instead of one.
         self.verifier = BatchedVerifier(
-            hasher=get_hasher(hasher),
+            hasher=get_hasher(hasher, workers=hash_workers),
             max_delay_seconds=0.0 if hasher == "cpu" else 0.002,
         )
         self.cleanup = (

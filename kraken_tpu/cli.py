@@ -95,6 +95,10 @@ def main(argv: list[str] | None = None) -> None:
     p_origin.add_argument("--tracker", default=None)
     p_origin.add_argument("--p2p-port", type=int, default=None)
     p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
+    p_origin.add_argument("--hash-workers", type=int, default=None,
+                          help="host piece-hash pool size (cpu hasher);"
+                               " raise toward the core count on multi-core"
+                               " origins; 0 = strictly serial")
     p_origin.add_argument("--cluster", default=None,
                           help="comma-separated origin http addrs (incl. self)")
     p_origin.add_argument("--cluster-dns", default=None,
@@ -112,6 +116,9 @@ def main(argv: list[str] | None = None) -> None:
     p_agent.add_argument("--tracker", default=None)
     p_agent.add_argument("--p2p-port", type=int, default=None)
     p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
+    p_agent.add_argument("--hash-workers", type=int, default=None,
+                         help="host piece-hash pool size for the verify"
+                              " plane (cpu hasher); 0 = strictly serial")
     p_agent.add_argument("--registry-port", type=int, default=None,
                          help="serve the docker-registry read API here"
                               " (requires --build-index)")
@@ -396,6 +403,7 @@ def main(argv: list[str] | None = None) -> None:
             http_port=port,
             p2p_port=pick(args.p2p_port, "p2p_port", 0),
             hasher=pick(args.hasher, "hasher", "cpu"),
+            hash_workers=int(pick(args.hash_workers, "hash_workers", 1)),
             backends=backends,
             ring=ring,
             self_addr=self_addr,
@@ -431,6 +439,7 @@ def main(argv: list[str] | None = None) -> None:
             registry_port=registry_port or 0,
             build_index_addr=build_index,
             hasher=pick(args.hasher, "hasher", "cpu"),
+            hash_workers=int(pick(args.hash_workers, "hash_workers", 1)),
             cleanup=cleanup,
             scheduler_config=(
                 SchedulerConfig.from_dict(scheduler_cfg)

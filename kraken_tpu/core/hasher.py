@@ -21,12 +21,92 @@ of pieces. A per-piece call would hide the batch axis the hardware needs.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict
 
 import numpy as np
 
 DIGEST_SIZE = 32
+
+
+class HashPool:
+    """Worker threads for the HOST piece-hash path (`hash_workers`).
+
+    Piece hashing is embarrassingly parallel and ``hashlib`` releases
+    the GIL for large buffers, so N workers hash N pieces genuinely
+    concurrently -- the multi-core lever the serial loop left on the
+    table (ingest was hash-bound at 0.365 GB/s on one core; VERDICT r5
+    missing #2). The running blob digest stays OFF this pool: it is
+    order-dependent and remains the stated serial term of the ingest
+    scaling model (PERF.md "parallel host hashing").
+
+    Occupancy and queue-depth gauges publish at every task edge (submit/
+    start/finish -- a few per piece or per window shard, so the metric
+    cost is noise next to a 4 MiB sha pass).
+
+    Known scheduling limitation: the pool is one FIFO shared by the live
+    stream tier and the background re-read passes (generate() on tier
+    miss / reseed / scrub, dedup chunk hashing), so a stream piece
+    submitted behind a ~window/workers-sized generate() shard waits for
+    it (order ~100 ms). Those re-read passes are rare on a healthy
+    origin; if they become foreground work, a second pool (distinct
+    hash_workers instance) isolates them.
+    """
+
+    def __init__(self, workers: int, name: str = "cpu"):
+        if workers < 1:
+            raise ValueError(f"hash pool needs >= 1 worker: {workers}")
+        self.workers = workers
+        self.name = name
+        self._ex = ThreadPoolExecutor(
+            workers, thread_name_prefix=f"hashpool-{name}"
+        )
+        self._lock = threading.Lock()
+        self._running = 0
+        self._queued = 0
+        self._publish()  # gauges visible on /metrics from construction
+
+    def _publish(self) -> None:
+        from kraken_tpu.utils.metrics import record_hash_pool_metrics
+
+        record_hash_pool_metrics(
+            self.name, self.workers, self._running, self._queued
+        )
+
+    def submit(self, fn: Callable, *args) -> Future:
+        with self._lock:
+            self._queued += 1
+            self._publish()
+
+        def run():
+            with self._lock:
+                self._queued -= 1
+                self._running += 1
+                self._publish()
+            try:
+                return fn(*args)
+            finally:
+                with self._lock:
+                    self._running -= 1
+                    self._publish()
+
+        return self._ex.submit(run)
+
+    def run_sharded(self, n: int, worker: Callable[[int, int], None]) -> None:
+        """Run ``worker(lo, hi)`` over ``[0, n)`` split into at most
+        ``self.workers`` contiguous shards, blocking until all finish.
+        The split is contiguous so each worker walks memory sequentially
+        (pieces are adjacent in the source buffer)."""
+        shards = min(self.workers, n)
+        bounds = [k * n // shards for k in range(shards + 1)]
+        futs = [
+            self.submit(worker, bounds[k], bounds[k + 1])
+            for k in range(shards)
+        ]
+        for f in futs:
+            f.result()
 
 
 def record_hash_metrics(
@@ -60,6 +140,11 @@ class PieceHasher:
     """
 
     name = "abstract"
+    # Host hash-worker pool, when the implementation has one (the cpu
+    # hasher with hash_workers >= 1). Callers that can feed independent
+    # pieces concurrently (the origin's stream-time tier) use it
+    # directly; None = strictly serial hashing.
+    pool: HashPool | None = None
 
     def hash_pieces(self, data: bytes | memoryview, piece_length: int) -> np.ndarray:
         """Split ``data`` into ``piece_length`` pieces (last may be short)
@@ -78,9 +163,25 @@ class PieceHasher:
 
 class CPUPieceHasher(PieceHasher):
     """Reference implementation on hashlib. Also the golden oracle for the
-    TPU plane's tests (crypto hashes admit no tolerance)."""
+    TPU plane's tests (crypto hashes admit no tolerance).
+
+    ``workers >= 1`` hashes independent pieces through a :class:`HashPool`
+    (hashlib drops the GIL, so workers scale with cores); ``workers <= 0``
+    is the strictly serial pre-pool path -- the registry default, and the
+    oracle the pooled path is parity-tested against. Digests are
+    bit-identical either way: sharding only reorders WHICH thread hashes
+    a piece, never the piece boundaries.
+    """
 
     name = "cpu"
+
+    def __init__(self, workers: int = 0):
+        # Pool label carries the worker count: two pools in one process
+        # (origin hash_workers=4 + agent hash_workers=2) must not clobber
+        # each other's gauges.
+        self.pool = (
+            HashPool(workers, name=f"cpu/{workers}") if workers >= 1 else None
+        )
 
     def hash_pieces(self, data: bytes | memoryview, piece_length: int) -> np.ndarray:
         if piece_length <= 0:
@@ -89,9 +190,32 @@ class CPUPieceHasher(PieceHasher):
         view = memoryview(data)
         n = (len(view) + piece_length - 1) // piece_length
         out = np.empty((n, DIGEST_SIZE), dtype=np.uint8)
-        for i in range(n):
-            piece = view[i * piece_length : (i + 1) * piece_length]
-            out[i] = np.frombuffer(hashlib.sha256(piece).digest(), dtype=np.uint8)
+
+        def run(lo: int, hi: int) -> None:
+            # One row-matrix write per SHARD, not per piece: the digest
+            # list + join keeps the GIL-held numpy work out of the inner
+            # loop, which measures ~5% under 2-thread contention. Rows
+            # are disjoint, so concurrent shard writes never conflict.
+            digs = [
+                hashlib.sha256(
+                    view[i * piece_length : (i + 1) * piece_length]
+                ).digest()
+                for i in range(lo, hi)
+            ]
+            out[lo:hi] = np.frombuffer(
+                b"".join(digs), dtype=np.uint8
+            ).reshape(-1, DIGEST_SIZE)
+
+        # The pool only helps a BLOCKING batch call when it can shard
+        # (workers >= 2): a 1-worker pool would move the whole pass to
+        # another thread and wait -- pure overhead. (A 1-worker pool
+        # still earns its keep on the stream tier, where piece hashing
+        # OVERLAPS the serial blob digest via submit().)
+        if self.pool is None or self.pool.workers < 2 or n <= 1:
+            if n:
+                run(0, n)
+        else:
+            self.pool.run_sharded(n, run)
         if n:
             record_hash_metrics(
                 self.name, len(view), n, time.perf_counter() - start
@@ -100,8 +224,18 @@ class CPUPieceHasher(PieceHasher):
 
     def hash_batch(self, pieces: list[bytes | memoryview]) -> np.ndarray:
         out = np.empty((len(pieces), DIGEST_SIZE), dtype=np.uint8)
-        for i, p in enumerate(pieces):
-            out[i] = np.frombuffer(hashlib.sha256(p).digest(), dtype=np.uint8)
+
+        def run(lo: int, hi: int) -> None:
+            digs = [hashlib.sha256(pieces[i]).digest() for i in range(lo, hi)]
+            out[lo:hi] = np.frombuffer(
+                b"".join(digs), dtype=np.uint8
+            ).reshape(-1, DIGEST_SIZE)
+
+        if self.pool is None or self.pool.workers < 2 or len(pieces) <= 1:
+            if pieces:
+                run(0, len(pieces))
+        else:
+            self.pool.run_sharded(len(pieces), run)
         return out
 
 
@@ -113,14 +247,25 @@ def register_hasher(name: str, factory: Callable[[], PieceHasher]) -> None:
     _REGISTRY[name] = factory
 
 
-def get_hasher(name: str = "cpu") -> PieceHasher:
+def get_hasher(name: str = "cpu", workers: int = 0) -> PieceHasher:
     """Resolve a hasher by registry name (``cpu``, ``tpu``,
     ``tpu-sharded`` -- the last fans the piece batch across every local
     chip via shard_map).
 
     Instances are cached: TPU hasher construction compiles kernels, so the
     origin and agent share one instance per process.
+
+    ``workers`` (the YAML ``hash_workers`` knob) applies only to the cpu
+    hasher: ``workers >= 1`` returns a pooled instance cached per worker
+    count, so an origin and an agent configured alike share one pool per
+    process. Device hashers ignore it -- their parallelism is the batch
+    axis, not host threads.
     """
+    if name == "cpu" and workers >= 1:
+        key = f"cpu/{workers}"
+        if key not in _INSTANCES:
+            _INSTANCES[key] = CPUPieceHasher(workers=workers)
+        return _INSTANCES[key]
     if name not in _INSTANCES:
         if name not in _REGISTRY:
             # Importing the plane registers its hashers; deferred so that

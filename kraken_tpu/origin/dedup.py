@@ -113,6 +113,16 @@ class ChunkRouter:
         return self._host_spans(data)
 
 
+class DedupEvictionRace(KeyError):
+    """Eviction (or DELETE) raced an in-flight ``add_blob`` between the
+    chunk/sketch compute and the index admit. Benign by design -- the
+    index must simply not plant a ghost entry for a blob nobody can
+    fetch -- and therefore NOT a dedup-plane failure: callers count it
+    separately from ``origin_dedup_failures_total`` (round-5 ADVICE).
+    Subclasses KeyError so existing blob-not-found handling (404 on
+    ``/similar``) keeps working."""
+
+
 _MAGIC = 0xC5
 # v2: ledger fingerprints widened to 64-bit (first 8 digest bytes). The v1
 # 32-bit ledger saw likely birthday collisions past ~2^16 unique chunks,
@@ -299,7 +309,7 @@ class DedupIndex:
                 # for -- /similar would hand out a blob nobody can fetch
                 # -- and the sidecar write would orphan a ._md file
                 # beside a deleted blob.
-                raise KeyError(d.hex)
+                raise DedupEvictionRace(d.hex)
             self.store.set_metadata(d, record)
         self._admit(d, record)
         self._evict_over_cap(keep=d.hex)
@@ -328,7 +338,7 @@ class DedupIndex:
                 # (on_evict's remove_sync shares this lock, so checking
                 # inside it leaves only the remove_sync->delete sliver):
                 # indexing would plant a ghost /similar could hand out.
-                raise KeyError(d.hex)
+                raise DedupEvictionRace(d.hex)
             self._indexed[d.hex] = None
             self._index.add(d.hex, record.sketch)
             for fp, size in zip(record.fps.tolist(), record.sizes.tolist()):

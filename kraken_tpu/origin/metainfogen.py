@@ -96,7 +96,21 @@ class Generator:
             return cached
         size = self.store.cache_size(d)  # KeyError if absent
         piece_length = self.piece_lengths.piece_length(size)
-        window = max(piece_length, self.window_bytes // piece_length * piece_length)
+        # Floor the window at a FEW pieces when a hash pool exists, so a
+        # tiny configured window cannot fully serialize the sharded
+        # piece pass -- but cap the floor at 4 pieces: window_bytes is
+        # the operator's MEMORY bound, and flooring at workers pieces
+        # would silently inflate it ~(workers/windowpieces)x on many-core
+        # origins (16 MiB pieces x 62 workers = ~1 GiB/window). A window
+        # of k pieces still shards k ways; full occupancy wants
+        # window_bytes >= workers * piece_length, which OPERATIONS.md
+        # leaves to the operator.
+        pool = getattr(self.hasher, "pool", None)  # duck-typed test hashers
+        min_pieces = min(pool.workers, 4) if pool is not None else 1
+        window = max(
+            piece_length * min_pieces,
+            self.window_bytes // piece_length * piece_length,
+        )
         parts = []
         # One-window lookahead: the read of window i+1 runs in a side
         # thread while the hasher chews window i, so a TPU dispatch never

@@ -127,14 +127,19 @@ class CleanupManager:
         md = self.store.get_metadata(d, PersistMetadata)
         return md is None or not md.persist
 
-    def _sweep_abandoned_uploads(self, now: float) -> None:
+    def _sweep_abandoned_uploads(self) -> None:
         """Unlink upload-spool files idle past upload_ttl_seconds.
 
         A live chunked upload keeps a fresh mtime with every PATCH;
         commit renames the file out and abort unlinks it -- only uploads
         whose client died uncommitted age to the TTL. Without this, the
         origin's ``upload/`` dir grows forever (the proxy's upload
-        sessions have their own TTL purge; the origin's spool had none)."""
+        sessions have their own TTL purge; the origin's spool had none).
+
+        WALL CLOCK ONLY, never ``run_once(now=...)``'s injected clock:
+        that parameter exists for simulated TTI sweeps, but spool ages
+        come from real filesystem mtimes -- a future-dated simulated now
+        would unlink LIVE spool files mid-upload (round-5 ADVICE)."""
         ttl = self.config.upload_ttl_seconds
         if ttl <= 0:
             return
@@ -142,6 +147,7 @@ class CleanupManager:
             names = os.listdir(self.store.upload_dir)
         except FileNotFoundError:
             return
+        now = time.time()
         for name in names:
             path = os.path.join(self.store.upload_dir, name)
             try:
@@ -159,7 +165,7 @@ class CleanupManager:
         now = time.time() if now is None else now
         cfg = self.config
         self._flush_touches()
-        self._sweep_abandoned_uploads(now)
+        self._sweep_abandoned_uploads()
         evicted: list[Digest] = []
 
         entries = [

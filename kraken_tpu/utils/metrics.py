@@ -168,6 +168,29 @@ class Registry:
 REGISTRY = Registry()
 
 
+def record_hash_pool_metrics(
+    pool: str, workers: int, running: int, queued: int,
+    registry: Registry = REGISTRY,
+) -> None:
+    """Per-pool gauges for the host hash-worker pools (`hash_workers`):
+    occupancy (busy workers / pool size) says whether the piece pass is
+    actually parallel; queue depth says whether the pool is the
+    bottleneck (persistently > 0 ⇒ raise `hash_workers`, if cores
+    allow). Labeled by pool name so an origin and an agent sharing a
+    process stay distinguishable."""
+    registry.gauge(
+        "hash_pool_workers", "Configured size of the host hash pool"
+    ).set(workers, pool=pool)
+    registry.gauge(
+        "hash_pool_occupancy",
+        "Busy hash-pool workers / pool size (sampled at task edges)",
+    ).set(running / workers if workers else 0.0, pool=pool)
+    registry.gauge(
+        "hash_pool_queue_depth",
+        "Hash tasks waiting for a free pool worker",
+    ).set(queued, pool=pool)
+
+
 class FailureMeter:
     """Counter + throttled WARN for control loops that must swallow
     failures to keep running (announce, ring refresh, health probes).

@@ -199,3 +199,26 @@ def test_abandoned_upload_spool_ages_out(tmp_path):
     mgr.run_once()
     assert not store.upload_exists(dead)
     assert store.upload_exists(live)
+
+
+def test_simulated_now_cannot_unlink_live_uploads(tmp_path):
+    """run_once(now=...) exists for simulated TTI clocks, but spool ages
+    come from REAL filesystem mtimes: the sweep must use wall clock for
+    them, or a future-dated simulated now unlinks live uploads mid-stream
+    (round-5 ADVICE)."""
+    import time
+
+    from kraken_tpu.store import CAStore
+    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+
+    store = CAStore(str(tmp_path / "s"))
+    live = store.create_upload()
+    store.write_upload_chunk(live, 0, b"mid-stream")
+
+    mgr = CleanupManager(
+        store, CleanupConfig(tti_seconds=0, upload_ttl_seconds=3600)
+    )
+    # Ten TTLs in the future on the injected clock; the spool file's real
+    # mtime is NOW, so it must survive.
+    mgr.run_once(now=time.time() + 10 * 3600)
+    assert store.upload_exists(live)

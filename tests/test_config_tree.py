@@ -24,7 +24,7 @@ CLI_KEYS = {
     "announce_interval_seconds", "peer_ttl_seconds", "peerstore_redis",
     "registry_port", "build_index", "spool", "remotes", "dedup_index",
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
-    "tag_cache_ttl", "durability", "dedup_low_j_bands",
+    "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
 }
 
 

@@ -178,6 +178,53 @@ class TestCPUPieceHasher:
             get_hasher("nope")
 
 
+class TestPooledCPUPieceHasher:
+    """hash_workers pool: bit-identical to the serial oracle -- sharding
+    only reorders WHICH thread hashes a piece, never piece boundaries --
+    and visible on the pool gauges."""
+
+    def test_hash_pieces_parity_with_serial(self):
+        blob = blob_fixture(1_000_000, seed=11)
+        serial = CPUPieceHasher().hash_pieces(blob, 4096)
+        for workers in (1, 2, 3):
+            pooled = CPUPieceHasher(workers=workers).hash_pieces(blob, 4096)
+            assert (pooled == serial).all(), workers
+
+    def test_hash_pieces_parity_ragged_and_tiny(self):
+        h = CPUPieceHasher(workers=2)
+        for size in (0, 1, 4095, 4096, 4097, 40_961):
+            blob = blob_fixture(size, seed=size) if size else b""
+            assert (
+                h.hash_pieces(blob, 4096)
+                == CPUPieceHasher().hash_pieces(blob, 4096)
+            ).all(), size
+
+    def test_hash_batch_parity(self):
+        pieces = [b"", b"x", blob_fixture(5000, seed=1),
+                  blob_fixture(100_000, seed=2)]
+        serial = CPUPieceHasher().hash_batch(pieces)
+        pooled = CPUPieceHasher(workers=2).hash_batch(pieces)
+        assert (pooled == serial).all()
+
+    def test_registry_caches_per_worker_count(self):
+        assert get_hasher("cpu", workers=2) is get_hasher("cpu", workers=2)
+        assert get_hasher("cpu", workers=2) is not get_hasher("cpu")
+        assert get_hasher("cpu").pool is None
+        assert get_hasher("cpu", workers=2).pool.workers == 2
+
+    def test_pool_gauges_visible(self):
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        CPUPieceHasher(workers=2).hash_pieces(blob_fixture(100_000, seed=3),
+                                              4096)
+        text = REGISTRY.render()
+        # Label carries the worker count: two pools in one process must
+        # publish to distinct series.
+        assert 'hash_pool_workers{pool="cpu/2"} 2' in text
+        assert "hash_pool_occupancy" in text
+        assert "hash_pool_queue_depth" in text
+
+
 def test_metainfo_deserialize_fuzz_only_metainfoerror():
     """Metainfo comes off the wire (tracker proxy): any corruption --
     structural or bit-level -- must surface as MetaInfoError, never a raw

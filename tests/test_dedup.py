@@ -257,3 +257,51 @@ def test_low_j_bands_config_reaches_both_indexes(tmp_path):
     assert on._index.low_j_bands == 32
     assert off._index.low_j_bands == 0
     assert compact_off._index.low_j_bands == 0
+
+
+def test_eviction_race_raises_typed_and_not_counted_as_failure(tmp_path):
+    """Eviction racing an in-flight add_blob raises DedupEvictionRace
+    (still a KeyError for the 404 paths) and the origin server counts it
+    in origin_dedup_eviction_races_total, NOT in the failure meter the
+    races were polluting (round-5 ADVICE)."""
+    from kraken_tpu.origin.dedup import DedupEvictionRace
+    from kraken_tpu.origin.server import OriginServer
+    from kraken_tpu.origin.metainfogen import Generator
+    from kraken_tpu.utils.metrics import REGISTRY
+
+    store = CAStore(str(tmp_path / "s"))
+    blob = np.random.default_rng(9).integers(
+        0, 256, 32 * 1024, np.uint8
+    ).tobytes()
+    d = _store_blob(store, blob)
+    index = DedupIndex(store, params=PARAMS)
+    # Simulate the race: the blob "evicts" between compute and admit.
+    store.in_cache = lambda _d: False
+    with pytest.raises(DedupEvictionRace):
+        index.add_blob_sync(d)
+    assert isinstance(DedupEvictionRace(d.hex), KeyError)
+
+    # Server-side accounting: races and real failures diverge.
+    async def main():
+        server = OriginServer(
+            store=store, generator=Generator(store), dedup=index,
+            stream_piece_hash=False,
+        )
+        races = REGISTRY.counter("origin_dedup_eviction_races_total")
+        failures = REGISTRY.counter("origin_dedup_failures_total")
+        r0, f0 = races.value(), failures.value()
+        server._schedule_dedup(d)  # hits the monkeypatched race
+        await asyncio.gather(*server._dedup_tasks)
+        assert races.value() == r0 + 1
+        assert failures.value() == f0
+
+        async def boom(_d):
+            raise RuntimeError("sidecar corrupt")
+
+        index.add_blob = boom  # a REAL fault still lands in the meter
+        server._schedule_dedup(d)
+        await asyncio.gather(*server._dedup_tasks)
+        assert races.value() == r0 + 1
+        assert failures.value() == f0 + 1
+
+    asyncio.run(main())

@@ -90,6 +90,141 @@ def test_stream_metainfo_matches_generate(tmp_path):
     asyncio.run(main())
 
 
+def test_stream_metainfo_matches_generate_pooled(tmp_path):
+    """hash_workers=2: stream-time pieces are hashed on pool workers in
+    piece order while the blob digest streams serially -- the MetaInfo
+    must still be byte-identical to the serial oracle, including across
+    chunk boundaries that straddle pieces and a short trailing piece."""
+
+    async def main():
+        import os
+
+        blob = os.urandom(9 * PIECE + 1234)
+        d = Digest.from_bytes(blob)
+        node = _node(tmp_path, hash_workers=2)
+        await node.start()
+        try:
+            # Deliberately piece-misaligned chunk boundaries.
+            cuts = [0, PIECE // 3, 4 * PIECE + 17, 7 * PIECE - 1, len(blob)]
+            chunks = [blob[a:b] for a, b in zip(cuts, cuts[1:])]
+            status, _ = await _upload(node.addr, d, chunks)
+            assert status == 201
+            stored = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            want = get_hasher("cpu").hash_pieces(blob, PIECE).tobytes()
+            assert stored.serialize() == type(stored)(
+                d, len(blob), PIECE, want
+            ).serialize()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_patch_failure_invalidates_tracker(tmp_path):
+    """An exception escaping the spool-file close (deferred write error,
+    e.g. ENOSPC at flush) must invalidate the upload digest tracker: a
+    client that carries on as if the PATCH landed must get the verifying
+    re-read at commit, never the fast path over a possible hole
+    (round-5 ADVICE, medium)."""
+
+    async def main():
+        import os
+
+        from kraken_tpu.core.digest import Digest as D
+
+        blob = os.urandom(2 * PIECE)
+        d = Digest.from_bytes(blob)
+        node = _node(tmp_path)
+        await node.start()
+
+        class FailingClose:
+            def __init__(self, f):
+                self._f = f
+
+            def __getattr__(self, a):
+                return getattr(self._f, a)
+
+            def close(self):
+                self._f.close()
+                raise OSError("deferred write error at close")
+
+        orig_open = node.store.open_upload_file
+        patches = {"n": 0}
+
+        def open_patched(uid):
+            patches["n"] += 1
+            f = orig_open(uid)
+            return FailingClose(f) if patches["n"] == 1 else f
+
+        node.store.open_upload_file = open_patched
+        reads = {"n": 0}
+        orig_reader = D.from_reader.__func__
+
+        def counting_reader(cls, f):
+            reads["n"] += 1
+            return orig_reader(cls, f)
+
+        D.from_reader = classmethod(counting_reader)
+        try:
+            from aiohttp import ClientSession
+
+            base = f"http://{node.addr}/namespace/ns/blobs/{d}"
+            async with ClientSession() as http:
+                async with http.post(f"{base}/uploads") as r:
+                    uid = await r.text()
+                # First PATCH: bytes land, close raises -> 500.
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[:PIECE],
+                    headers={"X-Upload-Offset": "0"},
+                ) as r:
+                    assert r.status == 500
+                # Client believes it landed and streams on sequentially.
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[PIECE:],
+                    headers={"X-Upload-Offset": str(PIECE)},
+                ) as r:
+                    assert r.status == 204
+                async with http.put(f"{base}/uploads/{uid}/commit") as r:
+                    assert r.status == 201, await r.text()
+            # Commit must have taken the verifying re-read, not the
+            # invalidated tracker's fast path.
+            assert reads["n"] >= 1
+            assert node.store.read_cache_file(d) == blob
+        finally:
+            D.from_reader = classmethod(orig_reader)
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_invalidated_pooled_tracker_drops_chunk_pins():
+    """A pooled tracker buffers memoryview slices of request-body chunks
+    until their piece completes; invalidation (PATCH failure, offset
+    mismatch) must drop those pins -- an invalidated tracker can sit in
+    the map for the 6h TTL, and each view keeps its whole parent chunk
+    alive."""
+    import io
+
+    from kraken_tpu.core.hasher import HashPool
+    from kraken_tpu.origin.server import _UploadDigest
+
+    pool = HashPool(1, name="cpu/test-pins")
+    t = _UploadDigest(piece_length=4096, pool=pool)
+    t.begin_patch(0)
+    t.write_and_update(io.BytesIO(), b"x" * 1000)  # partial piece buffered
+    assert t._parts
+    t.end_patch()
+    t.invalidate()
+    assert not t._parts and not t._futs
+    # And the offset-mismatch path drops them too.
+    t2 = _UploadDigest(piece_length=4096, pool=pool)
+    t2.begin_patch(0)
+    t2.write_and_update(io.BytesIO(), b"y" * 1000)
+    t2.end_patch()
+    assert not t2.begin_patch(999)  # wrong offset -> invalidate
+    assert not t2._parts
+
+
 def test_out_of_order_patches_fall_back_and_verify(tmp_path):
     """Reverse-order PATCHes break the running digest; commit must fall
     back to the verifying re-read and still land correctly -- and a
