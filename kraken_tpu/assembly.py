@@ -33,6 +33,7 @@ from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import FailureMeter, instrument_app
@@ -98,12 +99,24 @@ async def _ring_refresh_loop(get_cluster, interval: float) -> None:
 
 async def _serve(app: web.Application, host: str, port: int,
                  component: str = "", ssl_context=None):
+    # Chaos guard: refuse to bind a listener while failpoints are armed
+    # without the explicit acknowledgement (utils/failpoints.py) -- a
+    # stray `failpoints:` config section or a leftover test arm() must
+    # fail the boot loudly, never inject silently in rotation.
+    failpoints.FAILPOINTS.assert_safe(component or "node")
     if component:
         # Per-endpoint latency/status metrics + GET /metrics on every
         # component app (lib/middleware + tally in the reference --
         # upstream path, unverified; SURVEY.md SS2.4/SS5).
         instrument_app(app, component)
-    runner = web.AppRunner(app)
+    # handler_cancellation: aiohttp >= 3.8 stopped cancelling handlers on
+    # client disconnect by default; this codebase is written for the
+    # cancelling contract (the 499 accounting in instrument_app, the
+    # upload-tracker invalidation on aborted PATCH bodies, the shielded
+    # jax-profile stop) -- without it a disconnected client leaves its
+    # handler running to completion, e.g. a 30 s profile capture pinning
+    # the process-global profiler after the caller gave up.
+    runner = web.AppRunner(app, handler_cancellation=True)
     await runner.setup()
     site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
     await site.start()
@@ -639,11 +652,16 @@ class AgentNode:
         ssl_context=None,
         tag_cache_ttl: float = 0.0,
         durability: str = "rename",
+        registry_strict_accept: bool = False,
     ):
         self.host = host
         self.http_port = http_port
         self.p2p_port = p2p_port
         self.registry_port = registry_port
+        # Manifest Accept negotiation: strict mode 406s clients pinned to
+        # types we don't hold; default serves the stored bytes like the
+        # reference (old docker clients regress under strict -- ADVICE r5).
+        self.registry_strict_accept = registry_strict_accept
         self.build_index_addr = build_index_addr
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root, durability=durability)
@@ -654,9 +672,15 @@ class AgentNode:
         # avoid.
         # hash_workers: the same host hash pool the origin uses, here
         # feeding BatchedVerifier.hash_batch -- a multi-core agent
-        # verifies a piece batch across cores instead of one.
+        # verifies a piece batch across cores instead of one. Only >= 2
+        # buys anything on an agent: hash_batch takes the inline path
+        # below 2 workers (core/hasher.py), and agents have no stream-
+        # submit tier to keep a 1-worker pool busy -- building one just
+        # parks an idle thread behind misleading pool gauges.
         self.verifier = BatchedVerifier(
-            hasher=get_hasher(hasher, workers=hash_workers),
+            hasher=get_hasher(
+                hasher, workers=hash_workers if hash_workers >= 2 else 0
+            ),
             max_delay_seconds=0.0 if hasher == "cpu" else 0.002,
         )
         self.cleanup = (
@@ -746,6 +770,7 @@ class AgentNode:
                     tag_cache_ttl=self.tag_cache_ttl,
                 ),
                 read_only=True,
+                strict_accept=self.registry_strict_accept,
             )
             self._registry_runner, self.registry_port = await _serve(
                 registry.make_app(), self.host, self.registry_port,

@@ -16,6 +16,7 @@ from kraken_tpu.backend.base import (
     register_backend,
 )
 from kraken_tpu.backend.namepath import get_pather
+from kraken_tpu.utils import failpoints
 
 
 @register_backend("file")
@@ -35,6 +36,13 @@ class FileBackend(BackendClient):
             raise BlobNotFoundError(name) from None
 
     async def download(self, namespace: str, name: str) -> bytes:
+        # Failpoint backend.file.download: a flaky durable store --
+        # blobrefresh/writeback retry planes must surface and retry it,
+        # never translate it into "not found".
+        if failpoints.fire("backend.file.download"):
+            import errno
+
+            raise OSError(errno.EIO, "failpoint backend.file.download", name)
         try:
             with open(self._path(name), "rb") as f:
                 return f.read()
@@ -42,6 +50,10 @@ class FileBackend(BackendClient):
             raise BlobNotFoundError(name) from None
 
     async def upload(self, namespace: str, name: str, data: bytes) -> None:
+        if failpoints.fire("backend.file.upload"):
+            import errno
+
+            raise OSError(errno.ENOSPC, "failpoint backend.file.upload", name)
         path = self._path(name)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"

@@ -19,6 +19,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import signal
 
 from kraken_tpu.assembly import (
@@ -260,6 +261,26 @@ def main(argv: list[str] | None = None) -> None:
     cfg = load_config(args.config) if args.config else {}
     setup_json_logging(args.component)
 
+    # Chaos plane (utils/failpoints.py). Env KRAKEN_FAILPOINTS is self-
+    # acknowledging (setting it IS the operator's opt-in); a YAML
+    # `failpoints:` mapping additionally requires KRAKEN_FAILPOINTS_ALLOW=1
+    # so a chaos config pasted into production fails the boot loudly --
+    # assembly re-checks before binding any listener.
+    from kraken_tpu.utils import failpoints as _failpoints
+
+    _failpoints.load_from_env()
+    fp_cfg = cfg.get("failpoints")
+    if fp_cfg:
+        if os.environ.get("KRAKEN_FAILPOINTS_ALLOW") != "1":
+            parser.error(
+                "config arms failpoints ({}) but KRAKEN_FAILPOINTS_ALLOW=1"
+                " is not set; refusing to boot an injecting node by"
+                " accident".format(sorted(fp_cfg))
+            )
+        for fp_name, fp_spec in fp_cfg.items():
+            _failpoints.FAILPOINTS.arm(str(fp_name), str(fp_spec))
+        _failpoints.allow()
+
     def pick(flag, key, default=None):
         return flag if flag is not None else cfg.get(key, default)
 
@@ -449,6 +470,9 @@ def main(argv: list[str] | None = None) -> None:
             ssl_context=ssl_context,
             tag_cache_ttl=float(cfg.get("tag_cache_ttl", 0.0)),
             durability=cfg.get("durability", "rename"),
+            registry_strict_accept=bool(
+                cfg.get("registry_strict_accept", False)
+            ),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

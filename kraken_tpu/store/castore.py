@@ -33,6 +33,7 @@ from typing import BinaryIO, Iterator, Optional, Type, TypeVar
 
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.store.metadata import Metadata
+from kraken_tpu.utils import failpoints
 
 M = TypeVar("M", bound=Metadata)
 
@@ -82,6 +83,11 @@ class CAStore:
 
     def _commit_file(self, src: str, dst: str) -> None:
         """Move ``src`` into place at ``dst`` under the durability mode."""
+        if failpoints.fire("castore.commit"):
+            # Full disk surfacing at the rename/fsync boundary.
+            import errno
+
+            raise OSError(errno.ENOSPC, "failpoint castore.commit", dst)
         if self.durability == "fsync":
             fd = os.open(src, os.O_RDONLY)
             try:
@@ -126,6 +132,10 @@ class CAStore:
         path = self._upload_path(uid)
         if not os.path.exists(path):
             raise UploadNotFoundError(uid)
+        if failpoints.fire("castore.write"):
+            import errno
+
+            raise OSError(errno.ENOSPC, "failpoint castore.write", path)
         with open(path, "r+b") as f:
             f.seek(offset)
             f.write(data)

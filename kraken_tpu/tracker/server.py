@@ -23,6 +23,7 @@ from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.core.peer import PeerInfo
 from kraken_tpu.tracker.peerhandout import default_priority
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore, PeerStore
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.dedup import TTLCache
 
 
@@ -51,6 +52,13 @@ class TrackerServer:
         return app
 
     async def _announce(self, req: web.Request) -> web.Response:
+        # Failpoint tracker.announce.error: a flapping tracker -- clients
+        # must meter the failure (announce_failures_total) and recover on
+        # a later interval, not wedge or crash.
+        if failpoints.fire("tracker.announce.error"):
+            raise web.HTTPServiceUnavailable(
+                text="failpoint tracker.announce.error"
+            )
         try:
             doc = await req.json()
             info_hash = doc["info_hash"]
@@ -78,6 +86,11 @@ class TrackerServer:
         others = [
             p for p in candidates if p.peer_id != peer.peer_id
         ][: self.handout_limit]
+        # Failpoint tracker.announce.empty: a 200 with an empty handout
+        # (fresh tracker after restart, peer-store flush) -- leechers
+        # must simply re-announce rather than treat it as terminal.
+        if failpoints.fire("tracker.announce.empty"):
+            others = []
         return web.json_response(
             {
                 "peers": [p.to_dict() for p in self.policy(others)],

@@ -1,0 +1,273 @@
+"""Deterministic failpoint plane: named fault-injection sites.
+
+The repo's failure *reactions* (piece verify -> peer ban, retrying HTTP,
+ring repair, upload-tracker invalidation) each exist, but exercising them
+end-to-end used to mean hand-monkeypatching one code path per test. A
+failpoint is a NAMED site compiled into the real code path -- e.g.
+``httputil.request.error`` or ``castore.commit`` -- that does nothing
+until armed, and when armed injects the site's fault (the site defines
+WHAT fails; the registry decides WHEN).
+
+Mirrors the failpoint idiom of etcd/gofail and TiKV's fail-rs (upstream
+designs, unverified): process-global registry, triggers with seeded RNG
+so chaos runs replay deterministically, zero work on the hot path while
+disarmed.
+
+Trigger grammar (env var, YAML, admin endpoint, and tests all share it)::
+
+    once                fire exactly one time, then exhaust
+    always              fire on every evaluation
+    every:N             fire on every Nth evaluation (N, 2N, ...)
+    prob:P              fire with probability P per evaluation (seeded RNG)
+
+with ``+``-joined modifiers::
+
+    times:N             stop firing after N total fires
+    delay:MS            sleep MS milliseconds when firing (async sites)
+    seed:N              RNG seed for prob (default 0: deterministic)
+
+Examples: ``once``, ``prob:0.2+seed:7``, ``every:3+times:2+delay:50``.
+
+Configuration surfaces:
+
+- env ``KRAKEN_FAILPOINTS="name=spec,name=spec"`` (setting the var is the
+  explicit operator opt-in);
+- YAML ``failpoints: {name: spec}`` (cli.py refuses it unless
+  ``KRAKEN_FAILPOINTS_ALLOW=1`` is also set -- a stray armed failpoint in
+  a prod config must fail loudly, not silently inject faults);
+- runtime: ``GET/POST /debug/failpoints`` on every component's metrics
+  mux (utils/metrics.py), the live-node runbook surface
+  (docs/OPERATIONS.md).
+
+Safety: :func:`allow` is the deliberate chaos acknowledgement. Arming
+does NOT imply it -- assembly refuses to serve (``assert_safe``) when
+anything is armed without it, so no import-time or config accident can
+put an injecting node into rotation.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Optional
+
+
+class FailpointError(Exception):
+    """Generic injected fault (sites that have no better-typed error)."""
+
+
+class FailpointConfigError(Exception):
+    """Armed failpoints without the explicit chaos acknowledgement."""
+
+
+class Hit:
+    """One firing decision. ``delay_s`` is the armed spec's delay (0.0
+    when none); async sites honor it, sync sites may time.sleep it."""
+
+    __slots__ = ("name", "delay_s")
+
+    def __init__(self, name: str, delay_s: float):
+        self.name = name
+        self.delay_s = delay_s
+
+    def __bool__(self) -> bool:  # `if hit:` reads naturally at sites
+        return True
+
+
+class _Armed:
+    """Armed state for one site: parsed spec + seeded RNG + counters."""
+
+    __slots__ = (
+        "spec", "mode", "arg", "times", "delay_s", "seed", "rng",
+        "hits", "fired",
+    )
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.mode = "always"
+        self.arg = 0.0
+        self.times = 0  # 0 = unlimited
+        self.delay_s = 0.0
+        self.seed = 0
+        for i, part in enumerate(spec.split("+")):
+            part = part.strip()
+            key, _, val = part.partition(":")
+            try:
+                if i == 0:
+                    if key == "once":
+                        self.mode, self.times = "once", 1
+                    elif key == "always":
+                        self.mode = "always"
+                    elif key == "every":
+                        self.mode, self.arg = "every", float(int(val))
+                        if self.arg < 1:
+                            raise ValueError(part)
+                    elif key == "prob":
+                        self.mode, self.arg = "prob", float(val)
+                        if not 0.0 <= self.arg <= 1.0:
+                            raise ValueError(part)
+                    else:
+                        raise ValueError(part)
+                elif key == "times":
+                    self.times = int(val)
+                elif key == "delay":
+                    self.delay_s = float(val) / 1000.0
+                elif key == "seed":
+                    self.seed = int(val)
+                else:
+                    raise ValueError(part)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"malformed failpoint spec {spec!r} (at {part!r}); "
+                    "grammar: once|always|every:N|prob:P"
+                    "[+times:N][+delay:MS][+seed:N]"
+                ) from None
+        # Seeded by default: a chaos run replays bit-for-bit.
+        self.rng = random.Random(self.seed)
+        self.hits = 0  # evaluations while armed
+        self.fired = 0  # actual injections
+
+    def evaluate(self) -> bool:
+        self.hits += 1
+        if self.times and self.fired >= self.times:
+            return False
+        if self.mode == "once":
+            fire = True
+        elif self.mode == "always":
+            fire = True
+        elif self.mode == "every":
+            fire = self.hits % int(self.arg) == 0
+        else:  # prob
+            fire = self.rng.random() < self.arg
+        if fire:
+            self.fired += 1
+        return fire
+
+
+class FailpointRegistry:
+    """Process-global registry. One instance (:data:`FAILPOINTS`) below;
+    a fresh instance is only useful for testing the registry itself."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Armed] = {}
+        # Fast-path flag read WITHOUT the lock by fire(): the hot path
+        # (conn pumps, castore writes) must pay one attribute read while
+        # disarmed. Python guarantees no torn reads of a bool attribute.
+        self._any = False
+        self.allowed = False
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, name: str, spec: str = "once") -> None:
+        # Names come from YAML and unauthenticated JSON too: a non-str
+        # key would poison snapshot()'s sorted() (int < str TypeError)
+        # and kill the admin surface mid-chaos-run.
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"failpoint name must be a non-empty str: {name!r}")
+        armed = _Armed(spec)  # parse (and reject) outside the lock
+        with self._lock:
+            self._armed[name] = armed
+            self._any = True
+
+    def disarm(self, name: str) -> bool:
+        with self._lock:
+            existed = self._armed.pop(name, None) is not None
+            self._any = bool(self._armed)
+            return existed
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            self._armed.clear()
+            self._any = False
+
+    # -- evaluation (the injection-site API) -------------------------------
+
+    def fire(self, name: str) -> Optional[Hit]:
+        """Should site ``name`` inject now? None while disarmed (the
+        overwhelming case: one bool read)."""
+        if not self._any:
+            return None
+        with self._lock:
+            armed = self._armed.get(name)
+            if armed is None or not armed.evaluate():
+                return None
+            delay_s = armed.delay_s
+        # Metrics off-lock: REGISTRY has its own locking.
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "failpoints_fired_total",
+            "Fault injections per failpoint site (chaos runs only)",
+        ).inc(name=name)
+        return Hit(name, delay_s)
+
+    # -- introspection / safety --------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Admin-endpoint view: every armed site with its spec and
+        hit/fire counts."""
+        with self._lock:
+            return {
+                "allowed": self.allowed,
+                "failpoints": {
+                    name: {
+                        "spec": a.spec,
+                        "hits": a.hits,
+                        "fired": a.fired,
+                        "exhausted": bool(a.times) and a.fired >= a.times,
+                    }
+                    for name, a in sorted(self._armed.items())
+                },
+            }
+
+    def assert_safe(self, component: str = "") -> None:
+        """Refuse to serve with armed failpoints absent the explicit
+        chaos acknowledgement (:func:`allow`). Called by assembly before
+        any listener binds: a stray ``failpoints:`` section in a prod
+        config -- or a leftover arm() from an earlier test in the same
+        process -- fails the boot loudly instead of injecting silently."""
+        with self._lock:
+            if self._armed and not self.allowed:
+                names = sorted(self._armed)
+                raise FailpointConfigError(
+                    f"{component or 'node'}: failpoints armed without the "
+                    f"chaos acknowledgement: {names}. Call "
+                    "kraken_tpu.utils.failpoints.allow() (tests), set "
+                    "KRAKEN_FAILPOINTS[_ALLOW] (cli), or disarm them."
+                )
+
+
+FAILPOINTS = FailpointRegistry()
+
+
+def fire(name: str) -> Optional[Hit]:
+    """Module-level evaluation shorthand for injection sites."""
+    return FAILPOINTS.fire(name)
+
+
+def allow(flag: bool = True) -> None:
+    """The deliberate chaos acknowledgement (see :meth:`assert_safe`)."""
+    FAILPOINTS.allowed = flag
+
+
+def load_from_env(environ=None) -> int:
+    """Arm failpoints from ``KRAKEN_FAILPOINTS`` (``name=spec,...``).
+    Setting the variable IS the operator's acknowledgement, so this also
+    calls :func:`allow`. Returns the number armed. Raises ValueError on a
+    malformed entry -- a typo'd chaos run must not silently run clean."""
+    raw = (environ or os.environ).get("KRAKEN_FAILPOINTS", "")
+    count = 0
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, spec = entry.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(f"malformed KRAKEN_FAILPOINTS entry {entry!r}")
+        FAILPOINTS.arm(name.strip(), spec.strip() or "once")
+        count += 1
+    if count:
+        allow()
+    return count

@@ -9,12 +9,72 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 import os
 from typing import Any
 
 import aiohttp
 
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.backoff import Backoff
+from kraken_tpu.utils.metrics import REGISTRY
+
+_log = logging.getLogger("kraken.httputil")
+
+
+def _count_retry(method: str) -> None:
+    """Retries were invisible: a flapping dependency that every call
+    papers over with 3 retries looks healthy until the 4th failure.
+    Metered per method so read and write planes stay distinguishable."""
+    REGISTRY.counter(
+        "http_client_retries_total",
+        "Outbound HTTP attempts retried (connection error / 5xx)",
+    ).inc(method=method)
+
+
+def _give_up(method: str, url: str, attempts: int, err: Exception) -> None:
+    """Final give-up: count it and log ONE structured line (the retries
+    themselves stay quiet -- the counter carries their volume)."""
+    REGISTRY.counter(
+        "http_client_giveups_total",
+        "Outbound HTTP requests that exhausted every retry",
+    ).inc(method=method)
+    _log.warning(
+        "http request gave up after %d attempts: %s %s: %r",
+        attempts, method, url, err,
+        extra={"method": method, "url": url, "attempts": attempts},
+    )
+
+
+async def _failpoint_gate(method: str, url: str) -> "HTTPError | None":
+    """Failure-injection sites shared by every outbound request path:
+
+    - ``httputil.request.slow``: sleep the armed delay, then proceed;
+    - ``httputil.request.conn_reset``: raise a connection error (caught
+      by the caller's retry loop exactly like a real RST);
+    - ``httputil.request.error``: RETURN an injected 503 ``HTTPError``
+      (returned, not raised: the caller feeds it through its own
+      retry-vs-raise policy exactly like a real 5xx).
+    """
+    hit = failpoints.fire("httputil.request.slow")
+    if hit:
+        await asyncio.sleep(hit.delay_s)
+    if failpoints.fire("httputil.request.conn_reset"):
+        raise aiohttp.ClientConnectionError(
+            f"failpoint httputil.request.conn_reset: {method} {url}"
+        )
+    if failpoints.fire("httputil.request.error"):
+        return HTTPError(method, url, 503, b"failpoint httputil.request.error")
+    return None
+
+
+def _maybe_truncate(body: bytes) -> bytes:
+    """``httputil.request.truncate_body``: a torn response (LB died
+    mid-body) -- callers must fail digest checks / length checks, never
+    accept the prefix silently."""
+    if body and failpoints.fire("httputil.request.truncate_body"):
+        return body[: len(body) // 2]
+    return body
 
 
 class HTTPError(Exception):
@@ -123,23 +183,31 @@ class HTTPClient:
         last_err: Exception | None = None
         for attempt in range(self._retries + 1):
             try:
-                session = await self._get_session()
-                async with session.request(
-                    method, url, data=data, headers=headers
-                ) as resp:
-                    body = await resp.read()
-                    if resp.status in ok_statuses:
-                        return body
-                    err = HTTPError(method, url, resp.status, body)
-                    # 4xx are semantic: no point retrying.
-                    if resp.status < 500 or not retry_5xx:
-                        raise err
-                    last_err = err
+                injected = await _failpoint_gate(method, url)
+                if injected is not None:
+                    if not retry_5xx:
+                        raise injected
+                    last_err = injected
+                else:
+                    session = await self._get_session()
+                    async with session.request(
+                        method, url, data=data, headers=headers
+                    ) as resp:
+                        body = await resp.read()
+                        if resp.status in ok_statuses:
+                            return _maybe_truncate(body)
+                        err = HTTPError(method, url, resp.status, body)
+                        # 4xx are semantic: no point retrying.
+                        if resp.status < 500 or not retry_5xx:
+                            raise err
+                        last_err = err
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
                 last_err = e
             if attempt < self._retries:
+                _count_retry(method)
                 await asyncio.sleep(self._backoff.delay(attempt))
         assert last_err is not None
+        _give_up(method, url, self._retries + 1, last_err)
         raise last_err
 
     async def request_full(
@@ -159,23 +227,34 @@ class HTTPClient:
         last_err: Exception | None = None
         for attempt in range(self._retries + 1):
             try:
-                session = await self._get_session()
-                async with session.request(
-                    method, url, data=data, headers=headers,
-                    allow_redirects=allow_redirects,
-                ) as resp:
-                    body = await resp.read()
-                    if resp.status in ok_statuses:
-                        return resp.status, dict(resp.headers), body
-                    err = HTTPError(method, url, resp.status, body)
-                    if resp.status < 500 or not retry_5xx:
-                        raise err
-                    last_err = err
+                injected = await _failpoint_gate(method, url)
+                if injected is not None:
+                    if not retry_5xx:
+                        raise injected
+                    last_err = injected
+                else:
+                    session = await self._get_session()
+                    async with session.request(
+                        method, url, data=data, headers=headers,
+                        allow_redirects=allow_redirects,
+                    ) as resp:
+                        body = await resp.read()
+                        if resp.status in ok_statuses:
+                            return (
+                                resp.status, dict(resp.headers),
+                                _maybe_truncate(body),
+                            )
+                        err = HTTPError(method, url, resp.status, body)
+                        if resp.status < 500 or not retry_5xx:
+                            raise err
+                        last_err = err
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
                 last_err = e
             if attempt < self._retries:
+                _count_retry(method)
                 await asyncio.sleep(self._backoff.delay(attempt))
         assert last_err is not None
+        _give_up(method, url, self._retries + 1, last_err)
         raise last_err
 
     async def get_to_file(
@@ -194,24 +273,39 @@ class HTTPClient:
         tmp = f"{dest_path}.http{os.getpid()}.tmp"
         for attempt in range(self._retries + 1):
             try:
-                session = await self._get_session()
-                async with session.get(url, headers=headers) as resp:
-                    if resp.status != 200:
-                        body = await resp.read()
-                        err = HTTPError("GET", url, resp.status, body)
-                        if resp.status < 500 or not retry_5xx:
-                            raise err
-                        last_err = err
-                    else:
-                        size = 0
-                        with open(tmp, "wb") as f:
-                            async for chunk in resp.content.iter_chunked(
-                                chunk_size
-                            ):
-                                await asyncio.to_thread(f.write, chunk)
-                                size += len(chunk)
-                        os.replace(tmp, dest_path)
-                        return size
+                injected = await _failpoint_gate("GET", url)
+                if injected is not None:
+                    if not retry_5xx:
+                        raise injected
+                    last_err = injected
+                else:
+                    session = await self._get_session()
+                    async with session.get(url, headers=headers) as resp:
+                        if resp.status != 200:
+                            body = await resp.read()
+                            err = HTTPError("GET", url, resp.status, body)
+                            if resp.status < 500 or not retry_5xx:
+                                raise err
+                            last_err = err
+                        else:
+                            size = 0
+                            with open(tmp, "wb") as f:
+                                async for chunk in resp.content.iter_chunked(
+                                    chunk_size
+                                ):
+                                    if failpoints.fire(
+                                        "httputil.request.truncate_body"
+                                    ):
+                                        # Torn streaming body: surface as
+                                        # the payload error a dropped LB
+                                        # produces (whole-transfer retry).
+                                        raise aiohttp.ClientPayloadError(
+                                            "failpoint truncate_body"
+                                        )
+                                    await asyncio.to_thread(f.write, chunk)
+                                    size += len(chunk)
+                            os.replace(tmp, dest_path)
+                            return size
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError,
                     aiohttp.ClientPayloadError) as e:
                 last_err = e
@@ -219,8 +313,10 @@ class HTTPClient:
                 with contextlib.suppress(OSError):
                     os.unlink(tmp)
             if attempt < self._retries:
+                _count_retry("GET")
                 await asyncio.sleep(self._backoff.delay(attempt))
         assert last_err is not None
+        _give_up("GET", url, self._retries + 1, last_err)
         raise last_err
 
     async def get(self, url: str, **kw) -> bytes:

@@ -343,6 +343,7 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
             out_dir = os.path.join(root, "kraken-jaxprof")
         if not _profile_lock.acquire(blocking=False):
             return web.Response(status=409, text="capture already running")
+        lock_deferred = False
         try:
             # start/stop serialize the XPlane tree -- off the loop, and
             # stop_trace MUST run even if the client disconnects mid-
@@ -353,15 +354,81 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
             try:
                 await asyncio.sleep(seconds)
             finally:
-                await asyncio.shield(
+                stop = asyncio.ensure_future(
                     asyncio.to_thread(jax.profiler.stop_trace)
                 )
+                try:
+                    await asyncio.shield(stop)
+                except asyncio.CancelledError:
+                    # Client disconnected mid-capture. The shield keeps
+                    # stop_trace running, but THIS await returns now --
+                    # releasing the lock here would let a second capture
+                    # start_trace while the process-global profiler is
+                    # still serializing (ADVICE r5). Hand the release to
+                    # stop's completion instead. threading.Lock may be
+                    # released from any thread/callback.
+                    lock_deferred = True
+                    stop.add_done_callback(
+                        lambda _f: _profile_lock.release()
+                    )
+                    raise
         finally:
-            _profile_lock.release()
+            if not lock_deferred:
+                _profile_lock.release()
         return web.json_response({"trace_dir": out_dir, "seconds": seconds})
+
+    async def failpoints_get(request):
+        # Chaos runbook surface (docs/OPERATIONS.md): list armed sites
+        # with hit/fire counts; firings also count on /metrics as
+        # failpoints_fired_total{name}.
+        from kraken_tpu.utils.failpoints import FAILPOINTS
+
+        return web.json_response(FAILPOINTS.snapshot())
+
+    async def failpoints_post(request):
+        # {"action": "arm", "name": ..., "spec": "once"} | {"action":
+        # "disarm", "name": ...} | {"action": "disarm_all"}. Arming over
+        # HTTP requires the SAME acknowledgement as every other surface:
+        # the process must already be allowed (env-armed boot, YAML +
+        # KRAKEN_FAILPOINTS_ALLOW, a chaos harness) or carry
+        # KRAKEN_FAILPOINTS_ALLOW=1 -- this mux is unauthenticated, and
+        # without the gate one curl could arm castore.commit=always on a
+        # production origin. Disarming is always allowed (it only ever
+        # makes a node healthier).
+        import os
+
+        from kraken_tpu.utils.failpoints import FAILPOINTS, allow
+
+        try:
+            doc = await request.json()
+            action = doc["action"]
+            if action == "arm":
+                if not (
+                    FAILPOINTS.allowed
+                    or os.environ.get("KRAKEN_FAILPOINTS_ALLOW") == "1"
+                ):
+                    return web.Response(
+                        status=403,
+                        text="arming requires the chaos acknowledgement:"
+                             " run this node with KRAKEN_FAILPOINTS_ALLOW=1"
+                             " (or boot it with KRAKEN_FAILPOINTS armed)",
+                    )
+                FAILPOINTS.arm(doc["name"], str(doc.get("spec", "once")))
+                allow()  # after a successful, authorized arm only
+            elif action == "disarm":
+                FAILPOINTS.disarm(doc["name"])
+            elif action == "disarm_all":
+                FAILPOINTS.disarm_all()
+            else:
+                raise ValueError(f"unknown action {action!r}")
+        except (ValueError, KeyError, TypeError) as e:
+            return web.Response(status=400, text=f"malformed request: {e}")
+        return web.json_response(FAILPOINTS.snapshot())
 
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)
     app.router.add_get("/debug/jax-profile", jax_profile_endpoint)
+    app.router.add_get("/debug/failpoints", failpoints_get)
+    app.router.add_post("/debug/failpoints", failpoints_post)
     return app

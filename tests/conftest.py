@@ -23,3 +23,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # Registered here (no pytest.ini exists): tier-1 is `-m 'not slow'`,
+    # so the fast chaos subset runs in tier-1 and the soak subset does
+    # not (docs/TESTING.md).
+    config.addinivalue_line(
+        "markers", "slow: soak-length tests excluded from tier-1"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: failpoint-driven failure injection (tests/test_chaos.py)",
+    )

@@ -1,0 +1,560 @@
+"""Chaos tier: deterministic failure injection through REAL assembled
+nodes via the failpoint plane (kraken_tpu/utils/failpoints.py).
+
+Every failure test before this PR hand-monkeypatched one code path; the
+reaction paths the system actually sells -- corrupt piece -> peer ban ->
+re-pull, ENOSPC mid-PATCH -> clean error + spool reclaim, tracker flap ->
+metered announce retry, mid-transfer disconnect -> re-request -- had
+never run end-to-end. Here each scenario arms a named failpoint with a
+deterministic trigger (seeded RNG, one-shot, every-Nth), drives real
+origin/tracker/agent nodes over real TCP, and asserts recovery with
+BIT-IDENTITY on every completed pull.
+
+Fast scenarios are unmarked (tier-1 runs them); the probabilistic soak is
+``slow``. Everything here carries the ``chaos`` marker.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.origin.metainfogen import PieceLengthConfig
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.backoff import Backoff
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+from kraken_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.chaos
+
+NS = "chaos"
+# 64 KiB pieces so a ~300 KB blob exercises multi-piece transfer paths.
+SMALL_PIECES = PieceLengthConfig(table=((0, 64 * 1024),))
+
+
+@pytest.fixture(autouse=True)
+def chaos_plane():
+    """Every test starts disarmed and ACKNOWLEDGED (nodes may assemble
+    with failpoints armed), and leaves the process-global plane clean --
+    a leaked armed failpoint would inject into unrelated tests."""
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow()
+    yield failpoints.FAILPOINTS
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow(False)
+
+
+async def _wait_for(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        await asyncio.sleep(interval)
+
+
+def _fired(name: str) -> float:
+    return REGISTRY.counter("failpoints_fired_total").value(name=name)
+
+
+async def _herd(tmp_path, n_agents=1, scheduler_config=None):
+    tracker = TrackerNode(announce_interval_seconds=0.1, peer_ttl_seconds=5.0)
+    await tracker.start()
+    origin = OriginNode(
+        store_root=str(tmp_path / "origin"),
+        tracker_addr=tracker.addr,
+        piece_lengths=SMALL_PIECES,
+        dedup=False,
+    )
+    await origin.start()
+    cluster = ClusterClient(
+        Ring(HostList(static=[origin.addr]), max_replica=1)
+    )
+    tracker.server.origin_cluster = cluster
+    agents = []
+    for i in range(n_agents):
+        a = AgentNode(
+            store_root=str(tmp_path / f"agent{i}"),
+            tracker_addr=tracker.addr,
+            scheduler_config=scheduler_config,
+        )
+        await a.start()
+        agents.append(a)
+    return tracker, origin, agents, cluster
+
+
+async def _teardown(tracker, origin, agents, cluster):
+    for a in agents:
+        await a.stop()
+    await origin.stop()
+    await cluster.close()
+    await tracker.stop()
+
+
+async def _pull(agent, d: Digest, timeout: float = 60.0) -> bytes:
+    http = HTTPClient(timeout_seconds=timeout, retries=0)
+    try:
+        return await http.get(
+            f"http://{agent.addr}/namespace/{NS}/blobs/{d.hex}"
+        )
+    finally:
+        await http.close()
+
+
+# -- the failpoint registry itself ------------------------------------------
+
+
+def test_trigger_grammar_and_deterministic_replay():
+    r = failpoints.FailpointRegistry()
+    assert r.fire("nothing.armed") is None  # disarmed: no-op
+
+    r.arm("a", "once")
+    assert r.fire("a") and r.fire("a") is None
+
+    r.arm("b", "every:3")
+    assert [bool(r.fire("b")) for _ in range(6)] == [
+        False, False, True, False, False, True,
+    ]
+
+    # Seeded probability replays bit-for-bit across arms.
+    r.arm("c", "prob:0.5+seed:7")
+    seq1 = [bool(r.fire("c")) for _ in range(32)]
+    r.arm("c", "prob:0.5+seed:7")
+    seq2 = [bool(r.fire("c")) for _ in range(32)]
+    assert seq1 == seq2 and any(seq1) and not all(seq1)
+
+    r.arm("d", "always+times:2")
+    assert sum(bool(r.fire("d")) for _ in range(5)) == 2
+
+    r.arm("e", "always+delay:250")
+    assert abs(r.fire("e").delay_s - 0.25) < 1e-9
+
+    for bad in ("sometimes", "prob:1.5", "every:0", "once+nope:1", "every"):
+        with pytest.raises(ValueError):
+            r.arm("f", bad)
+
+    r.arm("g", "always")
+    r.disarm("g")
+    assert r.fire("g") is None
+
+
+def test_env_arming_is_self_acknowledging():
+    n = failpoints.load_from_env(
+        {"KRAKEN_FAILPOINTS": "a.b=once, c.d = prob:0.25+seed:3"}
+    )
+    assert n == 2
+    assert failpoints.FAILPOINTS.allowed
+    snap = failpoints.FAILPOINTS.snapshot()["failpoints"]
+    assert snap["a.b"]["spec"] == "once"
+    assert snap["c.d"]["spec"] == "prob:0.25+seed:3"
+    with pytest.raises(ValueError):
+        failpoints.load_from_env({"KRAKEN_FAILPOINTS": "justaname"})
+    with pytest.raises(ValueError):
+        failpoints.load_from_env({"KRAKEN_FAILPOINTS": "a.b=bogus:spec"})
+
+
+def test_disarmed_by_default_and_boot_guard():
+    """Import-time default is a clean, unacknowledged plane, and
+    assembly refuses to bind listeners while failpoints are armed
+    without the acknowledgement -- a chaos config pasted into prod (or a
+    leaked test arm) fails the boot loudly."""
+    fresh = failpoints.FailpointRegistry()
+    assert fresh.snapshot() == {"allowed": False, "failpoints": {}}
+
+    async def main():
+        failpoints.allow(False)
+        failpoints.FAILPOINTS.arm("castore.write", "once")
+        t = TrackerNode()
+        with pytest.raises(failpoints.FailpointConfigError):
+            await t.start()
+        await t.stop()
+        failpoints.allow()  # the deliberate chaos ack: boots fine
+        t2 = TrackerNode()
+        await t2.start()
+        await t2.stop()
+
+    asyncio.run(main())
+
+
+def test_failpoints_admin_endpoint():
+    """The live-node runbook surface: list/arm/disarm with fire counts
+    over the metrics mux (docs/OPERATIONS.md)."""
+
+    async def main():
+        from aiohttp import web
+
+        from kraken_tpu.utils.metrics import instrument_app
+
+        app = web.Application()
+        instrument_app(app, "chaos-admin-test")
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        base = f"http://127.0.0.1:{runner.addresses[0][1]}"
+        http = HTTPClient(retries=0)
+        try:
+            doc = json.loads(await http.get(f"{base}/debug/failpoints"))
+            assert doc["failpoints"] == {}
+            await http.post(
+                f"{base}/debug/failpoints",
+                data=json.dumps(
+                    {"action": "arm", "name": "chaos.admin.site",
+                     "spec": "every:2"}
+                ),
+            )
+            assert failpoints.fire("chaos.admin.site") is None
+            assert failpoints.fire("chaos.admin.site")
+            doc = json.loads(await http.get(f"{base}/debug/failpoints"))
+            entry = doc["failpoints"]["chaos.admin.site"]
+            assert entry["hits"] == 2 and entry["fired"] == 1
+            # Firing also shows on /metrics.
+            text = await http.get(f"{base}/metrics")
+            assert b'failpoints_fired_total{name="chaos.admin.site"}' in text
+            with pytest.raises(HTTPError) as ei:
+                await http.post(
+                    f"{base}/debug/failpoints",
+                    data=json.dumps({"action": "bogus"}),
+                )
+            assert ei.value.status == 400
+            # Non-string name: rejected (400), never stored -- an int key
+            # would TypeError snapshot()'s sorted() and kill this surface.
+            with pytest.raises(HTTPError) as ei:
+                await http.post(
+                    f"{base}/debug/failpoints",
+                    data=json.dumps(
+                        {"action": "arm", "name": 123, "spec": "once"}
+                    ),
+                )
+            assert ei.value.status == 400
+            assert json.loads(await http.get(f"{base}/debug/failpoints"))
+            await http.post(
+                f"{base}/debug/failpoints",
+                data=json.dumps({"action": "disarm_all"}),
+            )
+            assert failpoints.fire("chaos.admin.site") is None
+
+            # The mux is unauthenticated, so ARMING demands the chaos
+            # acknowledgement: without it (and without
+            # KRAKEN_FAILPOINTS_ALLOW=1 in the env) the POST is a 403
+            # and nothing is armed or allowed. Disarming stays open.
+            failpoints.allow(False)
+            assert os.environ.get("KRAKEN_FAILPOINTS_ALLOW") != "1"
+            with pytest.raises(HTTPError) as ei:
+                await http.post(
+                    f"{base}/debug/failpoints",
+                    data=json.dumps(
+                        {"action": "arm", "name": "castore.commit",
+                         "spec": "always"}
+                    ),
+                )
+            assert ei.value.status == 403
+            assert not failpoints.FAILPOINTS.allowed
+            assert failpoints.fire("castore.commit") is None
+            await http.post(  # disarm_all needs no ack
+                f"{base}/debug/failpoints",
+                data=json.dumps({"action": "disarm_all"}),
+            )
+        finally:
+            await http.close()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# -- httputil failpoints + retry visibility ----------------------------------
+
+
+def _retries(method: str) -> float:
+    return REGISTRY.counter("http_client_retries_total").value(method=method)
+
+
+def _giveups(method: str) -> float:
+    return REGISTRY.counter("http_client_giveups_total").value(method=method)
+
+
+def test_http_injected_5xx_exhausts_retries_and_is_counted():
+    """`httputil.request.error` armed always: every attempt sees a 503,
+    the client retries its budget (counted), then gives up (counted +
+    one structured WARN). No real server is ever contacted."""
+
+    async def main():
+        r0, g0 = _retries("GET"), _giveups("GET")
+        failpoints.FAILPOINTS.arm("httputil.request.error", "always")
+        http = HTTPClient(
+            retries=2, backoff=Backoff(base_seconds=0.001, jitter=0)
+        )
+        try:
+            with pytest.raises(HTTPError) as ei:
+                await http.get("http://127.0.0.1:9/failpoint-test")
+            assert ei.value.status == 503
+        finally:
+            await http.close()
+        assert _retries("GET") == r0 + 2
+        assert _giveups("GET") == g0 + 1
+
+    asyncio.run(main())
+
+
+def test_http_conn_reset_once_recovers_on_retry():
+    async def main():
+        from aiohttp import web
+
+        async def ok(request):
+            return web.Response(body=b"x" * 64)
+
+        app = web.Application()
+        app.router.add_get("/blob", ok)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        base = f"http://127.0.0.1:{runner.addresses[0][1]}"
+        http = HTTPClient(
+            retries=2, backoff=Backoff(base_seconds=0.001, jitter=0)
+        )
+        try:
+            r0 = _retries("GET")
+            failpoints.FAILPOINTS.arm("httputil.request.conn_reset", "once")
+            assert await http.get(f"{base}/blob") == b"x" * 64
+            assert _retries("GET") == r0 + 1
+            # Truncated body: the caller sees the torn prefix (callers
+            # must digest/length-check; castore commit would reject it).
+            failpoints.FAILPOINTS.arm("httputil.request.truncate_body", "once")
+            assert await http.get(f"{base}/blob") == b"x" * 32
+        finally:
+            await http.close()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# -- scenario 1: corrupt piece -> peer ban -> pull completes -----------------
+
+
+def test_corrupt_piece_bans_peer_and_pull_completes(tmp_path):
+    """One injected payload corruption: verify fails (PieceError), the
+    dispatcher hard-blacklists the corrupting peer, and the pull still
+    finishes bit-identical from the remaining healthy peers."""
+
+    async def main():
+        tracker, origin, agents, cluster = await _herd(tmp_path, n_agents=2)
+        try:
+            blob = os.urandom(5 * 64 * 1024 + 1000)  # 6 pieces
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origin.addr)
+            await oc.upload(NS, d, blob)
+            await oc.close()
+
+            # agent0 pulls clean and stays as a second healthy seeder.
+            assert await _pull(agents[0], d) == blob
+
+            fired0 = _fired("p2p.conn.recv.corrupt")
+            failpoints.FAILPOINTS.arm("p2p.conn.recv.corrupt", "once")
+            got = await _pull(agents[1], d)
+            assert got == blob  # bit-identical despite the corruption
+            assert _fired("p2p.conn.recv.corrupt") == fired0 + 1
+            # The corrupting peer was hard-blacklisted on the leecher.
+            assert agents[1].scheduler.conn_state.blacklist._entries
+        finally:
+            await _teardown(tracker, origin, agents, cluster)
+
+    asyncio.run(main())
+
+
+# -- scenario 2: ENOSPC mid-PATCH -> clean error, spool reclaimed, retry OK --
+
+
+def test_enospc_mid_patch_clean_error_spool_reclaimed_retry_succeeds(tmp_path):
+    async def main():
+        from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"),
+            piece_lengths=SMALL_PIECES,
+            dedup=False,
+        )
+        await origin.start()
+        oc = BlobClient(origin.addr, HTTPClient(retries=0))
+        try:
+            blob = os.urandom(3 * 64 * 1024 + 500)
+            d = Digest.from_bytes(blob)
+
+            failpoints.FAILPOINTS.arm("origin.patch.write", "once")
+            with pytest.raises(HTTPError) as ei:
+                await oc.upload(NS, d, blob)
+            assert ei.value.status == 500  # clean error, not a hang/corrupt
+            assert not origin.store.in_cache(d)
+
+            # The failed upload left its spool file; the wall-clock sweep
+            # reclaims it.
+            assert os.listdir(origin.store.upload_dir)
+            sweeper = CleanupManager(
+                origin.store, CleanupConfig(upload_ttl_seconds=0.05)
+            )
+            await asyncio.sleep(0.11)
+            sweeper.run_once()
+            assert os.listdir(origin.store.upload_dir) == []
+
+            # Retried upload succeeds and round-trips bit-identical.
+            await oc.upload(NS, d, blob)
+            assert await oc.download(NS, d) == blob
+
+            # Deferred write error at close (buffered ENOSPC): same
+            # contract.
+            blob2 = os.urandom(2 * 64 * 1024)
+            d2 = Digest.from_bytes(blob2)
+            failpoints.FAILPOINTS.arm("origin.patch.close", "once")
+            with pytest.raises(HTTPError) as ei2:
+                await oc.upload(NS, d2, blob2)
+            assert ei2.value.status == 500
+            await oc.upload(NS, d2, blob2)
+            assert await oc.download(NS, d2) == blob2
+        finally:
+            await oc.close()
+            await origin.stop()
+
+    asyncio.run(main())
+
+
+# -- scenario 3: tracker flap -> metered announce retry recovers -------------
+
+
+def test_tracker_flap_metered_announce_retry_recovers(tmp_path):
+    async def main():
+        tracker, origin, agents, cluster = await _herd(tmp_path, n_agents=1)
+        try:
+            blob = os.urandom(3 * 64 * 1024)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origin.addr)
+            await oc.upload(NS, d, blob)
+            await oc.close()
+
+            meter = REGISTRY.counter("announce_failures_total")
+            base = meter.value()
+            failpoints.FAILPOINTS.arm("tracker.announce.error", "always")
+            pull = asyncio.create_task(_pull(agents[0], d))
+            # The flap is VISIBLE: announce failures get metered, not
+            # swallowed (FailureMeter on the scheduler's announce loop).
+            await _wait_for(
+                lambda: meter.value() > base,
+                timeout=20.0,
+                msg="announce failure to be metered",
+            )
+            assert not pull.done()
+            # Tracker recovers: the paced re-announce finds peers and the
+            # pull completes bit-identical.
+            failpoints.FAILPOINTS.disarm("tracker.announce.error")
+            assert await asyncio.wait_for(pull, 40.0) == blob
+
+            # An empty handout (fresh-restarted tracker) is also benign:
+            # the leecher just re-announces.
+            failpoints.FAILPOINTS.arm("tracker.announce.empty", "always+times:3")
+            blob2 = os.urandom(2 * 64 * 1024)
+            d2 = Digest.from_bytes(blob2)
+            oc2 = BlobClient(origin.addr)
+            await oc2.upload(NS, d2, blob2)
+            await oc2.close()
+            assert await _pull(agents[0], d2) == blob2
+        finally:
+            await _teardown(tracker, origin, agents, cluster)
+
+    asyncio.run(main())
+
+
+# -- scenario 4: mid-transfer disconnect -> re-request -> pull finishes ------
+
+
+def test_mid_transfer_disconnect_rerequests_and_finishes(tmp_path):
+    async def main():
+        tracker, origin, agents, cluster = await _herd(tmp_path, n_agents=1)
+        try:
+            blob = os.urandom(6 * 64 * 1024 + 123)  # 7 pieces
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origin.addr)
+            await oc.upload(NS, d, blob)
+            await oc.close()
+
+            fired0 = _fired("p2p.conn.disconnect")
+            # First payload frame kills the conn (and discards the
+            # frame): the dispatcher must drop the peer without
+            # blacklisting, re-announce, re-dial, and re-request the
+            # lost piece.
+            failpoints.FAILPOINTS.arm("p2p.conn.disconnect", "once")
+            got = await _pull(agents[0], d)
+            assert got == blob
+            assert _fired("p2p.conn.disconnect") == fired0 + 1
+        finally:
+            await _teardown(tracker, origin, agents, cluster)
+
+    asyncio.run(main())
+
+
+# -- soak: probabilistic multi-fault swarm (slow) ----------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_probabilistic_faults_swarm(tmp_path):
+    """Seeded probabilistic corruption + disconnects + tracker errors,
+    all at once, over a 3-agent swarm pulling several blobs: every pull
+    must complete bit-identical. Fixed seeds make a failure replayable
+    with KRAKEN_FAILPOINTS set to the same specs."""
+
+    async def main():
+        from kraken_tpu.p2p.connstate import ConnStateConfig
+        from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+        # Quick-recovery blacklist: with probabilistic corruption an
+        # agent may ban every seeder; the test asserts recovery, not
+        # 30 s production cool-offs.
+        cfg = SchedulerConfig(
+            announce_interval_seconds=0.1,
+            conn_state=ConnStateConfig(
+                blacklist_backoff=Backoff(
+                    base_seconds=0.3, factor=1.5, max_seconds=2.0, jitter=0
+                ),
+                soft_blacklist_seconds=0.3,
+            ),
+        )
+        tracker, origin, agents, cluster = await _herd(
+            tmp_path, n_agents=3, scheduler_config=cfg
+        )
+        try:
+            blobs = []
+            oc = BlobClient(origin.addr)
+            for i in range(4):
+                blob = os.urandom(4 * 64 * 1024 + i * 1111)
+                blobs.append((Digest.from_bytes(blob), blob))
+                await oc.upload(NS, blobs[-1][0], blob)
+            await oc.close()
+
+            failpoints.FAILPOINTS.arm(
+                "p2p.conn.recv.corrupt", "prob:0.03+seed:1"
+            )
+            failpoints.FAILPOINTS.arm(
+                "p2p.conn.disconnect", "prob:0.01+seed:2"
+            )
+            failpoints.FAILPOINTS.arm(
+                "tracker.announce.error", "prob:0.2+seed:3"
+            )
+            failpoints.FAILPOINTS.arm(
+                "p2p.conn.send.delay", "prob:0.05+delay:20+seed:4"
+            )
+            results = await asyncio.gather(
+                *(
+                    _pull(a, d, timeout=120.0)
+                    for a in agents
+                    for d, _b in blobs
+                )
+            )
+            expected = [b for _a in agents for _d, b in blobs]
+            assert results == expected  # bit-identity on EVERY pull
+        finally:
+            failpoints.FAILPOINTS.disarm_all()
+            await _teardown(tracker, origin, agents, cluster)
+
+    asyncio.run(main())

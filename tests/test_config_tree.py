@@ -25,6 +25,7 @@ CLI_KEYS = {
     "registry_port", "build_index", "spool", "remotes", "dedup_index",
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
+    "registry_strict_accept", "failpoints",
 }
 
 

@@ -292,6 +292,81 @@ def test_evict_callback_failures_metered(tmp_path):
     assert not store.in_cache(d)  # eviction completed despite callbacks
 
 
+def test_jax_profile_lock_survives_client_disconnect(monkeypatch):
+    """ADVICE r5: a client disconnect mid-capture cancels the handler;
+    the shielded stop_trace keeps running in its thread, and the
+    process-global profile lock must stay held until stop COMPLETES --
+    releasing it earlier would let a second capture start_trace while
+    the profiler is still serializing the first. The lock is handed to
+    stop's done-callback on cancellation (utils/metrics.py)."""
+    import threading
+
+    import aiohttp
+    import jax
+
+    from kraken_tpu.assembly import TrackerNode
+
+    started = threading.Event()
+    release = threading.Event()
+    stopped = threading.Event()
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda out_dir: started.set()
+    )
+
+    def slow_stop():
+        release.wait(10)
+        stopped.set()
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", slow_stop)
+
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+        try:
+            url = f"http://{tracker.addr}/debug/jax-profile"
+            # Raw socket so we can hard-close mid-capture (an impatient
+            # curl): _serve runs with handler_cancellation, so the
+            # disconnect cancels the handler between start and stop.
+            reader, writer = await asyncio.open_connection(
+                tracker.host, tracker.port
+            )
+            writer.write(
+                b"GET /debug/jax-profile?seconds=30 HTTP/1.1\r\n"
+                b"Host: x\r\n\r\n"
+            )
+            await writer.drain()
+            assert await asyncio.to_thread(started.wait, 5), "capture never started"
+            writer.close()
+
+            async with aiohttp.ClientSession() as http:
+                # stop_trace is still running (blocked on `release`): a
+                # second capture must see the lock held -> 409. Poll a
+                # little to let the cancellation propagate first.
+                for _ in range(50):
+                    async with http.get(url, params={"seconds": "0.01"}) as r:
+                        status = r.status
+                    assert status in (200, 409)
+                    if status == 409:
+                        break
+                    await asyncio.sleep(0.02)
+                assert status == 409, "lock was released before stop_trace finished"
+
+                # stop completes -> lock releases -> captures work again.
+                release.set()
+                assert await asyncio.to_thread(stopped.wait, 5)
+                for _ in range(100):
+                    async with http.get(url, params={"seconds": "0.01"}) as r:
+                        status = r.status
+                    if status == 200:
+                        break
+                    await asyncio.sleep(0.02)
+                assert status == 200, "lock never released after stop_trace"
+        finally:
+            await tracker.stop()
+
+    asyncio.run(main())
+
+
 def test_debug_jax_profile_endpoint(tmp_path):
     """/debug/jax-profile captures a jax.profiler trace (the SURVEY SS5
     tracing story for the TPU half) and answers 409 while one runs."""

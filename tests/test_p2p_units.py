@@ -171,6 +171,30 @@ def test_request_manager_timeout_requeues():
     assert rm.select(pid(1), {0}, [0], {}, now=20.0) == [0]
 
 
+def test_request_manager_adaptive_hard_expiry_under_storm():
+    """The hard expiry is a FLOOR raised by observed service times: under
+    a re-request storm (saturated seeder, honest-but-slow completions)
+    in-flight requests must NOT expire at the configured timeout -- that
+    feedback loop re-requests live work and collapses goodput -- but the
+    adaptive cutoff stays capped at 10x the timeout so a truly dead peer
+    cannot park a piece forever."""
+    rm = RequestManager(pipeline_limit=4, timeout_seconds=2.0)
+    # Load regime: twenty completions each taking ~10 s drive the EWMA
+    # to ~10 s (>> the 2 s configured timeout).
+    for i in range(20):
+        rm.mark_sent(i, pid(1), now=float(i))
+        rm.clear_piece(i, now=float(i) + 10.0)
+    # cutoff = max(timeout, min(8 * ewma, 10 * timeout)) = 20 s here.
+    rm.mark_sent(100, pid(2), now=100.0)
+    # Past the base timeout (2 s): still pending -- NOT expired.
+    assert rm.pending_for(pid(2), now=104.0) == [100]
+    # Just under the 10x-timeout ceiling: still pending.
+    assert rm.pending_for(pid(2), now=119.5) == [100]
+    # Past the ceiling: expired, the piece is requestable again.
+    assert rm.pending_for(pid(2), now=121.0) == []
+    assert rm.select(pid(3), {100}, [100], {}, now=121.0) == [100]
+
+
 def test_request_manager_endgame_duplicates():
     rm = RequestManager(pipeline_limit=4)  # timeout 8 -> stale after 2
     assert rm.select(pid(1), {0, 1}, [0, 1], {}, now=0.0) == [0, 1] or True
@@ -428,6 +452,39 @@ def test_idle_churn_exempts_active_transfers(tmp_path):
         assert serving.peer_id in d._peers  # mid-serve: kept
         assert awaited.peer_id in d._peers  # awaiting payload: kept
         assert stuck.peer_id not in d._peers  # exemption capped: churned
+        d.close()
+
+    asyncio.run(main())
+
+
+def test_idle_churn_caps_request_pending_exemption(tmp_path):
+    """The request-pending exemption has the same 10x churn_idle bound as
+    the serving one: a peer we requested from that then goes fully
+    silent (no payload, no announce) must lose its conn slot at the cap
+    even while its request is still formally in flight."""
+
+    async def main():
+        from kraken_tpu.p2p.dispatch import Dispatcher, _Peer
+
+        t = _seeding_torrent(tmp_path, os.urandom(4096))
+        # Long request timeout: the pending request must still be live at
+        # the churn cap, so the cap (not request expiry) is what drops it.
+        d = Dispatcher(
+            t, requests=RequestManager(timeout_seconds=60.0),
+            churn_idle_seconds=2.0,  # cap at 20 s idle
+        )
+        now = asyncio.get_running_loop().time()
+        slow, dead = _FakeConn(pid(1)), _FakeConn(pid(2))
+        d._peers[slow.peer_id] = _Peer(slow, set(), now - 10.0)
+        d.requests.mark_sent(0, slow.peer_id, now=now - 10.0)
+        d._peers[dead.peer_id] = _Peer(dead, set(), now - 25.0)
+        d.requests.mark_sent(1, dead.peer_id, now=now - 25.0)
+        await d.tick()
+        assert slow.peer_id in d._peers  # within the cap: exempt
+        assert dead.peer_id not in d._peers  # past 10x churn_idle: dropped
+        # Its in-flight request was released with the peer, so the piece
+        # is immediately re-requestable elsewhere.
+        assert d.requests.pending_for(dead.peer_id) == []
         d.close()
 
     asyncio.run(main())

@@ -67,9 +67,11 @@ class FakeTransferer:
 
 
 class Rig:
-    def __init__(self, read_only=False):
+    def __init__(self, read_only=False, strict_accept=False):
         self.transferer = FakeTransferer()
-        self.server = RegistryServer(self.transferer, read_only=read_only)
+        self.server = RegistryServer(
+            self.transferer, read_only=read_only, strict_accept=strict_accept
+        )
 
     async def __aenter__(self):
         self.runner = web.AppRunner(self.server.make_app())
@@ -498,8 +500,9 @@ def test_error_envelope_on_randomized_garbage():
 
 def test_manifest_accept_negotiation():
     """VERDICT r4 #7: manifest GET/HEAD honors Accept. Stored-type
-    listed, no header, or a wildcard -> 200 with the stored type; a
-    client pinned to types we don't hold -> typed 406 (extension code
+    listed, no header, or a wildcard -> 200 with the stored type; in
+    STRICT mode (`registry_strict_accept: true`) a client pinned to
+    types we don't hold -> typed 406 (extension code
     MANIFEST_NOT_ACCEPTABLE -- see API.md), never bytes it would choke
     on. Covered for docker-schema2, OCI manifest, and list types."""
 
@@ -509,7 +512,7 @@ def test_manifest_accept_negotiation():
     OCI_INDEX = "application/vnd.oci.image.index.v1+json"
 
     async def main():
-        async with Rig() as rig:
+        async with Rig(strict_accept=True) as rig:
             stored = {}
             for tag, media in (
                 ("docker2", DOCKER2), ("oci", OCI), ("list", LIST),
@@ -560,6 +563,33 @@ def test_manifest_accept_negotiation():
                 headers={"Accept": OCI},
             ) as r:
                 assert r.status == 406
+
+    asyncio.run(main())
+
+
+def test_manifest_accept_lenient_by_default():
+    """ADVICE r5: strict Accept is opt-in. By DEFAULT a client pinned to
+    a type we don't hold still gets the stored bytes with the stored
+    Content-Type (the reference's behavior) -- older docker/containerd
+    clients send narrow Accept headers yet parse the bytes fine, and a
+    406 would fail pulls that used to work."""
+
+    DOCKER2 = "application/vnd.docker.distribution.manifest.v2+json"
+    OCI = "application/vnd.oci.image.manifest.v1+json"
+
+    async def main():
+        async with Rig() as rig:  # strict_accept defaults to False
+            body = json.dumps({"mediaType": DOCKER2, "t": "x"}).encode()
+            d = Digest.from_bytes(body)
+            rig.transferer.blobs[str(d)] = body
+            rig.transferer.tags["repo:docker2"] = d
+            async with rig.http.get(
+                f"{rig.base}/v2/repo/manifests/docker2",
+                headers={"Accept": OCI},  # pinned to a type we don't hold
+            ) as r:
+                assert r.status == 200, await r.text()
+                assert r.headers["Content-Type"] == DOCKER2
+                assert await r.read() == body
 
     asyncio.run(main())
 
