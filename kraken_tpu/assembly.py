@@ -45,6 +45,8 @@ from kraken_tpu.p2p.storage import (
 )
 from kraken_tpu.store import CAStore
 from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+from kraken_tpu.store.recovery import run_fsck, write_clean_shutdown
+from kraken_tpu.store.scrub import ScrubConfig, Scrubber
 from kraken_tpu.tracker.client import TrackerClient
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore, RedisPeerStore
 from kraken_tpu.tracker.server import TrackerServer
@@ -91,6 +93,13 @@ async def _ring_refresh_loop(get_cluster, interval: float) -> None:
         try:
             if cluster is not None:
                 await cluster.ring.refresh_async()
+                # Same tick: drop passive-health verdicts for hosts that
+                # left the hostlist -- the failure map must not grow
+                # without bound under membership churn, and a departed
+                # host's stale verdict must not greet a reused address.
+                health = getattr(cluster, "health", None)
+                if health is not None:
+                    health.prune(cluster.ring.resolved_hosts)
         except Exception as e:
             # Flapping DNS / dead origins must show on /metrics, not
             # vanish into the retry loop.
@@ -202,6 +211,9 @@ class OriginNode:
         p2p_bandwidth: dict | None = None,
         ssl_context=None,
         durability: str = "rename",
+        scrub: dict | ScrubConfig | None = None,
+        fsck: bool = True,
+        task_timeout_seconds: float = 1800.0,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -236,8 +248,15 @@ class OriginNode:
         self.refresher = (
             Refresher(self.store, backends, self.generator) if backends else None
         )
+        # task_timeout_seconds bounds ONE executor run (a hung writeback
+        # socket must not stall every task kind); a cut task reschedules
+        # with backoff. Size it above your slowest legitimate transfer
+        # (multi-GiB writeback over a slow link); 0 disables.
         self.retry = (
-            RetryManager(TaskStore(retry_db or f"{store_root}/retry.db"))
+            RetryManager(
+                TaskStore(retry_db or f"{store_root}/retry.db"),
+                task_timeout_seconds=task_timeout_seconds,
+            )
         )
         self.writeback = (
             WritebackExecutor(self.store, backends, self.retry) if backends else None
@@ -263,6 +282,16 @@ class OriginNode:
             BandwidthLimiter(**p2p_bandwidth) if p2p_bandwidth else None
         )
         self.ssl_context = ssl_context
+        # Self-healing storage plane (store/recovery.py, store/scrub.py):
+        # fsck reconciles the tree before any listener binds; the
+        # scrubber re-verifies at-rest bytes on a budgeted cycle and
+        # feeds corruption into the heal plane (origin/server.py).
+        self.fsck_enabled = fsck
+        self.scrub_config = (
+            ScrubConfig(**scrub) if isinstance(scrub, dict) else scrub
+        )
+        self.scrubber: Optional[Scrubber] = None
+        self.fsck_report = None
         self.monitor: Optional[ActiveMonitor] = None
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[OriginServer] = None
@@ -295,8 +324,42 @@ class OriginNode:
         except DigestError:
             return None
 
+    def _on_scrub_corrupt(self, d: Digest, ns: str) -> None:
+        """Scrub-task context (event loop), AFTER the blob moved to
+        quarantine: every derived plane must drop it (the dedup index
+        would hand out a ghost; the scheduler would advertise bytes we
+        no longer hold), then the heal plane restores it."""
+        if self.dedup is not None:
+            try:
+                # Sidecar already moved with the blob; remove_sync
+                # adjusts the ledger from whatever is still readable.
+                self.dedup.remove_sync(d)
+            except Exception:
+                _log.warning(
+                    "dedup drop of quarantined blob failed",
+                    extra={"digest": d.hex}, exc_info=True,
+                )
+        if self.scheduler is not None:
+            self.scheduler.unseed(d)
+        if self.server is not None:
+            self.server.enqueue_heal(ns, d)
+
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        # Startup fsck BEFORE any listener binds: the tree must be
+        # reconciled (orphans swept, crash-window blobs verified) before
+        # the swarm, replication, or writeback can stream from it.
+        if self.fsck_enabled:
+            self.fsck_report = await asyncio.to_thread(
+                run_fsck,
+                self.store,
+                upload_ttl_seconds=(
+                    self.cleanup.config.upload_ttl_seconds
+                    if self.cleanup
+                    else 6 * 3600
+                ),
+                expect_namespace=True,
+            )
         # Fixed p2p port -> stable addr_hash identity across restarts (the
         # reference's default); ephemeral port -> random identity.
         factory = PeerIDFactory(
@@ -346,6 +409,27 @@ class OriginNode:
             self.self_addr = self.addr
             self.server.self_addr = self.addr
         self.retry.start()
+        # Blobs fsck quarantined (crash-window corruption) enter the heal
+        # plane now that the retry manager is polling: re-fetch from ring
+        # replicas, backend read-through fallback (origin/server.py).
+        if self.fsck_report is not None:
+            from kraken_tpu.store.recovery import quarantine_namespace
+
+            for hex_ in self.fsck_report.quarantined:
+                self.server.enqueue_heal(
+                    quarantine_namespace(self.store, hex_),
+                    Digest.from_hex(hex_),
+                )
+        # Background integrity scrubber: budgeted re-verification of
+        # at-rest bytes, corruption -> quarantine -> heal.
+        if self.scrub_config is not None:
+            self.scrubber = Scrubber(
+                self.store,
+                self.scrub_config,
+                hasher=self.generator.hasher,
+                on_corrupt=self._on_scrub_corrupt,
+            )
+            self.scrubber.start()
         # Seed everything already on disk (origin startup behavior). A blob
         # whose metainfo sidecar was lost (partial disk restore, manual
         # cleanup) gets its metainfo REGENERATED -- otherwise it would stay
@@ -477,6 +561,11 @@ class OriginNode:
                 ]
                 await self.monitor.check_all(peers)
                 await self.ring.refresh_async()
+                # Forget verdicts for hosts that left the membership --
+                # the monitor map must not grow without bound under
+                # churn, and a stale verdict must not greet a reused
+                # address (placement/healthcheck.py prune).
+                self.monitor.prune(self.ring.resolved_hosts)
             except Exception as e:
                 _health_probe_failures.record("health probe sweep", e)
 
@@ -506,6 +595,8 @@ class OriginNode:
             self._cleanup_task.cancel()
         if self._reseed_task:
             self._reseed_task.cancel()
+        if self.scrubber:
+            self.scrubber.stop()
         for t in list(self._repair_tasks):
             t.cancel()
         self.retry.stop()
@@ -517,6 +608,11 @@ class OriginNode:
             await self._tracker_client.close()
         if self._health_http:
             await self._health_http.close()
+        if self.server:
+            await self.server.close_heal_cluster()
+        # LAST: the clean-shutdown stamp bounds the next boot's fsck
+        # crash-window verify to blobs written after this instant.
+        await asyncio.to_thread(write_clean_shutdown, self.store)
 
 
 class BuildIndexNode:
@@ -532,13 +628,17 @@ class BuildIndexNode:
         origin_cluster: ClusterClient | None = None,
         ssl_context=None,
         immutable_tags: bool = False,
+        task_timeout_seconds: float = 1800.0,
     ):
         from kraken_tpu.buildindex.server import TagServer
         from kraken_tpu.buildindex.tagstore import TagStore
 
         self.host = host
         self.port = port
-        self.retry = RetryManager(TaskStore(f"{store_root}/retry.db"))
+        self.retry = RetryManager(
+            TaskStore(f"{store_root}/retry.db"),
+            task_timeout_seconds=task_timeout_seconds,
+        )
         self.store = TagStore(
             f"{store_root}/tags", backends=backends, retry=self.retry
         )
@@ -653,6 +753,8 @@ class AgentNode:
         tag_cache_ttl: float = 0.0,
         durability: str = "rename",
         registry_strict_accept: bool = False,
+        scrub: dict | ScrubConfig | None = None,
+        fsck: bool = True,
     ):
         self.host = host
         self.http_port = http_port
@@ -697,6 +799,16 @@ class AgentNode:
         # immutable_tags on the build-index: with mutable tags, a positive
         # cache serves a re-pointed tag's OLD digest for up to the TTL.
         self.tag_cache_ttl = tag_cache_ttl
+        # Agent self-healing: fsck sweeps crash debris; the scrubber
+        # quarantines rot and unseeds it. No heal task here -- an agent
+        # cache miss already re-pulls through the swarm on demand, and
+        # agents never write namespace sidecars (expect_namespace=False).
+        self.fsck_enabled = fsck
+        self.scrub_config = (
+            ScrubConfig(**scrub) if isinstance(scrub, dict) else scrub
+        )
+        self.scrubber: Optional[Scrubber] = None
+        self.fsck_report = None
         self.scheduler: Optional[Scheduler] = None
         self.server: Optional[AgentServer] = None
         self._runner: Optional[web.AppRunner] = None
@@ -718,6 +830,13 @@ class AgentNode:
         if loop is not None and sched is not None:
             loop.call_soon_threadsafe(sched.unseed, d)
 
+    def _on_scrub_corrupt(self, d: Digest, ns: str) -> None:
+        """Scrub-task context (event loop), blob already quarantined: stop
+        advertising it to the swarm. The next local read is a cache miss
+        and re-pulls verified pieces on demand -- the agent's heal path."""
+        if self.scheduler is not None:
+            self.scheduler.unseed(d)
+
     @property
     def registry_addr(self) -> str | None:
         """Where the docker-registry read endpoint is served, or None when
@@ -728,6 +847,17 @@ class AgentNode:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        if self.fsck_enabled:
+            self.fsck_report = await asyncio.to_thread(
+                run_fsck,
+                self.store,
+                upload_ttl_seconds=(
+                    self.cleanup.config.upload_ttl_seconds
+                    if self.cleanup
+                    else 6 * 3600
+                ),
+                expect_namespace=False,
+            )
         factory = PeerIDFactory(
             PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
         )
@@ -758,6 +888,14 @@ class AgentNode:
             self._cleanup_task = asyncio.create_task(
                 _cleanup_loop(self.cleanup)
             )
+        if self.scrub_config is not None:
+            self.scrubber = Scrubber(
+                self.store,
+                self.scrub_config,
+                hasher=self.verifier.hasher,
+                on_corrupt=self._on_scrub_corrupt,
+            )
+            self.scrubber.start()
         if self.build_index_addr:
             from kraken_tpu.buildindex.server import TagClient
             from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -785,6 +923,8 @@ class AgentNode:
     async def stop(self) -> None:
         if self._cleanup_task:
             self._cleanup_task.cancel()
+        if self.scrubber:
+            self.scrubber.stop()
         if self.scheduler:
             await self.scheduler.stop()
         if self._runner:
@@ -795,3 +935,5 @@ class AgentNode:
             await self._tracker_client.close()
         if self._tag_client:
             await self._tag_client.close()
+        # LAST: bound the next boot's fsck crash-window verify.
+        await asyncio.to_thread(write_clean_shutdown, self.store)

@@ -110,6 +110,10 @@ def main(argv: list[str] | None = None) -> None:
                           help="this origin's address AS IT APPEARS in"
                                " --cluster (required with --cluster; health"
                                " probes and repair must exclude self)")
+    p_origin.add_argument("--scrub-bps", type=float, default=None,
+                          help="background integrity-scrub read budget in"
+                               " bytes/sec (overrides scrub.bytes_per_second;"
+                               " 0 = unthrottled)")
 
     p_agent = sub.add_parser("agent")
     _common(p_agent)
@@ -125,6 +129,10 @@ def main(argv: list[str] | None = None) -> None:
                               " (requires --build-index)")
     p_agent.add_argument("--build-index", default=None,
                          help="build-index addr for tag -> digest lookups")
+    p_agent.add_argument("--scrub-bps", type=float, default=None,
+                         help="background integrity-scrub read budget in"
+                              " bytes/sec (overrides scrub.bytes_per_second;"
+                              " 0 = unthrottled)")
 
     p_bi = sub.add_parser("build-index")
     _common(p_bi)
@@ -147,6 +155,29 @@ def main(argv: list[str] | None = None) -> None:
         "scrub", help="offline store integrity scrub (exit 1 on corruption)"
     )
     p_scrub.add_argument("--store", required=True)
+
+    p_fsck = sub.add_parser(
+        "fsck", help="offline store-tree reconciliation: sweep crash"
+        " debris, re-adopt orphans, verify crash-window blobs; exit"
+        " 0 clean / 1 repaired / 2 unhealable (quarantined) /"
+        " 3 usage error -- deploy scripts gate on it"
+    )
+    p_fsck.add_argument("--root", required=True,
+                        help="store root (the directory holding upload/"
+                             " and cache/)")
+    p_fsck.add_argument("--upload-ttl", type=float, default=21600.0,
+                        help="sweep spool/partial files idle longer than"
+                             " this many seconds (0 disables)")
+    p_fsck.add_argument("--expect-namespace", action="store_true",
+                        help="origin store: re-adopt data files missing"
+                             " a namespace sidecar (never set for agent"
+                             " stores -- agents do not write namespace"
+                             " sidecars)")
+    p_fsck.add_argument("--verify", choices=["auto", "all", "none"],
+                        default="auto",
+                        help="content verification scope: auto ="
+                             " crash-window only (clean-shutdown stamp),"
+                             " all = every blob, none = skip")
 
     p_locate = sub.add_parser(
         "locate", help="print a digest's ring placement offline"
@@ -199,7 +230,10 @@ def main(argv: list[str] | None = None) -> None:
         # the configured PieceHasher-backed digest path and report
         # corruption. CAS semantics make this exact -- a blob's name IS
         # its digest. Exit 1 if anything fails verification (cron-able).
-        import os
+        # NOTE: no local `import os` here -- a function-local import
+        # would shadow the module-level one for ALL of main(), making
+        # every later `os.` reference in other branches an
+        # UnboundLocalError.
         import sys
 
         from kraken_tpu.core.digest import Digest
@@ -233,6 +267,43 @@ def main(argv: list[str] | None = None) -> None:
         if bad:
             sys.exit(1)
         return
+
+    if args.component == "fsck":
+        # Offline crash-recovery reconciliation: everything the startup
+        # fsck does in assembly, runnable from cron/CI against a store
+        # whose node is down. Exit codes are the deploy-gate contract
+        # (docs/OPERATIONS.md): 0 clean, 1 repaired, 2 unhealable --
+        # quarantined blobs need the live heal plane (or a backend
+        # restore) before the node serves them again; 3 usage/config
+        # error (the store was never examined -- a typo'd path must not
+        # page as "data corruption" nor pass as "clean").
+        import sys
+
+        from kraken_tpu.store import CAStore
+        from kraken_tpu.store.recovery import run_fsck
+
+        # Refuse a nonexistent root: CAStore would create the tree and a
+        # typo'd path would "fsck clean" forever.
+        if not os.path.isdir(args.root):
+            print(json.dumps({
+                "event": "error",
+                "message": f"store root does not exist: {args.root}",
+            }), flush=True)
+            sys.exit(3)
+        report = run_fsck(
+            CAStore(args.root),
+            upload_ttl_seconds=args.upload_ttl,
+            expect_namespace=args.expect_namespace,
+            verify=args.verify,
+        )
+        print(json.dumps({
+            "event": "fsck_done",
+            "repairs": report.repairs,
+            "quarantined": report.quarantined,
+            "verified": report.verified,
+            "exit_code": report.exit_code,
+        }), flush=True)
+        sys.exit(report.exit_code)
 
 
     if args.component == "locate":
@@ -288,6 +359,17 @@ def main(argv: list[str] | None = None) -> None:
     # low_watermark_bytes, interval_seconds} -- absent = eviction off.
     cleanup_cfg = cfg.get("cleanup")
     cleanup = CleanupConfig(**cleanup_cfg) if cleanup_cfg else None
+
+    # YAML: scrub: {interval_seconds, bytes_per_second, chunk_bytes} --
+    # absent = background integrity scrubbing off. --scrub-bps overrides
+    # the budget (and enables scrubbing with defaults when no section
+    # exists). YAML: fsck: false disables the startup reconciliation
+    # (default on; docs/OPERATIONS.md).
+    scrub_cfg = cfg.get("scrub")
+    if getattr(args, "scrub_bps", None) is not None:
+        scrub_cfg = dict(scrub_cfg or {})
+        scrub_cfg["bytes_per_second"] = args.scrub_bps
+    fsck_enabled = bool(cfg.get("fsck", True))
 
     # YAML: tls: {cert: path, key: path[, client_ca: path]} -- terminate
     # TLS on the HTTP listener (the reference fronts components with
@@ -436,6 +518,14 @@ def main(argv: list[str] | None = None) -> None:
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
             durability=cfg.get("durability", "rename"),
+            scrub=scrub_cfg,
+            fsck=fsck_enabled,
+            # YAML: per-task executor timeout for the durable retry
+            # plane (writeback/replication/heal). Raise above your
+            # slowest legitimate transfer; 0 disables.
+            task_timeout_seconds=float(
+                cfg.get("task_timeout_seconds", 1800.0)
+            ),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -473,6 +563,8 @@ def main(argv: list[str] | None = None) -> None:
             registry_strict_accept=bool(
                 cfg.get("registry_strict_accept", False)
             ),
+            scrub=scrub_cfg,
+            fsck=fsck_enabled,
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)
@@ -495,6 +587,9 @@ def main(argv: list[str] | None = None) -> None:
             # YAML: immutable_tags: true -- a tag can never be re-pointed
             # at a different digest (same-digest re-push stays idempotent).
             immutable_tags=bool(cfg.get("immutable_tags", False)),
+            task_timeout_seconds=float(
+                cfg.get("task_timeout_seconds", 1800.0)
+            ),
         )
         asyncio.run(_run_until_signal(node, {"component": "build-index"}))
 

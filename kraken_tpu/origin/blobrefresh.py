@@ -15,6 +15,7 @@ from kraken_tpu.origin.metainfogen import Generator
 from kraken_tpu.store import CAStore
 from kraken_tpu.store.castore import DigestMismatchError, FileExistsInCacheError
 from kraken_tpu.utils.dedup import RequestCoalescer
+from kraken_tpu.utils.metrics import REGISTRY
 
 
 class Refresher:
@@ -67,10 +68,21 @@ class Refresher:
             except FileExistsInCacheError:
                 pass  # a concurrent path restored it; ours was redundant
             except DigestMismatchError as e:
+                # The heal plane leans on this read-through as its last
+                # resort; a backend serving wrong bytes must be visibly
+                # distinct from a backend miss on /metrics.
+                REGISTRY.counter(
+                    "blob_refresh_pulls_total",
+                    "Backend read-through pulls by result",
+                ).inc(result="corrupt")
                 raise BlobNotFoundError(
                     f"backend returned corrupt blob: {e}"
                 ) from None
         except BaseException:
             self.store.abort_upload(uid)
             raise
+        REGISTRY.counter(
+            "blob_refresh_pulls_total",
+            "Backend read-through pulls by result",
+        ).inc(result="ok")
         await self.generator.generate(d)

@@ -164,6 +164,7 @@ class ClusterClient:
         ring: Ring,
         client_factory: Callable[[str], BlobClient] | None = None,
         health=None,  # placement.healthcheck.PassiveFilter (optional)
+        exclude_addr: str = "",
     ):
         self.ring = ring
         self._factory = client_factory or BlobClient
@@ -172,6 +173,11 @@ class ClusterClient:
         # the ring's health_filter, failing origins leave the ring on the
         # next refresh (SURVEY.md SS5 failure detection).
         self.health = health
+        # An origin using a ClusterClient over its OWN ring (the heal
+        # plane re-fetching a quarantined blob from replicas) must skip
+        # itself: asking yourself for the bytes you just lost is at best
+        # a wasted round-trip and at worst a read-through loop.
+        self.exclude_addr = exclude_addr
 
     def _client(self, addr: str) -> BlobClient:
         if addr not in self._clients:
@@ -179,7 +185,11 @@ class ClusterClient:
         return self._clients[addr]
 
     def clients_for(self, d: Digest) -> list[BlobClient]:
-        return [self._client(a) for a in self.ring.locations(d)]
+        return [
+            self._client(a)
+            for a in self.ring.locations(d)
+            if a != self.exclude_addr
+        ]
 
     def _report(self, c: BlobClient, ok: bool) -> None:
         if self.health is not None:

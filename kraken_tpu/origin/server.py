@@ -201,6 +201,7 @@ class _UploadDigest:
         return b"".join(out)
 
 REPLICATE_KIND = "replicate"
+HEAL_KIND = "heal"
 
 
 def _replication_task(addr: str, ns: str, d: Digest) -> Task:
@@ -212,6 +213,19 @@ def _replication_task(addr: str, ns: str, d: Digest) -> Task:
         kind=REPLICATE_KIND,
         key=f"{d.hex}:{ns}:{addr}",
         payload={"addr": addr, "namespace": ns, "digest": d.hex},
+    )
+
+
+def _heal_task(ns: str, d: Digest) -> Task:
+    """Restore a quarantined blob from healthy ring replicas (backend
+    read-through fallback). Rides the persistedretry plane so a heal
+    that cannot succeed NOW (every replica down, backend flapping)
+    retries with backoff until the cluster recovers -- corruption must
+    never be forgotten just because the first re-fetch failed."""
+    return Task(
+        kind=HEAL_KIND,
+        key=f"{d.hex}:{ns}",
+        payload={"namespace": ns, "digest": d.hex},
     )
 
 
@@ -243,6 +257,7 @@ class OriginServer:
         self.dedup = dedup
         self.cleanup = cleanup
         self._dedup_tasks: set[asyncio.Task] = set()
+        self._heal_cluster = None  # lazy ClusterClient (heal plane)
         self._upload_digests: dict[str, _UploadDigest] = {}
         # Optimistic stream-time piece length: the piece-length config is
         # keyed on FINAL blob size (unknown mid-stream), so stream piece-
@@ -270,6 +285,7 @@ class OriginServer:
         )
         if retry is not None:
             retry.register(REPLICATE_KIND, self._execute_replication)
+            retry.register(HEAL_KIND, self._execute_heal)
             # Earlier builds keyed tasks '{addr}:{ns}:{hex}'; rewrite any
             # such persisted rows so the digest-first prefix scan in
             # _maybe_unpin sees them (a missed row releases the eviction
@@ -542,6 +558,12 @@ class OriginServer:
         assert self.retry is not None
         added = self.retry.add(_replication_task(addr, ns, d))
         if added:
+            # Visible enqueue rate: the heal loop's "replication
+            # re-enqueued" claim must be checkable from /metrics.
+            REGISTRY.counter(
+                "replication_enqueued_total",
+                "Replication tasks accepted into the persistedretry queue",
+            ).inc()
             # Pin against eviction until the blob lands on every target
             # (otherwise a cleanup sweep can erase the cluster's only copy
             # while the peer is down). Unpinned in _execute_replication.
@@ -672,6 +694,120 @@ class OriginServer:
             REPLICATE_KIND, f"{d.hex}:"
         ) <= 1 and self.store.in_cache(d):
             unpin(self.store, d, REPLICATE_KIND)
+
+    # -- self-heal (quarantined blob -> ring re-fetch -> re-replicate) -----
+
+    def enqueue_heal(self, ns: str, d: Digest) -> bool:
+        """Queue a durable restore of a quarantined/lost blob. Called by
+        the scrubber's corruption hook (assembly wiring); dedups on
+        (kind, key) so repeated scrub cycles over a still-broken blob
+        don't stack tasks."""
+        if self.retry is None:
+            return False
+        return self.retry.add(_heal_task(ns, d))
+
+    async def _execute_heal(self, task: Task) -> None:
+        """Restore one blob bit-identically, then re-converge the ring.
+
+        Source order: healthy ring replicas first (ClusterClient
+        ``_try_each`` in ring order, self excluded; arrival is committed
+        through the verifying ``commit_upload``, so a replica serving
+        wrong bytes can never be adopted), then backend read-through
+        (``Refresher`` -- its commit verifies too). Both exhausted ->
+        raise, and the retry plane re-runs with backoff until the
+        cluster recovers. After restore the FULL commit pipeline runs
+        (namespace sidecar, metainfo + seed, writeback, replication,
+        dedup), so the ring converges back to max_replica."""
+        d = Digest.from_hex(task.payload["digest"])
+        ns = task.payload["namespace"]
+        source = ""
+        if self.store.in_cache(d):
+            # A cached copy usually means a racing path (refresh,
+            # replication push) already restored the blob -- but it can
+            # also be the CORRUPT original whose quarantine move failed
+            # on a dying disk (fsck suppresses that OSError yet still
+            # enqueues the heal). A heal may declare NOTHING healed
+            # unverified: re-hash, and move rot aside before restoring
+            # over it (commit refuses to overwrite a cache path). If
+            # even the move fails, the raise reschedules the task --
+            # better to retry than to re-seed corrupt bytes.
+            if await asyncio.to_thread(self._cached_matches, d):
+                source = "cached"
+            else:
+                await asyncio.to_thread(self.store.quarantine_cache_file, d)
+        if not source and self.ring is not None:
+            cluster = await self._get_heal_cluster()
+            uid = self.store.create_upload()
+            try:
+                await cluster.download_to_file(
+                    ns, d, self.store.upload_path(uid)
+                )
+                await asyncio.to_thread(self.store.commit_upload, uid, d)
+                source = "ring"
+            except FileExistsInCacheError:
+                source = "ring"
+            except Exception:
+                _log.warning(
+                    "heal: no ring replica could serve the blob; trying"
+                    " backend read-through",
+                    extra={"digest": d.hex, "namespace": ns},
+                )
+            finally:
+                self.store.abort_upload(uid)  # no-op once committed
+        if not source:
+            if self.refresher is None:
+                raise BlobNotFoundError(
+                    f"heal: no ring replica and no backend for {d.hex}"
+                )
+            # Coalesced, verified backend pull (blobrefresh.py); raises
+            # BlobNotFoundError when the backend misses too -> retry.
+            await self.refresher.refresh(ns, d)
+            source = "backend"
+        REGISTRY.counter(
+            "blob_heals_total",
+            "Quarantined/lost blobs restored bit-identically, by source",
+        ).inc(source=source)
+        _log.info(
+            "heal: blob restored",
+            extra={"digest": d.hex, "namespace": ns, "source": source},
+        )
+        # Re-run the commit pipeline: re-seed, re-writeback, and
+        # re-enqueue replication so every ring owner is made whole.
+        await self._post_commit(ns, d)
+
+    def _cached_matches(self, d: Digest) -> bool:
+        """Shared invariant check (``CAStore.verify_cache_file``):
+        unreadable (EIO) or vanished both read as 'not a healthy copy'."""
+        return self.store.verify_cache_file(d)
+
+    async def _get_heal_cluster(self):
+        """One ClusterClient (pooled aiohttp sessions) reused across heal
+        executions instead of a dial-everything-fresh per task -- heals
+        retry with backoff precisely when the cluster is degraded, the
+        worst moment to pay TCP/TLS setup per attempt. Rebuilt if the
+        ring or self_addr was swapped after construction (herd harnesses
+        attach them post-start); the ring's own health filter already
+        keeps dead members out of ``locations``. Closed by assembly at
+        node stop."""
+        from kraken_tpu.origin.client import ClusterClient
+
+        c = self._heal_cluster
+        if (
+            c is not None
+            and c.ring is self.ring
+            and c.exclude_addr == self.self_addr
+        ):
+            return c
+        if c is not None:
+            await c.close()
+        c = ClusterClient(self.ring, exclude_addr=self.self_addr)
+        self._heal_cluster = c
+        return c
+
+    async def close_heal_cluster(self) -> None:
+        if self._heal_cluster is not None:
+            await self._heal_cluster.close()
+            self._heal_cluster = None
 
     # -- reads -------------------------------------------------------------
 

@@ -55,7 +55,9 @@ class BatchedVerifier:
         # while a trickle no longer pays a fixed 2 ms per piece -- at
         # 1 MiB pieces that tax alone capped a pair at ~500 MB/s (round-5
         # pair profile). Raise it only to build bigger TPU batches.
-        self._hasher = hasher or get_hasher("cpu")
+        # Public: the agent's scrubber reuses this hasher's pool for its
+        # digest work (assembly wiring) -- renaming it must break loudly.
+        self.hasher = hasher or get_hasher("cpu")
         self._max_batch = max_batch
         self._max_delay = max_delay_seconds
         self._queue: list[tuple[bytes, bytes, asyncio.Future]] = []
@@ -105,7 +107,7 @@ class BatchedVerifier:
     ) -> None:
         try:
             digests = await asyncio.to_thread(
-                self._hasher.hash_batch, [d for d, _e, _f in batch]
+                self.hasher.hash_batch, [d for d, _e, _f in batch]
             )
         except Exception as e:
             # A hasher failure must fail the waiters, not strand them.

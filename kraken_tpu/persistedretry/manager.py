@@ -5,12 +5,15 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import json
+import logging
 import os
 import sqlite3
 import time
 from typing import Awaitable, Callable, Optional
 
 from kraken_tpu.utils.backoff import Backoff
+
+_log = logging.getLogger("kraken.persistedretry")
 
 
 @dataclasses.dataclass
@@ -173,13 +176,22 @@ class Manager:
         poll_interval_seconds: float = 1.0,
         backoff: Backoff | None = None,
         max_attempts: int = 0,  # 0 = retry forever (reference semantics)
+        task_timeout_seconds: float = 1800.0,  # 0 = no per-task timeout
     ):
         self.store = store
         self.poll_interval = poll_interval_seconds
         self.backoff = backoff or Backoff(base_seconds=1.0, max_seconds=300.0)
         self.max_attempts = max_attempts
+        # One poll loop serves EVERY task kind, so a single hung executor
+        # (a writeback upload wedged on a dead backend socket) would
+        # stall writeback, replication, AND heal forever. The timeout is
+        # generous -- a multi-GiB writeback legitimately takes minutes --
+        # but it must exist: a timed-out task just reschedules with
+        # backoff like any other failure.
+        self.task_timeout = task_timeout_seconds
         self._executors: dict[str, Callable[[Task], Awaitable[None]]] = {}
         self._task: Optional[asyncio.Task] = None
+        self._poll_failures = None  # lazy FailureMeter (start() only)
 
     def register(self, kind: str, fn: Callable[[Task], Awaitable[None]]) -> None:
         self._executors[kind] = fn
@@ -199,7 +211,26 @@ class Manager:
             if fn is None:
                 continue  # executor not registered (yet); leave queued
             try:
-                await fn(task)
+                if self.task_timeout > 0:
+                    try:
+                        await asyncio.wait_for(fn(task), self.task_timeout)
+                    except asyncio.TimeoutError:
+                        from kraken_tpu.utils.metrics import REGISTRY
+
+                        REGISTRY.counter(
+                            "retry_task_timeouts_total",
+                            "Retry tasks cancelled at task_timeout_seconds",
+                        ).inc(kind=task.kind)
+                        _log.warning(
+                            "retry task timed out; rescheduling",
+                            extra={
+                                "kind": task.kind, "key": task.key,
+                                "timeout_seconds": self.task_timeout,
+                            },
+                        )
+                        raise
+                else:
+                    await fn(task)
             except Exception:
                 task.attempts += 1
                 if self.max_attempts and task.attempts >= self.max_attempts:
@@ -214,9 +245,26 @@ class Manager:
         return ok
 
     def start(self) -> None:
+        # The poll itself can raise (transient sqlite disk error in
+        # store.ready, or done/reschedule mid-cycle). An unguarded loop
+        # dies SILENTLY on the first such error -- every durable plane
+        # (writeback, replication, heal) then stops forever while the
+        # process looks healthy. Meter + structured WARN + keep polling.
+        from kraken_tpu.utils.metrics import FailureMeter
+
+        if self._poll_failures is None:
+            self._poll_failures = FailureMeter(
+                "retry_poll_errors_total",
+                "Retry-queue poll cycles that raised (loop kept polling)",
+                _log,
+            )
+
         async def loop():
             while True:
-                await self.run_once()
+                try:
+                    await self.run_once()
+                except Exception as e:
+                    self._poll_failures.record("retry poll", e)
                 await asyncio.sleep(self.poll_interval)
 
         self._task = asyncio.create_task(loop())

@@ -45,6 +45,18 @@ class PassiveFilter:
         # nothing, as in the reference).
         return out or list(hosts)
 
+    def prune(self, current_hosts: Iterable[str]) -> int:
+        """Forget hosts that left the hostlist. Without this the failure
+        map grows without bound under membership churn (k8s pod cycling
+        mints a fresh ip:port per generation) and a departed host's stale
+        verdict would apply to a REUSED address the moment it comes back.
+        Called from the assembly refresh tick. Returns entries dropped."""
+        keep = set(current_hosts)
+        stale = [h for h in self._fails if h not in keep]
+        for h in stale:
+            del self._fails[h]
+        return len(stale)
+
 
 class ActiveMonitor:
     """Periodic probe of every host; tracks consecutive pass/fail counts.
@@ -95,3 +107,14 @@ class ActiveMonitor:
     def filter(self, hosts: Iterable[str]) -> list[str]:
         out = [h for h in hosts if self.healthy(h)]
         return out or list(hosts)
+
+    def prune(self, current_hosts: Iterable[str]) -> int:
+        """Forget verdicts for hosts no longer in the hostlist (same
+        unbounded-growth and stale-verdict hazard as
+        :meth:`PassiveFilter.prune`; a host re-added later starts fresh
+        at the healthy default). Returns entries dropped."""
+        keep = set(current_hosts)
+        stale = [h for h in self._state if h not in keep]
+        for h in stale:
+            del self._state[h]
+        return len(stale)

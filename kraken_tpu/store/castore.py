@@ -9,6 +9,7 @@ Layout:
     <root>/upload/<uuid>                 in-flight uploads (random names)
     <root>/cache/<hex[:2]>/<hex[2:4]>/<hex>   committed blobs, sharded
     <data_path>._md_<name>               typed metadata sidecars
+    <root>/quarantine/<hex>              corrupt blobs moved aside (+ sidecars)
 
 Invariants:
 
@@ -77,6 +78,12 @@ class CAStore:
         self.durability = durability
         self.upload_dir = os.path.join(root, "upload")
         self.cache_dir = os.path.join(root, "cache")
+        # Corrupt blobs are MOVED here, never deleted: an operator can
+        # post-mortem the damaged bytes (store/scrub.py, store/recovery.py).
+        # Deliberately outside cache/: quarantined files are invisible to
+        # list_cache_digests and eviction, but still counted by
+        # disk_usage_bytes (they occupy real disk under the watermarks).
+        self.quarantine_dir = os.path.join(root, "quarantine")
         os.makedirs(self.upload_dir, exist_ok=True)
         os.makedirs(self.cache_dir, exist_ok=True)
         self._lock = threading.Lock()
@@ -293,6 +300,60 @@ class CAStore:
                 with contextlib.suppress(FileNotFoundError):
                     os.unlink(md)
 
+    # -- quarantine (self-healing plane: scrub + fsck) ---------------------
+
+    def quarantine_path(self, d: Digest) -> str:
+        return os.path.join(self.quarantine_dir, d.hex)
+
+    def quarantine_cache_file(self, d: Digest) -> Optional[str]:
+        """Move a corrupt blob and its metadata sidecars into
+        ``quarantine/`` -- NEVER silent deletion: operators post-mortem
+        the damaged bytes (docs/OPERATIONS.md runbook). The move drops the
+        blob from the cache tree, so ``in_cache`` turns False and every
+        sidecar-derived state (piece status, torrent meta, dedup sketch)
+        goes with it. Returns the quarantine path, or None when the blob
+        raced away (evicted/deleted) before the move. Re-quarantining the
+        same digest overwrites the previous capture -- same claimed
+        content, and the newest damage is the one worth keeping."""
+        src = self.cache_path(d)
+        with self._lock:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            dst = self.quarantine_path(d)
+            try:
+                os.replace(src, dst)
+            except FileNotFoundError:
+                return None
+            for md in self._metadata_paths(src):
+                with contextlib.suppress(FileNotFoundError):
+                    os.replace(
+                        md,
+                        os.path.join(
+                            self.quarantine_dir, os.path.basename(md)
+                        ),
+                    )
+            return dst
+
+    def verify_cache_file(self, d: Digest) -> bool:
+        """True iff the cached bytes re-hash to ``d`` -- the ONE place
+        the CAS verification invariant lives for at-rest checks (fsck
+        crash-window verify, heal's cached-copy check). Missing or
+        unreadable (EIO on a failed sector) both read as 'not a healthy
+        copy': callers treat unreadable as at-rest damage, never as an
+        excuse to abort or to trust the bytes."""
+        try:
+            with open(self.cache_path(d), "rb") as f:
+                return Digest.from_reader(f) == d
+        except OSError:
+            return False
+
+    def list_quarantined(self) -> list[str]:
+        """Hex digests currently held in quarantine (operator surface)."""
+        try:
+            names = os.listdir(self.quarantine_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n for n in names if len(n) == 64 and "._md_" not in n)
+
     # -- metadata ----------------------------------------------------------
 
     def _md_path(self, data_path: str, name: str) -> str:
@@ -331,11 +392,17 @@ class CAStore:
     # -- maintenance -------------------------------------------------------
 
     def disk_usage_bytes(self) -> int:
+        """Bytes the store holds on disk: the cache tree PLUS quarantine.
+        Quarantined blobs are invisible to eviction (they are evidence,
+        cleaned by operators), but they are real disk -- excluding them
+        would let watermark math believe there is headroom while the
+        volume fills toward ENOSPC."""
         total = 0
-        for dirpath, _dirnames, filenames in os.walk(self.cache_dir):
-            for name in filenames:
-                with contextlib.suppress(FileNotFoundError):
-                    total += os.path.getsize(os.path.join(dirpath, name))
+        for root in (self.cache_dir, self.quarantine_dir):
+            for dirpath, _dirnames, filenames in os.walk(root):
+                for name in filenames:
+                    with contextlib.suppress(FileNotFoundError):
+                        total += os.path.getsize(os.path.join(dirpath, name))
         return total
 
     def wipe(self) -> None:

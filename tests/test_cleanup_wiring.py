@@ -34,10 +34,14 @@ async def _drive_origin_eviction(tmp_path):
 
     # Start with pressure OFF (huge watermark): the sweep loop runs from
     # the beginning, but eviction must not race the setup below.
+    # tti_seconds=0 genuinely DISABLES idle eviction (a positive TTI
+    # would race: the setup backdates every blob's mtime to the epoch,
+    # so the 0.1 s sweep could idle-evict `recent` in the window between
+    # the utime and the HTTP GET that re-touches it).
     node = OriginNode(
         store_root=str(tmp_path / "o"),
         cleanup=CleanupConfig(
-            tti_seconds=3600,  # no idle eviction in this test
+            tti_seconds=0,  # no idle eviction in this test
             high_watermark_bytes=1 << 40,
             low_watermark_bytes=1 << 40,
             interval_seconds=0.1,
@@ -70,7 +74,7 @@ async def _drive_origin_eviction(tmp_path):
         # aged, unpinned blobs (b2, b3) and stop at the low watermark,
         # sparing the pinned and the recently-read blob.
         node.cleanup.config = CleanupConfig(
-            tti_seconds=3600,
+            tti_seconds=0,
             high_watermark_bytes=350_000,
             low_watermark_bytes=250_000,
             interval_seconds=0.1,

@@ -25,7 +25,8 @@ CLI_KEYS = {
     "registry_port", "build_index", "spool", "remotes", "dedup_index",
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
-    "registry_strict_accept", "failpoints",
+    "registry_strict_accept", "failpoints", "scrub", "fsck",
+    "task_timeout_seconds",
 }
 
 
@@ -65,6 +66,27 @@ def test_cleanup_watermarks_ordered():
         if not cl:
             continue
         assert cl["low_watermark_bytes"] < cl["high_watermark_bytes"], path
+
+
+def test_scrub_sections_construct_scrub_config():
+    """Every shipped `scrub:` section must map 1:1 onto ScrubConfig
+    kwargs -- the CLI constructs it with ScrubConfig(**section), so a
+    typo'd knob is a boot-time TypeError in production."""
+    import dataclasses
+
+    from kraken_tpu.store.scrub import ScrubConfig
+
+    fields = {f.name for f in dataclasses.fields(ScrubConfig)}
+    seen = 0
+    for comp, path in _component_files():
+        sc = load_config(path).get("scrub")
+        if not sc:
+            continue
+        assert set(sc) <= fields, f"{path}: unknown scrub keys {set(sc) - fields}"
+        cfg = ScrubConfig(**sc)
+        assert cfg.bytes_per_second >= 0 and cfg.interval_seconds > 0, path
+        seen += 1
+    assert seen >= 2  # origin + agent ship scrub enabled
 
 
 def test_cli_keys_match_cli_source():

@@ -12,6 +12,7 @@ protocol and the tracker metainfo proxy.
 
 import asyncio
 import os
+import time
 
 import pytest
 
@@ -307,9 +308,18 @@ def test_origin_restart_skips_corrupt_blob(tmp_path):
             with open(reborn.store.cache_path(d), "r+b") as f:
                 f.seek(1000)
                 f.write(b"\x00" * 64)  # corrupt in place
+            # Model true bit-rot: damage without an mtime bump. (A fresh
+            # mtime past the clean-shutdown stamp is the CRASH-WINDOW
+            # case, which startup fsck now quarantines before reseed ever
+            # sees the blob -- covered in tests/test_recovery.py; here we
+            # prove the reseed path's own verify still refuses to serve
+            # rot that fsck's stamp heuristic cannot see.)
+            old = time.time() - 3600
+            os.utime(reborn.store.cache_path(d), (old, old))
             await reborn.start()
             origins[0] = reborn
 
+            assert reborn.fsck_report is not None and not reborn.fsck_report.quarantined
             assert reborn._reseed_task is not None
             await reborn._reseed_task
             # Skipped: no regenerated sidecar, not seeded.

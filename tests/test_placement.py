@@ -143,3 +143,41 @@ def test_active_monitor_thresholds():
         assert mon.healthy("h")  # pass_threshold 1
 
     asyncio.run(main())
+
+
+def test_passive_filter_prune_drops_departed_hosts():
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=1000)
+    pf.failed("gone:1")
+    pf.failed("stays:1")
+    assert not pf.healthy("gone:1") and not pf.healthy("stays:1")
+    dropped = pf.prune(["stays:1", "new:1"])
+    assert dropped == 1
+    # The departed host's verdict is forgotten: if its address is reused
+    # by a fresh node, it starts healthy...
+    assert pf.healthy("gone:1")
+    # ...while hosts still in the list keep their state.
+    assert not pf.healthy("stays:1")
+    # Bounded under churn: repeated prune against the live set never
+    # leaves entries for hosts outside it.
+    for i in range(50):
+        pf.failed(f"pod-{i}:1")
+    pf.prune(["stays:1"])
+    assert set(pf._fails) == {"stays:1"}
+
+
+def test_active_monitor_prune_drops_departed_hosts():
+    async def main():
+        health = {"a:1": False, "b:1": True}
+
+        async def probe(h):
+            return health.get(h, True)
+
+        mon = ActiveMonitor(probe, fail_threshold=1)
+        await mon.check_all(["a:1", "b:1"])
+        assert not mon.healthy("a:1") and mon.healthy("b:1")
+        assert mon.prune(["b:1"]) == 1
+        assert set(mon._state) == {"b:1"}
+        # A reused address starts at the healthy default.
+        assert mon.healthy("a:1")
+
+    asyncio.run(main())
