@@ -34,7 +34,10 @@ def _bits_to_set(bits: bytes, num_pieces: int) -> set[int]:
 
 
 class _Peer:
-    __slots__ = ("conn", "has", "pump", "complete", "last_useful", "serving")
+    __slots__ = (
+        "conn", "has", "pump", "complete", "last_useful", "serving",
+        "receiving",
+    )
 
     def __init__(self, conn: Conn, has: set[int], now: float):
         self.conn = conn
@@ -47,6 +50,7 @@ class _Peer:
         # crowds (everyone else is soft-blacklisted waiting for a slot).
         self.last_useful = now
         self.serving = 0  # concurrent _serve_piece tasks (flood bound)
+        self.receiving = 0  # concurrent payload tasks (inbound flood bound)
 
 
 class Dispatcher:
@@ -71,7 +75,12 @@ class Dispatcher:
         self._on_peer_failure = on_peer_failure or (lambda p, r: None)
         self._peers: dict[PeerID, _Peer] = {}
         self._io_tasks: set[asyncio.Task] = set()
-        self.done: asyncio.Future[None] = asyncio.get_event_loop().create_future()
+        # get_running_loop, not the deprecated get_event_loop: under a
+        # non-running loop on 3.12+ the latter raises (and before that
+        # could bind the future to a loop the scheduler never runs).
+        self.done: asyncio.Future[None] = (
+            asyncio.get_running_loop().create_future()
+        )
         if torrent.complete():
             self.done.set_result(None)
 
@@ -101,6 +110,13 @@ class Dispatcher:
             return False
         peer = _Peer(conn, has, asyncio.get_running_loop().time())
         self._peers[conn.peer_id] = peer
+        if hasattr(conn, "set_payload_handler"):
+            # Hot-path: the conn's recv loop hands PIECE_PAYLOAD frames
+            # here synchronously, bypassing the recv queue + pump await
+            # for the one type that carries the bytes.
+            conn.set_payload_handler(
+                lambda msg: self._handle_payload_direct(peer, msg)
+            )
         peer.pump = asyncio.create_task(self._pump(peer))
         return True
 
@@ -193,7 +209,19 @@ class Dispatcher:
     def _fail_peer(self, pid: PeerID, exc: BaseException) -> None:
         """One exception->drop policy for the pump AND the io tasks."""
         if isinstance(exc, ConnClosedError):
-            self._drop_peer(pid)
+            # A conn that closed itself over misbehavior (oversize
+            # payload, protocol garbage flagged by the wire) must reach
+            # the blacklist with its recorded reason -- a reasonless drop
+            # here would let the offender redial immediately.
+            peer = self._peers.get(pid)
+            if peer is not None and getattr(peer.conn, "misbehavior", False):
+                self._drop_peer(
+                    pid,
+                    f"conn misbehavior: "
+                    f"{getattr(peer.conn, 'close_reason', 'unknown')}",
+                )
+            else:
+                self._drop_peer(pid)
         elif isinstance(exc, PieceError):
             self._drop_peer(pid, f"bad piece: {exc}")
         else:
@@ -220,6 +248,53 @@ class Dispatcher:
 
         t.add_done_callback(release)
 
+    def _handle_payload_direct(self, peer: _Peer, msg: Message) -> None:
+        """PIECE_PAYLOAD entry called synchronously from the conn's recv
+        loop (the hot-type bypass). MUST NOT await -- it runs inside the
+        recv pump. Owns ``msg``'s pooled buffer from here on."""
+        if self._peers.get(peer.conn.peer_id) is not peer:
+            msg.release()  # raced a drop: nobody else will return it
+            return
+        peer.last_useful = asyncio.get_running_loop().time()
+        self._spawn_payload(peer, msg)
+
+    _MAX_RECEIVING_PER_PEER = 64  # concurrent payload tasks per conn: the
+    # inbound mirror of _MAX_SERVING_PER_PEER. Each admitted payload holds
+    # a piece-sized pool lease until verify+write complete, and the hot-
+    # path bypass never blocks on the recv queue -- so a hostile peer
+    # pushing UNSOLICITED payloads faster than the disk drains them would
+    # otherwise grow leases without bound (the pool budget caps FREE
+    # bytes, not live leases). Honest peers cannot reach this: their
+    # in-flight payloads are request-gated at pipeline_limit (16) plus
+    # bounded endgame duplicates. Over-cap frames are shed (released,
+    # dropped) -- no progress for the flooder, no RSS growth for us.
+
+    def _spawn_payload(self, peer: _Peer, msg: Message) -> None:
+        """Spawn the verify->write handler for one payload frame with the
+        ONE release point for its pooled buffer: the task done-callback
+        fires on completion, failure, AND cancellation-before-first-step,
+        so no path (corrupt-piece ban, mid-transfer disconnect, teardown)
+        can leak the lease. Admission is accounted SYNCHRONOUSLY (same
+        rationale as _admit_serve: buffered frames arrive without
+        yielding to the loop)."""
+        try:
+            idx = self._check_index(msg)
+        except PieceError as e:
+            msg.release()
+            self._fail_peer(peer.conn.peer_id, e)
+            return
+        if peer.receiving >= self._MAX_RECEIVING_PER_PEER:
+            msg.release()
+            return
+        peer.receiving += 1
+        t = self._spawn_io(peer, self._on_payload(peer, idx, msg))
+
+        def release(_task: asyncio.Task) -> None:
+            peer.receiving -= 1
+            msg.release()
+
+        t.add_done_callback(release)
+
     async def _serve_piece(self, peer: _Peer, idx: int) -> None:
         data = await self.torrent.read_piece_async(idx)
         await peer.conn.send(Message.piece_payload(idx, data))
@@ -241,9 +316,9 @@ class Dispatcher:
             ):
                 self._admit_serve(peer, idx)
         elif msg.type == MsgType.PIECE_PAYLOAD:
-            self._spawn_io(
-                peer, self._on_payload(peer, self._check_index(msg), msg.payload)
-            )
+            # Cold path: payloads that queued before the fast-path handler
+            # was registered (or in unit tests driving _handle directly).
+            self._spawn_payload(peer, msg)
         elif msg.type == MsgType.ANNOUNCE_PIECE:
             peer.has.add(self._check_index(msg))
             self._spawn_io(peer, self._request_more(peer))
@@ -259,7 +334,10 @@ class Dispatcher:
         elif msg.type == MsgType.ERROR:
             raise ConnClosedError(msg.header.get("detail", "peer error"))
 
-    async def _on_payload(self, peer: _Peer, idx: int, data: bytes) -> None:
+    async def _on_payload(self, peer: _Peer, idx: int, msg: Message) -> None:
+        data = msg.payload  # bytes or a pooled memoryview -- both flow
+        # through verify and os.pwrite untouched; the buffer returns via
+        # _spawn_payload's done-callback AFTER the bitfield mark below.
         self.events.emit(
             "receive_piece", self.torrent.info_hash.hex,
             peer=peer.conn.peer_id.hex, piece=idx, size=len(data),

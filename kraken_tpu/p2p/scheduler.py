@@ -41,6 +41,7 @@ from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
+from kraken_tpu.utils.bufpool import BufferPool
 from kraken_tpu.utils.dedup import RequestCoalescer
 from kraken_tpu.utils.metrics import FailureMeter
 
@@ -91,6 +92,8 @@ class SchedulerConfig:
         piece_pipeline_limit: int = 16,
         piece_timeout_seconds: float = 8.0,
         conn_churn_idle_seconds: float = 4.0,
+        wire_send_batch: int = 16,
+        bufpool_budget_mb: int = 256,
     ):
         self.announce_interval = announce_interval_seconds
         self.dial_timeout = dial_timeout_seconds
@@ -115,6 +118,11 @@ class SchedulerConfig:
         self.piece_pipeline_limit = piece_pipeline_limit
         self.piece_timeout = piece_timeout_seconds
         self.conn_churn_idle = conn_churn_idle_seconds
+        # Wire-plane knobs (round 7, docs/OPERATIONS.md "Wire plane"):
+        # max frames corked into one vectored send per drain(), and the
+        # recv payload pool's retained-byte budget.
+        self.wire_send_batch = wire_send_batch
+        self.bufpool_budget_mb = bufpool_budget_mb
 
     @classmethod
     def from_dict(cls, doc: dict) -> "SchedulerConfig":
@@ -196,6 +204,12 @@ class Scheduler:
         # watermark eviction sweep unseeds many blobs back to back.
         self._digest_to_hash: dict[Digest, InfoHash] = {}
         self._coalescer: RequestCoalescer = RequestCoalescer()
+        # One payload pool per scheduler, shared by every conn: the piece
+        # pipeline bounds concurrent leases, the budget bounds retained
+        # free bytes (utils/bufpool.py).
+        self._bufpool = BufferPool(
+            budget_bytes=self.config.bufpool_budget_mb << 20
+        )
         self._server: Optional[asyncio.base_events.Server] = None
         self._announce_queue = AnnounceQueue()
         self._announce_pump_task: Optional[asyncio.Task] = None
@@ -211,6 +225,7 @@ class Scheduler:
         values). No torrent state is dropped."""
         self.config = config
         self.conn_state.reconfigure(config.conn_state)
+        self._bufpool.set_budget(config.bufpool_budget_mb << 20)
         _log.info("scheduler config reloaded")
 
     async def start(self) -> None:
@@ -493,7 +508,16 @@ class Scheduler:
         theirs: HandshakeResult,
     ) -> None:
         h = ctl.torrent.info_hash
-        conn = Conn(reader, writer, theirs.peer_id, h, bandwidth=self.bandwidth)
+        conn = Conn(
+            reader, writer, theirs.peer_id, h,
+            bandwidth=self.bandwidth,
+            pool=self._bufpool,
+            send_batch=self.config.wire_send_batch,
+            # The handshaken metainfo's piece length bounds every payload
+            # this conn may legally carry -- anything longer is rejected
+            # before buffering and blacklists the sender.
+            max_payload_length=ctl.torrent.metainfo.piece_length,
+        )
         conn.start()
         if not ctl.dispatcher.add_conn(conn, theirs.bitfield, theirs.num_pieces):
             # Rejected (duplicate peer / bad bitfield); the dispatcher closed
@@ -511,7 +535,9 @@ class Scheduler:
             del self._conn_owners[key]
             self.conn_state.remove(*key)
             self.events.emit(
-                "drop_active_conn", key[1].hex, peer=key[0].hex
+                "drop_active_conn", key[1].hex, peer=key[0].hex,
+                reason=conn.close_reason or "",
+                detail=conn.close_detail,
             )
 
     # -- retry timer -------------------------------------------------------

@@ -64,7 +64,10 @@ class BatchedVerifier:
         self._flusher: Optional[asyncio.Task] = None
         self._inflight: set[asyncio.Task] = set()  # strong refs to hash tasks
 
-    async def verify(self, data: bytes, expected: bytes) -> bool:
+    async def verify(self, data: bytes | memoryview, expected: bytes) -> bool:
+        # ``data`` may be a pooled memoryview (zero-copy recv path): the
+        # caller keeps its lease alive until this returns, and hashlib
+        # consumes buffer-protocol objects directly.
         loop = asyncio.get_running_loop()
         fut: asyncio.Future[bool] = loop.create_future()
         self._queue.append((data, expected, fut))
@@ -105,15 +108,36 @@ class BatchedVerifier:
     async def _hash_off_loop(
         self, batch: list[tuple[bytes, bytes, asyncio.Future]]
     ) -> None:
+        # Drop abandoned entries BEFORE touching their buffers: a waiter
+        # cancelled mid-verify (torrent teardown, peer drop) releases its
+        # pooled payload buffer from the task's done-callback, and the
+        # verifier is SHARED across torrents -- hashing a released
+        # memoryview would fail the whole batch and blacklist innocent
+        # peers of unrelated torrents. A cancelled await marks its future
+        # done, so this filter removes exactly the doomed entries.
+        batch = [(d, e, f) for d, e, f in batch if not f.done()]
+        if not batch:
+            return
         try:
             digests = await asyncio.to_thread(
                 self.hasher.hash_batch, [d for d, _e, _f in batch]
             )
-        except Exception as e:
-            # A hasher failure must fail the waiters, not strand them.
-            for _d, _e2, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+        except Exception:
+            # One bad entry (e.g. a buffer released in the race window
+            # between the filter above and the hash) must not fail its
+            # batch-mates: retry per item, failing only what individually
+            # fails.
+            for d, expected, fut in batch:
+                if fut.done():
+                    continue
+                try:
+                    got = await asyncio.to_thread(
+                        self.hasher.hash_batch, [d]
+                    )
+                    fut.set_result(bytes(got[0]) == expected)
+                except Exception as e:
+                    if not fut.done():
+                        fut.set_exception(e)
             return
         for (d, expected, fut), got in zip(batch, digests):
             if not fut.done():
@@ -314,11 +338,13 @@ class Torrent:
             raise PieceError(f"short read on piece {i}")
         return data
 
-    async def write_piece(self, i: int, data: bytes) -> bool:
+    async def write_piece(self, i: int, data: bytes | memoryview) -> bool:
         """Verify + persist piece ``i``. Returns True when this write
         completed the torrent. Raises :class:`PieceError` on corrupt data
         (callers blacklist the sender). File IO runs off-loop so a disk
-        stall can't freeze the scheduler."""
+        stall can't freeze the scheduler. ``data`` may be a pooled
+        memoryview flowing straight from the wire to ``os.pwrite`` --
+        the caller releases its lease only after this returns."""
         if self._status is None:
             # With endgame duplication a second copy of the final piece
             # can arrive after completion: a benign duplicate, never a

@@ -12,18 +12,39 @@ Frame layout (all ints big-endian):
     u8  type | u32 header_len | u32 payload_len | header | payload
 
 Handshake exchange happens first on every conn, as HANDSHAKE frames.
+
+Zero-copy recv (round 7): with a :class:`~kraken_tpu.utils.bufpool.
+BufferPool`, PIECE_PAYLOAD bytes are read straight into a leased,
+recycled buffer -- no per-piece payload allocation and no
+``raw[header_len:]`` slice copy -- and ``Message.payload`` is a writable
+``memoryview`` that flows through verify and ``os.pwrite`` untouched.
+The lease rides on ``Message.lease``; whoever consumes the payload calls
+:meth:`Message.release` exactly once (idempotent) after the last read.
+
+Corked vectored send: :func:`send_messages` writes a whole batch of
+frames with ONE ``drain()`` -- control frames coalesce into a single
+``writelines`` buffer, payloads are appended without an extra copy --
+so the send loop pays the event-loop future machinery per batch, not
+per frame.
 """
 
 from __future__ import annotations
 
 import asyncio
 import enum
-from typing import Any
+from typing import Any, Iterable, Optional
 
 import msgpack
 
+from kraken_tpu.utils.bufpool import BufferPool, Lease
+
 MAX_HEADER = 1 << 20
 MAX_PAYLOAD = 1 << 26  # 64 MiB -- piece length upper bound
+
+# Control frames below this ride in the coalesced writelines buffer (one
+# small concat beats N transport appends); payloads at or above it are
+# handed to the transport as-is, avoiding a batch-sized join copy.
+_COALESCE_CUTOFF = 16 << 10
 
 
 class MsgType(enum.IntEnum):
@@ -41,15 +62,42 @@ class WireError(Exception):
     pass
 
 
+class PayloadOversizeError(WireError):
+    """A PIECE_PAYLOAD frame longer than the handshaken torrent's piece
+    length (or the absolute MAX_PAYLOAD cap). Raised BEFORE the payload
+    is buffered, so a hostile peer cannot balloon RSS; the conn plane
+    treats it as misbehavior (escalating blacklist), not connectivity."""
+
+
 class Message:
-    """One protocol frame: typed header dict + optional raw payload."""
+    """One protocol frame: typed header dict + optional raw payload.
 
-    __slots__ = ("type", "header", "payload")
+    ``payload`` is ``bytes`` for control frames and (on the pooled recv
+    path) a ``memoryview`` into a leased buffer for PIECE_PAYLOAD;
+    ``release()`` returns that buffer to its pool and is a no-op for
+    unpooled messages, so consumers call it unconditionally."""
 
-    def __init__(self, type: MsgType, header: dict | None = None, payload: bytes = b""):
+    __slots__ = ("type", "header", "payload", "lease")
+
+    def __init__(
+        self,
+        type: MsgType,
+        header: dict | None = None,
+        payload: bytes | memoryview = b"",
+        lease: Optional[Lease] = None,
+    ):
         self.type = type
         self.header = header or {}
         self.payload = payload
+        self.lease = lease
+
+    def release(self) -> None:
+        lease, self.lease = self.lease, None
+        if lease is not None:
+            # The view dies with the lease; drop our reference first so a
+            # late reader gets b"" length math, not a released-view error.
+            self.payload = b""
+            lease.release()
 
     def __repr__(self) -> str:
         return f"Message({self.type.name}, {self.header}, payload={len(self.payload)}B)"
@@ -105,20 +153,102 @@ class Message:
         return cls(MsgType.ERROR, {"code": code, "detail": detail})
 
 
-async def send_message(writer: asyncio.StreamWriter, msg: Message) -> None:
-    header = msgpack.packb(msg.header)
-    writer.write(
+def _head(msg: Message, header: bytes) -> bytes:
+    return (
         bytes([msg.type])
         + len(header).to_bytes(4, "big")
         + len(msg.payload).to_bytes(4, "big")
+        + header
     )
-    writer.write(header)
-    if msg.payload:
-        writer.write(msg.payload)
+
+
+async def send_messages(
+    writer: asyncio.StreamWriter, msgs: Iterable[Message]
+) -> None:
+    """Write every frame in ``msgs`` and drain ONCE.
+
+    Small frames (prefix+header, control payloads) collect into one
+    ``writelines`` call -- a single transport append for the whole run of
+    control traffic riding a payload batch. Piece payloads are written
+    as-is: the transport buffers the existing bytes/memoryview, so the
+    batch costs zero payload copies on this side of the socket.
+    """
+    small: list[bytes] = []
+    for msg in msgs:
+        header = msgpack.packb(msg.header)
+        small.append(_head(msg, header))
+        payload = msg.payload
+        if payload:
+            if len(payload) < _COALESCE_CUTOFF:
+                small.append(bytes(payload))
+            else:
+                if small:
+                    writer.writelines(small)
+                    small = []
+                writer.write(payload)
+    if small:
+        writer.writelines(small)
     await writer.drain()
 
 
-async def recv_message(reader: asyncio.StreamReader) -> Message:
+async def send_message(writer: asyncio.StreamWriter, msg: Message) -> None:
+    await send_messages(writer, (msg,))
+
+
+async def _readinto_exactly(
+    reader: asyncio.StreamReader, view: memoryview
+) -> None:
+    """``readexactly`` into a caller-owned buffer.
+
+    asyncio's StreamReader has no public readinto, and ``readexactly``
+    materializes a fresh payload-sized ``bytes`` per call -- the exact
+    per-piece allocation the bufpool exists to remove. This drains the
+    reader's internal buffer straight into ``view`` using the same
+    private fields ``readexactly`` itself uses (``_buffer``, ``_eof``,
+    ``_wait_for_data``, ``_maybe_resume_transport`` -- stable across
+    CPython 3.8-3.12); if an exotic reader lacks them we fall back to
+    readexactly + copy (correct, one transient allocation).
+    """
+    n = len(view)
+    if not (
+        hasattr(reader, "_buffer")
+        and hasattr(reader, "_eof")
+        and hasattr(reader, "_wait_for_data")
+        and hasattr(reader, "_maybe_resume_transport")
+    ):  # pragma: no cover - non-CPython readers
+        view[:] = await reader.readexactly(n)
+        return
+    pos = 0
+    while pos < n:
+        exc = reader.exception()
+        if exc is not None:
+            raise exc
+        if reader._buffer:
+            take = min(len(reader._buffer), n - pos)
+            with memoryview(reader._buffer) as mv:
+                view[pos : pos + take] = mv[:take]
+            del reader._buffer[:take]
+            reader._maybe_resume_transport()
+            pos += take
+        elif reader._eof:
+            raise asyncio.IncompleteReadError(bytes(view[:pos]), n)
+        else:
+            await reader._wait_for_data("_readinto_exactly")
+
+
+async def recv_message(
+    reader: asyncio.StreamReader,
+    pool: Optional[BufferPool] = None,
+    max_payload: int = MAX_PAYLOAD,
+) -> Message:
+    """Read one frame. With ``pool``, PIECE_PAYLOAD bytes land in a
+    leased buffer (``Message.payload`` is a memoryview, ``Message.lease``
+    owns the return); without, behavior matches the classic bytes path.
+
+    ``max_payload`` tightens the PIECE_PAYLOAD bound to the handshaken
+    torrent's piece length; violations raise :class:`PayloadOversizeError`
+    BEFORE any payload byte is buffered.
+    """
     try:
         prefix = await reader.readexactly(9)
     except asyncio.IncompleteReadError as e:
@@ -126,18 +256,23 @@ async def recv_message(reader: asyncio.StreamReader) -> Message:
     mtype = prefix[0]
     header_len = int.from_bytes(prefix[1:5], "big")
     payload_len = int.from_bytes(prefix[5:9], "big")
-    if header_len > MAX_HEADER or payload_len > MAX_PAYLOAD:
-        raise WireError(f"oversized frame: header={header_len} payload={payload_len}")
     try:
         t = MsgType(mtype)
     except ValueError:
         raise WireError(f"unknown message type {mtype}") from None
+    if t == MsgType.PIECE_PAYLOAD and payload_len > min(max_payload, MAX_PAYLOAD):
+        raise PayloadOversizeError(
+            f"piece payload {payload_len} exceeds limit "
+            f"{min(max_payload, MAX_PAYLOAD)}"
+        )
+    if header_len > MAX_HEADER or payload_len > MAX_PAYLOAD:
+        raise WireError(f"oversized frame: header={header_len} payload={payload_len}")
     try:
-        raw = await reader.readexactly(header_len + payload_len)
+        raw_header = await reader.readexactly(header_len) if header_len else b""
     except asyncio.IncompleteReadError as e:
         raise WireError("connection closed mid-frame") from e
     try:
-        header: Any = msgpack.unpackb(raw[:header_len]) if header_len else {}
+        header: Any = msgpack.unpackb(raw_header) if header_len else {}
     except Exception as e:
         # msgpack surfaces corruption as several exception types (its own
         # unpack errors, UnicodeDecodeError for non-utf8 raw strings,
@@ -146,4 +281,23 @@ async def recv_message(reader: asyncio.StreamReader) -> Message:
         raise WireError(f"malformed header: {e}") from e
     if not isinstance(header, dict):
         raise WireError("malformed header")
-    return Message(t, header, raw[header_len:])
+    lease: Optional[Lease] = None
+    if payload_len == 0:
+        payload: bytes | memoryview = b""
+    elif pool is not None and t == MsgType.PIECE_PAYLOAD:
+        lease = pool.lease(payload_len)
+        try:
+            await _readinto_exactly(reader, lease.view)
+        except asyncio.IncompleteReadError as e:
+            lease.release()
+            raise WireError("connection closed mid-frame") from e
+        except BaseException:
+            lease.release()
+            raise
+        payload = lease.view
+    else:
+        try:
+            payload = await reader.readexactly(payload_len)
+        except asyncio.IncompleteReadError as e:
+            raise WireError("connection closed mid-frame") from e
+    return Message(t, header, payload, lease=lease)

@@ -89,6 +89,25 @@ def test_scrub_sections_construct_scrub_config():
     assert seen >= 2  # origin + agent ship scrub enabled
 
 
+def test_scheduler_sections_construct_scheduler_config():
+    """Every shipped `scheduler:` section (wire_send_batch,
+    bufpool_budget_mb, pacing knobs...) must map onto SchedulerConfig
+    kwargs through the same from_dict the CLI/assembly use -- a typo'd
+    wire knob must fail here, not at production boot."""
+    from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        sc = load_config(path).get("scheduler")
+        if not sc:
+            continue
+        cfg = SchedulerConfig.from_dict(sc)  # raises on unknown keys
+        assert cfg.wire_send_batch >= 1, path
+        assert cfg.bufpool_budget_mb >= 0, path
+        seen += 1
+    assert seen >= 2  # origin + agent ship the wire-plane knobs
+
+
 def test_cli_keys_match_cli_source():
     """CLI_KEYS drifts too: every key this test whitelists must actually
     appear in cli.py, so deleting a knob there fails here."""

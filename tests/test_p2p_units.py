@@ -302,9 +302,12 @@ def test_scheduler_config_from_dict_and_reload():
 
     from kraken_tpu.p2p.scheduler import Scheduler
 
+    from kraken_tpu.utils.bufpool import BufferPool
+
     sched = Scheduler.__new__(Scheduler)  # no IO: just the reload surface
     sched.config = SchedulerConfig()
     sched.conn_state = state
+    sched._bufpool = BufferPool()
     sched.reload(cfg)
     assert sched.config.piece_pipeline_limit == 4
     assert state.config.max_global_conns == 9
@@ -348,6 +351,9 @@ def test_wire_fuzz_corrupt_frames_raise_wireerror():
                 self.buf = bytearray()
             def write(self, b):
                 self.buf += b
+            def writelines(self, bufs):
+                for b in bufs:
+                    self.buf += b
             async def drain(self):
                 pass
 
