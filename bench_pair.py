@@ -221,6 +221,95 @@ def run_alloc_sample(pieces: int = 16, piece_kb: int = 256) -> dict:
     }
 
 
+def run_brownout(hedge_delay_s: float = 0.1, slow_s: float = 0.5,
+                 reads: int = 40, blob_kb: int = 256) -> dict:
+    """Brown-out row (round 8, overload & degradation plane): two origin
+    read endpoints behind a hedged ClusterClient, with the ring PRIMARY
+    stalling ``slow_s`` per request (slow-but-alive). Reports read p50/
+    p99 and the hedge win rate -- the honesty number for the "a brown-out
+    costs tail latency, not availability" claim. Without hedging every
+    read would eat the full ``slow_s``; with it, p99 should sit near
+    ``hedge_delay_s`` + healthy service time."""
+    from aiohttp import web
+
+    from kraken_tpu.origin.client import BlobClient, ClusterClient
+    from kraken_tpu.placement import HostList, Ring
+    from kraken_tpu.utils.httputil import HTTPClient
+    from kraken_tpu.utils.metrics import REGISTRY
+
+    body = os.urandom(blob_kb << 10)
+
+    async def sample():
+        async def make_server(delay: float):
+            async def blob(req):
+                if delay:
+                    await asyncio.sleep(delay)
+                return web.Response(body=body)
+
+            app = web.Application()
+            app.router.add_get("/namespace/{ns}/blobs/{d}", blob)
+            runner = web.AppRunner(
+                app, handler_cancellation=True, shutdown_timeout=0.1
+            )
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            return runner, f"127.0.0.1:{runner.addresses[0][1]}"
+
+        slow_runner, slow_addr = await make_server(slow_s)
+        fast_runner, fast_addr = await make_server(0.0)
+        ring = Ring(HostList(static=[slow_addr, fast_addr]), max_replica=2)
+        cluster = ClusterClient(
+            ring,
+            client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+            hedge_delay_seconds=hedge_delay_s,
+            component="bench-brownout",
+        )
+        hedges = REGISTRY.counter("rpc_hedges_total")
+        wins = REGISTRY.counter("rpc_hedge_wins_total")
+        h0 = hedges.value(op="download")
+        w0 = wins.value(op="download")
+        lat = []
+        try:
+            i = 0
+            done = 0
+            while done < reads:
+                from kraken_tpu.core.digest import Digest
+
+                d = Digest.from_bytes(f"brownout-{i}".encode())
+                i += 1
+                if ring.locations(d)[0] != slow_addr:
+                    continue  # only reads whose primary is browned out
+                t0 = time.perf_counter()
+                got = await cluster.download(NS_BROWNOUT, d)
+                lat.append(time.perf_counter() - t0)
+                assert got == body
+                done += 1
+        finally:
+            await cluster.close()
+            await slow_runner.cleanup()
+            await fast_runner.cleanup()
+        launched = hedges.value(op="download") - h0
+        won = wins.value(op="download") - w0
+        return lat, launched, won
+
+    lat, launched, won = asyncio.run(sample())
+    lat.sort()
+    return {
+        "metric": "brownout_hedge",
+        "reads": reads,
+        "slow_s": slow_s,
+        "hedge_delay_s": hedge_delay_s,
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 1),
+        "hedges_launched": launched,
+        "hedge_win_rate": round(won / launched, 3) if launched else None,
+    }
+
+
+NS_BROWNOUT = "bench-brownout"
+
+
 def _run_repeats(args, knockout: bool) -> list[dict]:
     results = []
     for _ in range(args.repeats):
@@ -270,6 +359,8 @@ def main() -> None:
                     help="skip the pump_ceiling_mbps (all-knockout) rows")
     ap.add_argument("--skip-alloc", action="store_true",
                     help="skip the tracemalloc recv_alloc_per_piece sample")
+    ap.add_argument("--skip-brownout", action="store_true",
+                    help="skip the hedged-read brown-out row")
     args = ap.parse_args()
 
     _summarize("pair_goodput_mbps", _run_repeats(args, knockout=False))
@@ -277,6 +368,8 @@ def main() -> None:
         _summarize("pump_ceiling_mbps", _run_repeats(args, knockout=True))
     if not args.skip_alloc:
         print(json.dumps(run_alloc_sample()))
+    if not args.skip_brownout:
+        print(json.dumps(run_brownout()))
 
 
 if __name__ == "__main__":

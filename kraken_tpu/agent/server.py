@@ -9,8 +9,9 @@ Endpoints:
     GET    /namespace/{ns}/blobs/{d}     -> downloads via swarm, streams blob
     GET    /namespace/{ns}/blobs/{d}/stat
     DELETE /blobs/{d}
-    GET    /health
+    GET    /health                       -> 503 while draining (lameduck)
     GET    /readiness                    -> 200 once the scheduler listens
+    POST   /debug/lameduck               -> enter drain mode (no exit)
 """
 
 from __future__ import annotations
@@ -23,9 +24,12 @@ from aiohttp import web
 from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.p2p.scheduler import Scheduler
 from kraken_tpu.store import CAStore
+from kraken_tpu.utils.lameduck import LameduckMixin
 
 
-class AgentServer:
+class AgentServer(LameduckMixin):
+    lameduck_component = "agent"
+
     def __init__(self, store: CAStore, scheduler: Scheduler,
                  download_timeout_seconds: float = 300.0,
                  cleanup=None):  # store.cleanup.CleanupManager (optional)
@@ -33,6 +37,12 @@ class AgentServer:
         self.scheduler = scheduler
         self.download_timeout = download_timeout_seconds
         self.cleanup = cleanup
+        # Lameduck drain (utils/lameduck.py): /health fails (so load
+        # balancers and the ring route away), NEW swarm pulls are
+        # refused with 503+Retry-After, in-flight ones finish. Entered
+        # by SIGTERM (cli) or the debug endpoint; never exited -- drain
+        # precedes stop.
+        self._inflight_downloads = 0
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -42,7 +52,13 @@ class AgentServer:
         r.add_delete("/blobs/{d}", self._delete)
         r.add_get("/health", self._health)
         r.add_get("/readiness", self._readiness)
+        self.add_lameduck_routes(r)
         return app
+
+    @property
+    def inflight_work(self) -> int:
+        """Drain quiesce signal: downloads that must be allowed to finish."""
+        return self._inflight_downloads
 
     def _digest(self, req: web.Request) -> Digest:
         try:
@@ -54,6 +70,12 @@ class AgentServer:
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         if not self.store.in_cache(d):
+            if self.lameduck:
+                # A cache MISS needs a fresh swarm pull -- new work a
+                # draining node must refuse (cache hits below still
+                # serve: they cost one sendfile and finish immediately).
+                raise self.drain_unavailable()
+            self._inflight_downloads += 1
             try:
                 await asyncio.wait_for(
                     self.scheduler.download(ns, d), self.download_timeout
@@ -62,6 +84,8 @@ class AgentServer:
                 raise web.HTTPGatewayTimeout(text="download timed out")
             except Exception as e:
                 raise web.HTTPInternalServerError(text=f"download failed: {e}")
+            finally:
+                self._inflight_downloads -= 1
         if self.cleanup is not None:
             self.cleanup.touch(d)  # feed the eviction clock (throttled)
         # sendfile from the cache: O(1) request memory for any blob size.
@@ -88,9 +112,15 @@ class AgentServer:
         return web.Response(status=204)
 
     async def _health(self, req: web.Request) -> web.Response:
+        if self.lameduck:
+            # Failing health IS the drain broadcast: load balancers,
+            # monitors, and ring peers route away without being told.
+            raise self.drain_unavailable()
         return web.Response(text="ok")
 
     async def _readiness(self, req: web.Request) -> web.Response:
+        if self.lameduck:
+            raise self.drain_unavailable()
         if self.scheduler._server is None:
             raise web.HTTPServiceUnavailable(text="scheduler not started")
         return web.Response(text="ready")

@@ -35,8 +35,9 @@ from kraken_tpu.placement import Ring
 from kraken_tpu.placement.healthcheck import ActiveMonitor
 from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
+from kraken_tpu.utils.deadline import RPCConfig
 from kraken_tpu.utils.httputil import HTTPClient, base_url
-from kraken_tpu.utils.metrics import FailureMeter, instrument_app
+from kraken_tpu.utils.metrics import REGISTRY, FailureMeter, instrument_app
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -106,6 +107,49 @@ async def _ring_refresh_loop(get_cluster, interval: float) -> None:
             _ring_refresh_failures.record("ring refresh", e)
 
 
+def _rpc_config(rpc) -> RPCConfig:
+    """Normalize the YAML ``rpc:`` section (dict) / an RPCConfig / None
+    into one RPCConfig -- every node carries the same knob shape."""
+    if isinstance(rpc, RPCConfig):
+        return rpc
+    return RPCConfig.from_dict(rpc)
+
+
+async def _drain_node(server, scheduler, timeout: float,
+                      component: str) -> None:
+    """Shared lameduck drain: enter drain mode, then wait (up to
+    ``timeout``) for in-flight work to finish -- established p2p conns
+    completing and churning out, streaming HTTP bodies landing. The
+    caller runs the normal stop() afterwards; by then the hard teardown
+    cancels nothing that mattered."""
+    if server is not None:
+        server.enter_lameduck()
+    elif scheduler is not None:
+        scheduler.enter_lameduck()
+    REGISTRY.gauge(
+        "lameduck", "1 while this node is draining (SIGTERM/debug entry)"
+    ).set(1, component=component)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        conns = scheduler.num_active_conns if scheduler is not None else 0
+        inflight = server.inflight_work if server is not None else 0
+        if conns == 0 and inflight == 0:
+            _log.info(
+                "drain quiesced", extra={"component": component}
+            )
+            return
+        await asyncio.sleep(0.05)
+    _log.warning(
+        "drain timeout: proceeding to hard stop",
+        extra={
+            "component": component,
+            "active_conns": scheduler.num_active_conns if scheduler else 0,
+            "inflight": server.inflight_work if server else 0,
+        },
+    )
+
+
 async def _serve(app: web.Application, host: str, port: int,
                  component: str = "", ssl_context=None):
     # Chaos guard: refuse to bind a listener while failpoints are armed
@@ -140,9 +184,11 @@ class TrackerNode:
                  peer_ttl_seconds: float = 30.0,
                  ring_refresh_seconds: float = 5.0,
                  redis_addr: str = "",
-                 ssl_context=None):
+                 ssl_context=None,
+                 rpc: dict | RPCConfig | None = None):
         self.host = host
         self.port = port
+        self.rpc = _rpc_config(rpc)
         # Redis-protocol store: swarm survives tracker restarts and can be
         # shared by several trackers; default in-memory store re-heals via
         # TTL instead.
@@ -173,6 +219,23 @@ class TrackerNode:
         self._refresh_task = asyncio.create_task(_ring_refresh_loop(
             lambda: self.server.origin_cluster, self.ring_refresh
         ))
+
+    def reload(self, cfg: dict) -> None:
+        """SIGHUP: apply the ``rpc:`` section to the metainfo-proxy
+        cluster client live (hedge delay, read deadline, brown-out
+        threshold on its breaker)."""
+        if cfg.get("rpc") is None:
+            return
+        self.rpc = _rpc_config(cfg["rpc"])
+        c = self.server.origin_cluster
+        if c is not None:
+            c.hedge_delay = self.rpc.hedge_delay_seconds or None
+            c.deadline_seconds = self.rpc.request_deadline_seconds
+            if c.health is not None and hasattr(c.health, "brownout_threshold"):
+                c.health.brownout_threshold = (
+                    self.rpc.brownout_threshold_seconds
+                )
+        _log.info("rpc config reloaded", extra={"node": self.addr})
 
     async def stop(self) -> None:
         if self._refresh_task:
@@ -214,6 +277,7 @@ class OriginNode:
         scrub: dict | ScrubConfig | None = None,
         fsck: bool = True,
         task_timeout_seconds: float = 1800.0,
+        rpc: dict | RPCConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -290,6 +354,9 @@ class OriginNode:
         self.scrub_config = (
             ScrubConfig(**scrub) if isinstance(scrub, dict) else scrub
         )
+        # Overload & degradation knobs (YAML `rpc:` -- deadlines, hedge
+        # delay, brown-out threshold, drain timeout; live-reloadable).
+        self.rpc = _rpc_config(rpc)
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
         self.monitor: Optional[ActiveMonitor] = None
@@ -369,7 +436,8 @@ class OriginNode:
         # The p2p scheduler seeds cached blobs; origins announce as origin
         # peers so trackers hand them out last.
         self._tracker_client = TrackerClient(
-            self.tracker_addr, peer_id, self.host, 0, is_origin=True
+            self.tracker_addr, peer_id, self.host, 0, is_origin=True,
+            announce_timeout_seconds=self.rpc.announce_timeout_seconds,
         )
         self.scheduler = Scheduler(
             peer_id=peer_id,
@@ -400,6 +468,7 @@ class OriginNode:
             # (stream-time hashlib would bypass the device); CPU origins
             # piece-hash while the bytes stream in -- no re-read.
             stream_piece_hash=self.hasher_name == "cpu",
+            rpc=self.rpc,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -484,11 +553,29 @@ class OriginNode:
         return SchedulerConfig.from_dict({**doc, "conn_state": conn})
 
     def reload(self, cfg: dict) -> None:
-        """Apply a re-read config's ``scheduler:`` section live (SIGHUP)."""
+        """Apply a re-read config's ``scheduler:`` and ``rpc:`` sections
+        live (SIGHUP)."""
         if self.scheduler is not None:
             self.scheduler.reload(
                 self.build_scheduler_config(cfg.get("scheduler"))
             )
+        if cfg.get("rpc") is not None:
+            self.apply_rpc(_rpc_config(cfg["rpc"]))
+
+    def apply_rpc(self, rpc: RPCConfig) -> None:
+        """Swap the degradation knobs live: the announce budget, the
+        drain timeout, and the heal cluster's hedge/deadline settings
+        all take effect from the next call."""
+        self.rpc = rpc
+        if self._tracker_client is not None:
+            self._tracker_client.announce_timeout = rpc.announce_timeout_seconds
+        if self.server is not None:
+            self.server.rpc = rpc
+            c = self.server._heal_cluster
+            if c is not None:
+                c.hedge_delay = rpc.hedge_delay_seconds or None
+                c.deadline_seconds = rpc.request_deadline_seconds
+        _log.info("rpc config reloaded", extra={"node": self.self_addr})
 
     async def _reseed(self, missing: list[Digest]) -> None:
         """Regenerate lost metainfo sidecars and seed the blobs (runs in
@@ -588,7 +675,26 @@ class OriginNode:
         self._repair_tasks.add(t)
         t.add_done_callback(self._repair_tasks.discard)
 
+    async def drain(self, timeout: float | None = None) -> None:
+        """Lameduck drain (SIGTERM path; docs/OPERATIONS.md runbook):
+        stop announcing, fail /health so the ring routes away, refuse
+        new uploads and p2p conns, and let in-flight pieces and upload
+        bodies finish -- up to ``drain_timeout``. Call :meth:`stop`
+        afterwards for the hard teardown."""
+        await _drain_node(
+            self.server, self.scheduler,
+            self.rpc.drain_timeout_seconds if timeout is None else timeout,
+            "origin",
+        )
+
     async def stop(self) -> None:
+        # Refusal-before-teardown, even on the non-drain path: entering
+        # lameduck first means no NEW announce fires or conn lands in
+        # the window where the teardown below is mid-flight.
+        if self.server is not None:
+            self.server.enter_lameduck()
+        elif self.scheduler is not None:
+            self.scheduler.enter_lameduck()
         if self._health_task:
             self._health_task.cancel()
         if self._cleanup_task:
@@ -755,6 +861,7 @@ class AgentNode:
         registry_strict_accept: bool = False,
         scrub: dict | ScrubConfig | None = None,
         fsck: bool = True,
+        rpc: dict | RPCConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -807,6 +914,8 @@ class AgentNode:
         self.scrub_config = (
             ScrubConfig(**scrub) if isinstance(scrub, dict) else scrub
         )
+        # Overload & degradation knobs (YAML `rpc:`; live-reloadable).
+        self.rpc = _rpc_config(rpc)
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
         self.scheduler: Optional[Scheduler] = None
@@ -863,7 +972,8 @@ class AgentNode:
         )
         peer_id = factory.create(self.host, self.p2p_port)
         self._tracker_client = TrackerClient(
-            self.tracker_addr, peer_id, self.host, 0
+            self.tracker_addr, peer_id, self.host, 0,
+            announce_timeout_seconds=self.rpc.announce_timeout_seconds,
         )
         self.scheduler = Scheduler(
             peer_id=peer_id,
@@ -916,11 +1026,34 @@ class AgentNode:
             )
 
     def reload(self, cfg: dict) -> None:
-        """Apply a re-read config's ``scheduler:`` section live (SIGHUP)."""
+        """Apply a re-read config's ``scheduler:`` and ``rpc:`` sections
+        live (SIGHUP)."""
         if self.scheduler is not None and cfg.get("scheduler") is not None:
             self.scheduler.reload(SchedulerConfig.from_dict(cfg["scheduler"]))
+        if cfg.get("rpc") is not None:
+            self.rpc = _rpc_config(cfg["rpc"])
+            if self._tracker_client is not None:
+                self._tracker_client.announce_timeout = (
+                    self.rpc.announce_timeout_seconds
+                )
+            _log.info("rpc config reloaded", extra={"node": self.addr})
+
+    async def drain(self, timeout: float | None = None) -> None:
+        """Lameduck drain (SIGTERM path): stop announcing, fail /health,
+        refuse new swarm pulls and p2p conns; in-flight downloads and
+        pieces finish up to ``drain_timeout``. :meth:`stop` follows."""
+        await _drain_node(
+            self.server, self.scheduler,
+            self.rpc.drain_timeout_seconds if timeout is None else timeout,
+            "agent",
+        )
 
     async def stop(self) -> None:
+        # Refusal-before-teardown (see OriginNode.stop).
+        if self.server is not None:
+            self.server.enter_lameduck()
+        elif self.scheduler is not None:
+            self.scheduler.enter_lameduck()
         if self._cleanup_task:
             self._cleanup_task.cancel()
         if self.scrubber:

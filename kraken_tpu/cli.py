@@ -42,6 +42,16 @@ async def _run_until_signal(node, describe: dict,
                             config_path: str | None = None) -> None:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+    # SIGTERM (orchestrated shutdown: k8s, systemd, deploy scripts) gets
+    # the lameduck drain -- stop announcing, fail /health, let in-flight
+    # pieces and uploads finish up to rpc.drain_timeout_seconds -- then
+    # the clean stop. SIGINT (an operator's ^C) stops immediately.
+    drain_requested = False
+
+    def on_sigterm() -> None:
+        nonlocal drain_requested
+        drain_requested = True
+        stop.set()
 
     def reload_config() -> None:
         # SIGHUP = re-read --config and apply what reloads live (the
@@ -59,8 +69,8 @@ async def _run_until_signal(node, describe: dict,
 
     # Handlers BEFORE the READY line: herd managers signal as soon as they
     # see it, and an unhandled SIGHUP's default action kills the process.
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+    loop.add_signal_handler(signal.SIGTERM, on_sigterm)
     loop.add_signal_handler(signal.SIGHUP, reload_config)
 
     await node.start()
@@ -72,6 +82,8 @@ async def _run_until_signal(node, describe: dict,
     # One machine-readable line so herd harnesses can scrape the bound ports.
     print("READY " + json.dumps(describe), flush=True)
     await stop.wait()
+    if drain_requested and hasattr(node, "drain"):
+        await node.drain()
     await node.stop()
 
 
@@ -414,31 +426,50 @@ def main(argv: list[str] | None = None) -> None:
     host = pick(args.host, "host", "127.0.0.1")
     port = pick(args.port, "port", 0)
 
-    def origin_cluster(origins: str | None) -> ClusterClient | None:
-        """Ring-resolved origin cluster client with passive health:
-        request failures drop an origin from the ring on its next
-        refresh."""
+    # YAML: rpc: {announce_timeout_seconds, request_deadline_seconds,
+    # hedge_delay_seconds, brownout_threshold_seconds,
+    # drain_timeout_seconds} -- the overload & degradation plane knobs
+    # (docs/OPERATIONS.md "Degradation plane"). Absent = defaults.
+    from kraken_tpu.utils.deadline import RPCConfig
+
+    rpc_cfg = RPCConfig.from_dict(cfg.get("rpc"))
+
+    def origin_cluster(origins: str | None, component: str) -> ClusterClient | None:
+        """Ring-resolved origin cluster client behind a circuit breaker:
+        request failures trip an origin out of the ring (half-open
+        probe re-admits it), a slow-but-alive origin sheds to the back
+        of the replica order, and idempotent reads hedge to the next
+        healthy replica after rpc.hedge_delay_seconds."""
         addrs = [a for a in (origins or "").split(",") if a]
         if not addrs:
             return None
-        health = PassiveFilter()
+        health = PassiveFilter(
+            brownout_threshold_seconds=rpc_cfg.brownout_threshold_seconds,
+            name=f"{component}-origin-breaker",
+        )
         return ClusterClient(
             Ring(HostList(static=addrs),
                  max_replica=cfg.get("max_replica", 3),
                  health_filter=health.filter),
             health=health,
+            hedge_delay_seconds=rpc_cfg.hedge_delay_seconds,
+            deadline_seconds=rpc_cfg.request_deadline_seconds,
+            component=component,
         )
 
     if args.component == "tracker":
-        cluster = origin_cluster(pick(args.origins, "origins", ""))
+        cluster = origin_cluster(pick(args.origins, "origins", ""), "tracker")
         node = TrackerNode(
             host=host, port=port, origin_cluster=cluster,
             announce_interval_seconds=cfg.get("announce_interval_seconds", 3.0),
             peer_ttl_seconds=cfg.get("peer_ttl_seconds", 30.0),
             redis_addr=cfg.get("peerstore_redis", ""),
             ssl_context=ssl_context,
+            rpc=rpc_cfg,
         )
-        asyncio.run(_run_until_signal(node, {"component": "tracker"}))
+        asyncio.run(
+            _run_until_signal(node, {"component": "tracker"}, args.config)
+        )
 
     elif args.component == "origin":
         backends_cfg = cfg.get("backends")
@@ -526,6 +557,7 @@ def main(argv: list[str] | None = None) -> None:
             task_timeout_seconds=float(
                 cfg.get("task_timeout_seconds", 1800.0)
             ),
+            rpc=rpc_cfg,
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -565,6 +597,7 @@ def main(argv: list[str] | None = None) -> None:
             ),
             scrub=scrub_cfg,
             fsck=fsck_enabled,
+            rpc=rpc_cfg,
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)
@@ -582,7 +615,9 @@ def main(argv: list[str] | None = None) -> None:
             port=port,
             backends=backends,
             remotes=remotes or None,
-            origin_cluster=origin_cluster(pick(args.origins, "origins", "")),
+            origin_cluster=origin_cluster(
+                pick(args.origins, "origins", ""), "build-index"
+            ),
             ssl_context=ssl_context,
             # YAML: immutable_tags: true -- a tag can never be re-pointed
             # at a different digest (same-digest re-push stays idempotent).
@@ -594,7 +629,7 @@ def main(argv: list[str] | None = None) -> None:
         asyncio.run(_run_until_signal(node, {"component": "build-index"}))
 
     elif args.component == "proxy":
-        cluster = origin_cluster(pick(args.origins, "origins", ""))
+        cluster = origin_cluster(pick(args.origins, "origins", ""), "proxy")
         if cluster is None:
             parser.error("proxy requires --origins")
         build_index = pick(args.build_index, "build_index", "")

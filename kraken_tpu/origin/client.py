@@ -9,6 +9,7 @@ unverified; SURVEY.md SS2.4.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Callable, Optional
 
 from kraken_tpu.core.digest import Digest
@@ -17,7 +18,10 @@ from kraken_tpu.core.peer import BlobInfo
 from kraken_tpu.placement.hashring import Ring
 from urllib.parse import quote
 
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
+from kraken_tpu.utils.metrics import REGISTRY
 
 _RAISE = object()  # _try_each sentinel: no default, raise on exhaustion
 
@@ -33,7 +37,8 @@ class BlobClient:
         return f"{base_url(self.addr)}{path}"
 
     async def stat(
-        self, namespace: str, d: Digest, local_only: bool = False
+        self, namespace: str, d: Digest, local_only: bool = False,
+        deadline: Deadline | None = None,
     ) -> Optional[BlobInfo]:
         """``local_only`` asks "do YOU cache the bytes" (repair semantics)
         instead of "does the cluster durably have them"."""
@@ -44,6 +49,7 @@ class BlobClient:
                     f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/stat{suffix}"
                 ),
                 retry_5xx=False,
+                deadline=deadline,
             )
         except HTTPError as e:
             if e.status == 404:
@@ -53,23 +59,31 @@ class BlobClient:
 
         return BlobInfo.from_dict(json.loads(body))
 
-    async def download(self, namespace: str, d: Digest) -> bytes:
+    async def download(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> bytes:
         return await self._http.get(
-            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}")
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"),
+            deadline=deadline,
         )
 
     async def download_to_file(
-        self, namespace: str, d: Digest, dest_path: str
+        self, namespace: str, d: Digest, dest_path: str,
+        deadline: Deadline | None = None,
     ) -> int:
         """Stream the blob to ``dest_path`` -- O(chunk) memory, any size."""
         return await self._http.get_to_file(
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"),
             dest_path,
+            deadline=deadline,
         )
 
-    async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
+    async def get_metainfo(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> MetaInfo:
         raw = await self._http.get(
-            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo")
+            self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"),
+            deadline=deadline,
         )
         return MetaInfo.deserialize(raw)
 
@@ -154,7 +168,12 @@ class BlobClient:
 class ClusterClient:
     """Routes blob ops to the replica set owning each digest.
 
-    Reads try replicas in order and fall through on failure; writes go to
+    Reads walk replicas in breaker-aware order (placement order with
+    browned-out and tripped hosts shed toward the back --
+    placement/healthcheck.py) under ONE end-to-end deadline, and
+    idempotent reads HEDGE: after ``hedge_delay_seconds`` without a
+    first answer a second attempt launches at the next healthy replica,
+    first success wins, the loser is cancelled cleanly. Writes go to
     every replica (as the reference's proxy upload does) so any one can
     serve and replicate onward.
     """
@@ -165,19 +184,29 @@ class ClusterClient:
         client_factory: Callable[[str], BlobClient] | None = None,
         health=None,  # placement.healthcheck.PassiveFilter (optional)
         exclude_addr: str = "",
+        hedge_delay_seconds: float | None = None,
+        deadline_seconds: float | None = None,
+        component: str = "cluster",
     ):
         self.ring = ring
         self._factory = client_factory or BlobClient
         self._clients: dict[str, BlobClient] = {}
-        # Every request outcome feeds the passive filter; when it is also
-        # the ring's health_filter, failing origins leave the ring on the
-        # next refresh (SURVEY.md SS5 failure detection).
+        # Every request outcome (with its latency) feeds the breaker;
+        # when it is also the ring's health_filter, failing origins leave
+        # the ring on the next refresh (SURVEY.md SS5 failure detection).
         self.health = health
         # An origin using a ClusterClient over its OWN ring (the heal
         # plane re-fetching a quarantined blob from replicas) must skip
         # itself: asking yourself for the bytes you just lost is at best
         # a wasted round-trip and at worst a read-through loop.
         self.exclude_addr = exclude_addr
+        # None/0 = hedging off (e.g. the write-mostly proxy path keeps
+        # the old serial walk). YAML rpc.hedge_delay_seconds.
+        self.hedge_delay = hedge_delay_seconds or None
+        # Default TOTAL budget applied to any read whose caller brought
+        # no deadline of its own; None keeps the legacy unbudgeted walk.
+        self.deadline_seconds = deadline_seconds
+        self.component = component
 
     def _client(self, addr: str) -> BlobClient:
         if addr not in self._clients:
@@ -185,36 +214,229 @@ class ClusterClient:
         return self._clients[addr]
 
     def clients_for(self, d: Digest) -> list[BlobClient]:
-        return [
-            self._client(a)
-            for a in self.ring.locations(d)
-            if a != self.exclude_addr
+        addrs = [
+            a for a in self.ring.locations(d) if a != self.exclude_addr
         ]
+        if self.health is not None and hasattr(self.health, "order"):
+            # Breaker-aware read order: browned-out (slow-but-alive) and
+            # tripped hosts shed to the back; placement order otherwise.
+            addrs = self.health.order(addrs)
+        return [self._client(a) for a in addrs]
 
     def _report(self, c: BlobClient, ok: bool) -> None:
         if self.health is not None:
             (self.health.succeeded if ok else self.health.failed)(c.addr)
 
-    async def _try_each(self, d: Digest, op, *, default=_RAISE):
-        """Read policy: try each replica in ring order, return the first
-        success; feed every outcome to the health filter. With all replicas
-        failed, raise the last error (or return ``default`` if given and no
-        replica errored -- i.e. the ring was empty)."""
+    def _observe(self, c: BlobClient, ok: bool, seconds: float) -> None:
+        if self.health is None:
+            return
+        if hasattr(self.health, "observe"):
+            self.health.observe(c.addr, ok, seconds)
+        else:
+            (self.health.succeeded if ok else self.health.failed)(c.addr)
+
+    def _admit(self, addr: str):
+        """Breaker request admission: True (closed), a probe token (this
+        call holds a half-open host's single probe grant), or False
+        (skip)."""
+        h = self.health
+        if h is None or not hasattr(h, "try_acquire_probe"):
+            return True
+        return h.try_acquire_probe(addr)
+
+    def _release_probe(self, addr: str, token) -> None:
+        """Return an unused probe grant (cancelled attempt). Token-
+        matched: a stale release must never free a grant a later caller
+        has since acquired."""
+        h = self.health
+        if token is not None and h is not None and hasattr(h, "release_probe"):
+            h.release_probe(addr, token)
+
+    async def _attempt(self, c: BlobClient, op, deadline, as_hedge: bool,
+                       probe_token=None):
+        """One replica attempt: latency-timed, outcome fed to the
+        breaker. Two outcomes are NOT host evidence: a cancelled attempt
+        (losing hedge, teardown) and the caller's own budget running out
+        (DeadlineExceeded) -- blaming the host for either would trip or
+        re-open breakers on replicas that never misbehaved. Both return
+        the probe token and stay silent."""
+        if as_hedge:
+            # Failpoint rpc.hedge.lose: delay the hedge so the primary
+            # wins the race -- drives the loser-cancellation chaos path.
+            hit = failpoints.fire("rpc.hedge.lose")
+            if hit:
+                await asyncio.sleep(hit.delay_s)
+        t0 = time.monotonic()
+        try:
+            out = await op(c, deadline)
+        except asyncio.CancelledError:
+            self._release_probe(c.addr, probe_token)
+            raise
+        except DeadlineExceeded:
+            self._release_probe(c.addr, probe_token)
+            raise
+        except Exception:
+            self._observe(c, False, time.monotonic() - t0)
+            raise
+        self._observe(c, True, time.monotonic() - t0)
+        return out
+
+    async def _try_each(
+        self, d: Digest, op, *, default=_RAISE,
+        deadline: Deadline | None = None, op_name: str = "rpc",
+        hedge: bool = False,
+    ):
+        """Read policy: walk replicas in breaker order under one total
+        budget; idempotent ops hedge. First success wins; with all
+        replicas failed, raise the last error (or return ``default`` if
+        given and no replica errored -- i.e. the ring was empty).
+
+        ``op`` is an async callable ``(client, deadline)`` so the budget
+        reaches the HTTP layer of every attempt."""
+        if deadline is None and self.deadline_seconds:
+            deadline = Deadline(self.deadline_seconds, component=self.component)
+        clients = self.clients_for(d)
+        if hedge and self.hedge_delay is not None and len(clients) > 1:
+            return await self._hedged(d, clients, op, deadline, op_name, default)
+        return await self._serial(
+            d, clients, op, deadline, op_name, default, admit=True
+        )
+
+    async def _serial(
+        self, d: Digest, clients, op, deadline, op_name, default,
+        admit: bool,
+    ):
         last: Exception | None = None
-        for c in self.clients_for(d):
+        attempted = False
+        for c in clients:
+            if deadline is not None and deadline.expired:
+                raise deadline.exceeded(f"{op_name} {d.hex[:12]}") from last
+            admitted = self._admit(c.addr) if admit else True
+            if not admitted:
+                continue  # half-open host: someone else holds the probe
+            attempted = True
             try:
-                out = await op(c)
+                return await self._attempt(
+                    c, op, deadline, as_hedge=False,
+                    probe_token=None if admitted is True else admitted,
+                )
+            except DeadlineExceeded:
+                raise  # the budget is gone: walking further is theater
             except Exception as e:
-                self._report(c, False)
                 last = e
-                continue
-            self._report(c, True)
-            return out
+        if not attempted and admit and clients:
+            # Every replica was skipped by the probe gate: serving badly
+            # beats serving nothing -- retry the walk without admission.
+            return await self._serial(
+                d, clients, op, deadline, op_name, default, admit=False
+            )
         if last is not None:
             raise last
         if default is not _RAISE:
             return default
         raise KeyError(str(d))
+
+    async def _hedged(
+        self, d: Digest, clients, op, deadline, op_name, default
+    ):
+        """Staggered race: the primary attempt starts now; every
+        ``hedge_delay`` without an answer (or immediately on a failure)
+        the next admitted replica joins. First success cancels the rest.
+        Wall-clock worst case stays bounded by ``deadline``."""
+        hedges = REGISTRY.counter(
+            "rpc_hedges_total",
+            "Hedge attempts launched (idempotent reads, after hedge_delay)",
+        )
+        wins = REGISTRY.counter(
+            "rpc_hedge_wins_total",
+            "Hedged reads where the hedge answered before the primary",
+        )
+        # task -> (client, launched-as-hedge)
+        tasks: dict[asyncio.Task, tuple[BlobClient, bool]] = {}
+        idx = 0
+        last: Exception | None = None
+        attempted = False
+
+        def launch(as_hedge: bool) -> bool:
+            nonlocal idx, attempted
+            while idx < len(clients):
+                c = clients[idx]
+                idx += 1
+                admitted = self._admit(c.addr)
+                if not admitted:
+                    continue
+                token = None if admitted is True else admitted
+                t = asyncio.create_task(
+                    self._attempt(c, op, deadline, as_hedge,
+                                  probe_token=token)
+                )
+                if token is not None:
+                    # A task cancelled before its first step never runs
+                    # _attempt's own release -- the done-callback covers
+                    # that gap. Token-matched, so this stale release can
+                    # never free a grant a later caller acquired.
+                    t.add_done_callback(
+                        lambda t, a=c.addr, tok=token:
+                        self._release_probe(a, tok) if t.cancelled() else None
+                    )
+                tasks[t] = (c, as_hedge)
+                attempted = True
+                if as_hedge:
+                    hedges.inc(op=op_name)
+                return True
+            return False
+
+        try:
+            launch(False)
+            if not tasks:
+                # Every replica skipped by the probe gate: degrade to
+                # the serial all-in walk.
+                return await self._serial(
+                    d, clients, op, deadline, op_name, default, admit=False
+                )
+            while True:
+                timeout = self.hedge_delay if idx < len(clients) else None
+                if deadline is not None:
+                    rem = deadline.remaining()
+                    if rem <= 0:
+                        raise deadline.exceeded(
+                            f"{op_name} {d.hex[:12]}"
+                        ) from last
+                    timeout = rem if timeout is None else min(timeout, rem)
+                done, _pending = await asyncio.wait(
+                    tasks, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    # Hedge timer fired (or a deadline tick with nothing
+                    # finished): bring in the next replica.
+                    launch(True)
+                    continue
+                for t in done:
+                    c, was_hedge = tasks.pop(t)
+                    err = t.exception()
+                    if err is None:
+                        if was_hedge:
+                            wins.inc(op=op_name)
+                        return t.result()
+                    if isinstance(err, DeadlineExceeded):
+                        raise err
+                    last = err
+                if not tasks and not launch(False):
+                    break
+            if last is not None:
+                raise last
+            if default is not _RAISE:
+                return default
+            raise KeyError(str(d))
+        finally:
+            # Losers (and everything on an error path) are cancelled AND
+            # reaped: a leaked transfer task would keep pulling bytes --
+            # and holding buffers -- for a result nobody wants.
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _fan_out(self, d: Digest, op) -> None:
         """Write policy: send to EVERY replica (as the reference's proxy
@@ -233,13 +455,21 @@ class ClusterClient:
         if clients and len(errs) == len(clients):
             raise errs[0]
 
-    async def stat(self, namespace: str, d: Digest) -> Optional[BlobInfo]:
+    async def stat(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> Optional[BlobInfo]:
         return await self._try_each(
-            d, lambda c: c.stat(namespace, d), default=None
+            d, lambda c, dl: c.stat(namespace, d, deadline=dl),
+            default=None, deadline=deadline, op_name="stat", hedge=True,
         )
 
-    async def download(self, namespace: str, d: Digest) -> bytes:
-        return await self._try_each(d, lambda c: c.download(namespace, d))
+    async def download(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> bytes:
+        return await self._try_each(
+            d, lambda c, dl: c.download(namespace, d, deadline=dl),
+            deadline=deadline, op_name="download", hedge=True,
+        )
 
     async def adopt(self, namespace: str, d: Digest, source: str) -> bool:
         """Cross-repo mount: adopt the blob into ``namespace``. Writes go
@@ -262,14 +492,24 @@ class ClusterClient:
                 self._report(c, False)
         return ok
 
-    async def get_metainfo(self, namespace: str, d: Digest) -> MetaInfo:
-        return await self._try_each(d, lambda c: c.get_metainfo(namespace, d))
+    async def get_metainfo(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> MetaInfo:
+        return await self._try_each(
+            d, lambda c, dl: c.get_metainfo(namespace, d, deadline=dl),
+            deadline=deadline, op_name="get_metainfo", hedge=True,
+        )
 
     async def download_to_file(
-        self, namespace: str, d: Digest, dest_path: str
+        self, namespace: str, d: Digest, dest_path: str,
+        deadline: Deadline | None = None,
     ) -> int:
+        # Hedge-safe: get_to_file writes through a per-call temp file,
+        # so two racing transfers of one dest never tear each other;
+        # the winner's atomic rename publishes, the loser's tmp unlinks.
         return await self._try_each(
-            d, lambda c: c.download_to_file(namespace, d, dest_path)
+            d, lambda c, dl: c.download_to_file(namespace, d, dest_path, deadline=dl),
+            deadline=deadline, op_name="download_to_file", hedge=True,
         )
 
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:

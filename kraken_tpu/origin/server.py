@@ -43,6 +43,7 @@ from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
 from kraken_tpu.store.metadata import NamespaceMetadata, pin, unpin
 from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.lameduck import LameduckMixin
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 
 _log = logging.getLogger("kraken.origin")
@@ -229,8 +230,10 @@ def _heal_task(ns: str, d: Digest) -> Task:
     )
 
 
-class OriginServer:
+class OriginServer(LameduckMixin):
     """HTTP facade over the origin's storage plane."""
+
+    lameduck_component = "origin"
 
     def __init__(
         self,
@@ -245,6 +248,7 @@ class OriginServer:
         dedup=None,  # origin.dedup.DedupIndex (optional)
         cleanup=None,  # store.cleanup.CleanupManager (optional)
         stream_piece_hash: bool = True,  # False on TPU-hasher origins
+        rpc=None,  # utils.deadline.RPCConfig (optional)
     ):
         self.store = store
         self.generator = generator
@@ -256,6 +260,14 @@ class OriginServer:
         self.scheduler = scheduler
         self.dedup = dedup
         self.cleanup = cleanup
+        # rpc: utils.deadline.RPCConfig (hedge/deadline knobs for the
+        # heal-plane cluster client; None = defaults).
+        self.rpc = rpc
+        # Lameduck drain (utils/lameduck.py): /health fails, NEW upload
+        # sessions are refused with 503+Retry-After; in-flight
+        # PATCH/commit of existing sessions (and established p2p conns)
+        # finish. Never exited -- drain precedes stop.
+        self._inflight_writes = 0
         self._dedup_tasks: set[asyncio.Task] = set()
         self._heal_cluster = None  # lazy ClusterClient (heal plane)
         self._upload_digests: dict[str, _UploadDigest] = {}
@@ -311,6 +323,7 @@ class OriginServer:
         r.add_get("/namespace/{ns}/blobs/{d}", self._download)
         r.add_delete("/namespace/{ns}/blobs/{d}", self._delete)
         r.add_get("/health", self._health)
+        self.add_lameduck_routes(r)
         return app
 
     def _digest(self, req: web.Request) -> Digest:
@@ -319,9 +332,34 @@ class OriginServer:
         except DigestError:
             raise web.HTTPBadRequest(text="malformed digest")
 
+    # -- degradation plane -------------------------------------------------
+
+    @property
+    def inflight_work(self) -> int:
+        """Upload PATCH/commit bodies currently streaming -- the drain
+        loop lets these finish before the hard stop."""
+        return self._inflight_writes
+
+    async def _brownout_gate(self) -> None:
+        """Failpoint ``rpc.brownout.slow`` (and the addr-targeted
+        ``rpc.brownout.slow@host:port`` variant for single-process chaos
+        herds where the registry is shared): a SLOW-BUT-ALIVE origin --
+        the read path stalls for the armed delay but still answers.
+        Drives the hedged-read chaos scenarios (tests/test_chaos.py)."""
+        hit = failpoints.fire("rpc.brownout.slow") or failpoints.fire(
+            f"rpc.brownout.slow@{self.self_addr}"
+        )
+        if hit:
+            await asyncio.sleep(hit.delay_s)
+
     # -- upload flow -------------------------------------------------------
 
     async def _start_upload(self, req: web.Request) -> web.Response:
+        if self.lameduck:
+            # New write sessions are new WORK; a draining node refuses
+            # them so the pusher retries a healthy replica now instead
+            # of losing a half-streamed upload at the hard stop.
+            raise self.drain_unavailable()
         uid = self.store.create_upload()
         # Running digest over sequentially-streamed upload bytes: when the
         # whole upload arrives in offset order (the overwhelmingly common
@@ -368,6 +406,7 @@ class OriginServer:
         tracker = self._upload_digests.get(uid)
         if tracker is not None and not tracker.begin_patch(offset):
             tracker = None
+        self._inflight_writes += 1  # drain waits for streaming bodies
         try:
             f.seek(offset)
             # Batch spool writes: a thread hop per MiB costs ~0.5 ms each
@@ -409,6 +448,7 @@ class OriginServer:
                 tracker.invalidate()
             raise
         finally:
+            self._inflight_writes -= 1
             if tracker is not None:
                 tracker.end_patch()
             try:
@@ -429,6 +469,13 @@ class OriginServer:
         return web.Response(status=204)
 
     async def _commit(self, req: web.Request) -> web.Response:
+        self._inflight_writes += 1
+        try:
+            return await self._commit_inner(req)
+        finally:
+            self._inflight_writes -= 1
+
+    async def _commit_inner(self, req: web.Request) -> web.Response:
         uid = req.match_info["uid"]
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
@@ -800,7 +847,20 @@ class OriginServer:
             return c
         if c is not None:
             await c.close()
-        c = ClusterClient(self.ring, exclude_addr=self.self_addr)
+        c = ClusterClient(
+            self.ring,
+            exclude_addr=self.self_addr,
+            # Heals run precisely when some replica is sick: hedged,
+            # budgeted reads are the difference between a heal that
+            # routes around a brown-out and one that camps on it.
+            hedge_delay_seconds=(
+                self.rpc.hedge_delay_seconds if self.rpc else None
+            ),
+            deadline_seconds=(
+                self.rpc.request_deadline_seconds if self.rpc else None
+            ),
+            component="origin-heal",
+        )
         self._heal_cluster = c
         return c
 
@@ -823,6 +883,7 @@ class OriginServer:
         self._schedule_dedup(d)
 
     async def _stat(self, req: web.Request) -> web.Response:
+        await self._brownout_gate()
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         try:
@@ -856,6 +917,7 @@ class OriginServer:
             self.cleanup.touch(d)
 
     async def _download(self, req: web.Request) -> web.StreamResponse:
+        await self._brownout_gate()
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
@@ -867,6 +929,7 @@ class OriginServer:
         )
 
     async def _metainfo(self, req: web.Request) -> web.Response:
+        await self._brownout_gate()
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
         await self._ensure_local(ns, d)
@@ -917,4 +980,9 @@ class OriginServer:
         return web.Response(status=204)
 
     async def _health(self, req: web.Request) -> web.Response:
+        if self.lameduck:
+            # Failing health IS the drain broadcast: ring peers' active
+            # monitors drop this origin within their fail threshold and
+            # re-replication routes around it -- no orchestration hook.
+            raise self.drain_unavailable()
         return web.Response(text="ok")

@@ -7,6 +7,13 @@ drained by one pump task: a 10k-torrent seeding agent emits at most
 ``max_rate`` announces/second, oldest-due first (the heap order IS the
 ready/pending rotation), instead of one announce task per torrent firing
 every interval.
+
+Time-budget contract (round 8): every announce this queue's pump fires
+runs under ``TrackerClient.announce``'s total deadline
+(``rpc.announce_timeout_seconds`` -> utils/deadline.Deadline), so a hung
+tracker socket exhausts ONE budget and re-enters the heap at the next
+interval -- the pump itself never blocks on a wedged announce (it spawns
+per-announce tasks), and no key can wedge the rotation.
 """
 
 from __future__ import annotations

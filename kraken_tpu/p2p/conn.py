@@ -139,9 +139,17 @@ class Conn:
         except asyncio.QueueFull:
             pass
         put = asyncio.ensure_future(self._send_q.put(msg))
-        done, _pending = await asyncio.wait(
-            {put, self.closed}, return_when=asyncio.FIRST_COMPLETED
-        )
+        try:
+            done, _pending = await asyncio.wait(
+                {put, self.closed}, return_when=asyncio.FIRST_COMPLETED
+            )
+        except asyncio.CancelledError:
+            # The CALLER was cancelled mid-wait (teardown, hedge loser):
+            # asyncio.wait never cancels its awaitables, so the helper
+            # future must be reaped here or it outlives the conn as a
+            # forever-pending Queue.put task.
+            put.cancel()
+            raise
         if put not in done:
             put.cancel()
             raise ConnClosedError(str(self.peer_id))
@@ -154,9 +162,14 @@ class Conn:
             if self._closed_fut is not None and self._closed_fut.done():
                 raise ConnClosedError(str(self.peer_id))
             get = asyncio.ensure_future(self._recv_q.get())
-            done, _pending = await asyncio.wait(
-                {get, self.closed}, return_when=asyncio.FIRST_COMPLETED
-            )
+            try:
+                done, _pending = await asyncio.wait(
+                    {get, self.closed}, return_when=asyncio.FIRST_COMPLETED
+                )
+            except asyncio.CancelledError:
+                # Caller cancelled mid-wait: reap the helper (see send).
+                get.cancel()
+                raise
             if get not in done:
                 get.cancel()
                 raise ConnClosedError(str(self.peer_id))

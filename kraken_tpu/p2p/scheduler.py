@@ -214,6 +214,12 @@ class Scheduler:
         self._announce_queue = AnnounceQueue()
         self._announce_pump_task: Optional[asyncio.Task] = None
         self._announce_tasks: set[asyncio.Task] = set()
+        # Lameduck drain (docs/OPERATIONS.md "Degradation plane"): stop
+        # announcing and refuse NEW conns, but keep serving established
+        # ones so in-flight pieces finish. Entered by SIGTERM or
+        # POST /debug/lameduck; the tracker's peer TTL then ages this
+        # node out of handouts.
+        self.lameduck = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -252,6 +258,23 @@ class Scheduler:
     @property
     def addr(self) -> str:
         return f"{self.ip}:{self.port}"
+
+    @property
+    def num_active_conns(self) -> int:
+        """Live peer conns -- the drain loop's quiesce signal."""
+        return len(self._conn_owners)
+
+    def enter_lameduck(self) -> None:
+        """Drain mode: seed announces stop (the tracker's peer TTL ages
+        this node out of handouts) and new INBOUND conns are refused --
+        but in-flight downloads keep announcing and dialing: "let
+        in-flight work finish" includes a download that has not found
+        its peers yet, and the HTTP layer already refuses NEW download
+        requests while draining. Established conns keep serving until
+        they complete and churn out; assembly's drain() waits on
+        :attr:`num_active_conns`."""
+        self.lameduck = True
+        _log.info("scheduler entering lameduck drain")
 
     # -- public API --------------------------------------------------------
 
@@ -379,6 +402,12 @@ class Scheduler:
     async def _announce_once(self, ctl: _TorrentControl) -> None:
         h = ctl.torrent.info_hash
         complete = ctl.torrent.complete()
+        if self.lameduck and complete:
+            # Draining seeders go dark (no reschedule: the tracker's
+            # peer TTL forgets us); LEECHING announces keep flowing so
+            # an in-flight download can still find its peers and finish
+            # inside the drain window.
+            return
         interval = (
             self.config.seed_announce_interval
             if complete
@@ -405,6 +434,10 @@ class Scheduler:
             )
 
     def _maybe_dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
+        # Deliberately NOT lameduck-gated: dials only ever serve an
+        # INCOMPLETE torrent (see the complete() check below), i.e. an
+        # in-flight download -- exactly the work a drain lets finish.
+        # New downloads are refused upstream at the HTTP layer.
         if peer.peer_id == self.peer_id:
             return
         # Complete torrents only serve; they never dial (origins and
@@ -488,6 +521,11 @@ class Scheduler:
         resolver loads its metainfo); agents only serve torrents they have
         live controls for. Raising KeyError rejects the conn.
         """
+        if self.lameduck:
+            # Draining: the polite busy frame -- the dialer soft-
+            # blacklists (capacity, not misbehavior) and retries another
+            # peer, which is exactly what 503+Retry-After means in HTTP.
+            raise _AtCapacity(hs.info_hash.hex)
         if self.conn_state.at_capacity(hs.info_hash):
             raise _AtCapacity(hs.info_hash.hex)
         ctl = self._controls.get(hs.info_hash)

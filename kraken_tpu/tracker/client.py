@@ -3,6 +3,14 @@
 Mirrors uber/kraken ``tracker/announceclient`` + ``tracker/metainfoclient``
 -- upstream paths, unverified; SURVEY.md SS2.4. These implement the
 scheduler's ``AnnounceClient`` / ``MetaInfoClient`` protocols.
+
+Every announce runs under an explicit total budget
+(``announce_timeout_seconds`` -> utils/deadline.Deadline): before round 8
+the announce POST had NO timeout at all, so one hung tracker socket
+stalled the scheduler's announce loop forever -- the announce queue kept
+popping, but the in-flight task never returned. Exhaustion is counted on
+``announce_timeouts_total`` and raises, which the scheduler's announce
+loop already meters and retries next interval.
 """
 
 from __future__ import annotations
@@ -14,7 +22,9 @@ from kraken_tpu.core.metainfo import InfoHash, MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
 from urllib.parse import quote
 
+from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
 from kraken_tpu.utils.httputil import HTTPClient, base_url
+from kraken_tpu.utils.metrics import REGISTRY
 
 
 class TrackerClient:
@@ -28,6 +38,7 @@ class TrackerClient:
         port: int,
         is_origin: bool = False,
         http: HTTPClient | None = None,
+        announce_timeout_seconds: float = 5.0,
     ):
         self.addr = addr
         self.peer_id = peer_id
@@ -35,6 +46,10 @@ class TrackerClient:
         self.port = port
         self.is_origin = is_origin
         self._http = http or HTTPClient()
+        # Per-announce TOTAL budget (retries included); the per-attempt
+        # timeout becomes min(http timeout, remaining budget). 0 = the
+        # legacy unbounded announce (discouraged; kept for tests).
+        self.announce_timeout = announce_timeout_seconds
 
     async def announce(
         self, d: Digest, h: InfoHash, namespace: str, complete: bool
@@ -46,10 +61,23 @@ class TrackerClient:
             origin=self.is_origin,
             complete=complete,
         )
-        body = await self._http.post(
-            f"{base_url(self.addr)}/announce",
-            data=json.dumps({"info_hash": h.hex, "peer": me.to_dict()}),
+        deadline = (
+            Deadline(self.announce_timeout, component="announce")
+            if self.announce_timeout
+            else None
         )
+        try:
+            body = await self._http.post(
+                f"{base_url(self.addr)}/announce",
+                data=json.dumps({"info_hash": h.hex, "peer": me.to_dict()}),
+                deadline=deadline,
+            )
+        except DeadlineExceeded:
+            REGISTRY.counter(
+                "announce_timeouts_total",
+                "Tracker announces abandoned at their total time budget",
+            ).inc()
+            raise
         doc = json.loads(body)
         return [PeerInfo.from_dict(p) for p in doc["peers"]], float(doc["interval"])
 

@@ -15,6 +15,7 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import logging
 import urllib.parse
 
 from aiohttp import web
@@ -25,6 +26,9 @@ from kraken_tpu.tracker.peerhandout import default_priority
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore, PeerStore
 from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.dedup import TTLCache
+from kraken_tpu.utils.metrics import FailureMeter
+
+_log = logging.getLogger("kraken.tracker")
 
 
 class TrackerServer:
@@ -43,6 +47,14 @@ class TrackerServer:
         self.policy = handout_policy
         self.handout_limit = handout_limit
         self._metainfo_cache: TTLCache = TTLCache(metainfo_cache_ttl)
+        # A handler failure swallowed as a bare 404 made a dying origin
+        # cluster indistinguishable from a missing blob; meter + one
+        # throttled WARN with request context instead.
+        self._handler_errors = FailureMeter(
+            "tracker_handler_errors_total",
+            "Tracker handler failures previously swallowed as 404s",
+            _log,
+        )
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -91,11 +103,38 @@ class TrackerServer:
         # must simply re-announce rather than treat it as terminal.
         if failpoints.fire("tracker.announce.empty"):
             others = []
+        ordered = self.policy(others)
+        ordered = self._shed_unhealthy_origins(ordered)
         return web.json_response(
             {
-                "peers": [p.to_dict() for p in self.policy(others)],
+                "peers": [p.to_dict() for p in ordered],
                 "interval": self.interval,
             }
+        )
+
+    def _shed_unhealthy_origins(
+        self, peers: list[PeerInfo]
+    ) -> list[PeerInfo]:
+        """Breaker-aware handout: origin peers whose HOST the tracker's
+        own origin-cluster breaker holds unhealthy (open, half-open, or
+        browned out) move to the back of the handout, so leechers dial
+        them only when everyone healthier is exhausted. Matching is by
+        IP -- the breaker keys http addrs, announces carry p2p addrs --
+        and only origin peers are shed: the breaker knows nothing about
+        agent hosts."""
+        health = getattr(self.origin_cluster, "health", None)
+        if health is None or not hasattr(health, "unhealthy_hosts"):
+            return peers
+
+        def host_ip(h: str) -> str:
+            h = h.split("://", 1)[-1]
+            return h.rsplit(":", 1)[0]
+
+        bad_ips = {host_ip(h) for h in health.unhealthy_hosts()}
+        if not bad_ips:
+            return peers
+        return sorted(  # stable: policy order preserved within each half
+            peers, key=lambda p: p.origin and p.ip in bad_ips
         )
 
     async def _metainfo(self, req: web.Request) -> web.Response:
@@ -110,7 +149,15 @@ class TrackerServer:
                 raise web.HTTPNotFound(text="no origin cluster configured")
             try:
                 metainfo = await self.origin_cluster.get_metainfo(ns, d)
-            except Exception:
+            except Exception as e:
+                # Still a 404 to the caller (agents retry through their
+                # announce loop), but never a SILENT one: an origin
+                # cluster that is down looks exactly like a missing blob
+                # otherwise. Metered + one throttled WARN with context.
+                self._handler_errors.record(
+                    f"metainfo fetch {d.hex[:12]} ns={ns} "
+                    f"peer={req.remote}", e,
+                )
                 raise web.HTTPNotFound(text="metainfo unavailable")
             cached = metainfo.serialize()
             self._metainfo_cache.put(d.hex, cached)

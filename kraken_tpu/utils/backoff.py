@@ -20,3 +20,22 @@ class Backoff:
         if self.jitter:
             d *= 1 + random.uniform(-self.jitter, self.jitter)
         return max(0.0, d)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecorrelatedJitter:
+    """AWS-style decorrelated-jitter backoff: each delay is drawn from
+    ``uniform(base, prev * 3)`` (capped), so repeated failures spread a
+    fleet's retries instead of synchronizing them the way plain
+    exponential-with-ratio-jitter does. Stateless -- the caller carries
+    ``prev`` (0 = first failure, which yields exactly ``base`` so the
+    initial cooldown stays deterministic for operators and tests)."""
+
+    base_seconds: float = 30.0
+    max_seconds: float = 300.0
+
+    def next(self, prev: float, rng: random.Random | None = None) -> float:
+        if prev <= 0:
+            return min(self.base_seconds, self.max_seconds)
+        draw = (rng or random).uniform(self.base_seconds, prev * 3)
+        return min(self.max_seconds, max(self.base_seconds, draw))

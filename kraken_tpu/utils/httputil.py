@@ -9,14 +9,20 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import itertools
 import logging
 import os
 from typing import Any
 
 import aiohttp
 
+# get_to_file temp-name disambiguator (hedged reads: two concurrent
+# transfers of one dest path in one process must not share a tmp file).
+_tmp_seq = itertools.count()
+
 from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.backoff import Backoff
+from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded  # noqa: F401 (re-exported)
 from kraken_tpu.utils.metrics import REGISTRY
 
 _log = logging.getLogger("kraken.httputil")
@@ -142,6 +148,7 @@ class HTTPClient:
         backoff: Backoff | None = None,
         ssl=None,
     ):
+        self._timeout_seconds = timeout_seconds
         self._timeout = aiohttp.ClientTimeout(total=timeout_seconds)
         self._retries = retries
         self._backoff = backoff or Backoff()
@@ -170,6 +177,35 @@ class HTTPClient:
         if self._session and not self._session.closed:
             await self._session.close()
 
+    def _attempt_timeout(
+        self, deadline: Deadline | None
+    ) -> aiohttp.ClientTimeout | None:
+        """The next attempt's total timeout: ``min(per_attempt,
+        remaining_budget)`` when a deadline rides along, else the
+        session default. None = use the session's configured timeout."""
+        if deadline is None:
+            return None
+        return aiohttp.ClientTimeout(
+            total=deadline.timeout(self._timeout_seconds)
+        )
+
+    async def _retry_pause(
+        self, method: str, url: str, attempt: int,
+        deadline: Deadline | None, last_err: Exception | None,
+    ) -> None:
+        """Backoff between attempts, capped by the remaining budget.
+        Raises the typed exhaustion error instead of sleeping past the
+        caller's deadline -- retries must never multiply the budget."""
+        delay = self._backoff.delay(attempt)
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem <= delay:
+                _give_up(method, url, attempt + 1, last_err)
+                raise deadline.exceeded(f"{method} {url}") from last_err
+            delay = min(delay, rem)
+        _count_retry(method)
+        await asyncio.sleep(delay)
+
     async def request(
         self,
         method: str,
@@ -179,9 +215,13 @@ class HTTPClient:
         headers: dict | None = None,
         ok_statuses: tuple[int, ...] = (200, 201, 204),
         retry_5xx: bool = True,
+        deadline: Deadline | None = None,
     ) -> bytes:
         last_err: Exception | None = None
         for attempt in range(self._retries + 1):
+            if deadline is not None and deadline.expired:
+                _give_up(method, url, attempt, last_err)
+                raise deadline.exceeded(f"{method} {url}") from last_err
             try:
                 injected = await _failpoint_gate(method, url)
                 if injected is not None:
@@ -190,8 +230,12 @@ class HTTPClient:
                     last_err = injected
                 else:
                     session = await self._get_session()
+                    kw = {}
+                    t = self._attempt_timeout(deadline)
+                    if t is not None:
+                        kw["timeout"] = t
                     async with session.request(
-                        method, url, data=data, headers=headers
+                        method, url, data=data, headers=headers, **kw
                     ) as resp:
                         body = await resp.read()
                         if resp.status in ok_statuses:
@@ -204,8 +248,7 @@ class HTTPClient:
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
                 last_err = e
             if attempt < self._retries:
-                _count_retry(method)
-                await asyncio.sleep(self._backoff.delay(attempt))
+                await self._retry_pause(method, url, attempt, deadline, last_err)
         assert last_err is not None
         _give_up(method, url, self._retries + 1, last_err)
         raise last_err
@@ -220,12 +263,16 @@ class HTTPClient:
         ok_statuses: tuple[int, ...] = (200, 201, 204),
         retry_5xx: bool = True,
         allow_redirects: bool = True,
+        deadline: Deadline | None = None,
     ) -> tuple[int, dict, bytes]:
         """Like :meth:`request` but returns (status, headers, body) --
         needed by backends that read response headers (Content-Length,
         Docker-Content-Digest, redirect Location)."""
         last_err: Exception | None = None
         for attempt in range(self._retries + 1):
+            if deadline is not None and deadline.expired:
+                _give_up(method, url, attempt, last_err)
+                raise deadline.exceeded(f"{method} {url}") from last_err
             try:
                 injected = await _failpoint_gate(method, url)
                 if injected is not None:
@@ -234,9 +281,13 @@ class HTTPClient:
                     last_err = injected
                 else:
                     session = await self._get_session()
+                    kw = {}
+                    t = self._attempt_timeout(deadline)
+                    if t is not None:
+                        kw["timeout"] = t
                     async with session.request(
                         method, url, data=data, headers=headers,
-                        allow_redirects=allow_redirects,
+                        allow_redirects=allow_redirects, **kw
                     ) as resp:
                         body = await resp.read()
                         if resp.status in ok_statuses:
@@ -251,8 +302,7 @@ class HTTPClient:
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
                 last_err = e
             if attempt < self._retries:
-                _count_retry(method)
-                await asyncio.sleep(self._backoff.delay(attempt))
+                await self._retry_pause(method, url, attempt, deadline, last_err)
         assert last_err is not None
         _give_up(method, url, self._retries + 1, last_err)
         raise last_err
@@ -265,13 +315,20 @@ class HTTPClient:
         headers: dict | None = None,
         chunk_size: int = 1 << 20,
         retry_5xx: bool = True,
+        deadline: Deadline | None = None,
     ) -> int:
         """Stream a GET body to ``dest_path`` (written via a temp file,
         atomically renamed) without buffering it in RAM; returns the byte
         count. Whole-transfer retries, same policy as :meth:`request`."""
         last_err: Exception | None = None
-        tmp = f"{dest_path}.http{os.getpid()}.tmp"
+        # Unique per call, not just per process: hedged reads run two
+        # transfers of the SAME dest concurrently in one process, and a
+        # shared tmp name would let the loser tear the winner's bytes.
+        tmp = f"{dest_path}.http{os.getpid()}.{next(_tmp_seq)}.tmp"
         for attempt in range(self._retries + 1):
+            if deadline is not None and deadline.expired:
+                _give_up("GET", url, attempt, last_err)
+                raise deadline.exceeded(f"GET {url}") from last_err
             try:
                 injected = await _failpoint_gate("GET", url)
                 if injected is not None:
@@ -280,7 +337,11 @@ class HTTPClient:
                     last_err = injected
                 else:
                     session = await self._get_session()
-                    async with session.get(url, headers=headers) as resp:
+                    kw = {}
+                    t = self._attempt_timeout(deadline)
+                    if t is not None:
+                        kw["timeout"] = t
+                    async with session.get(url, headers=headers, **kw) as resp:
                         if resp.status != 200:
                             body = await resp.read()
                             err = HTTPError("GET", url, resp.status, body)
@@ -313,8 +374,7 @@ class HTTPClient:
                 with contextlib.suppress(OSError):
                     os.unlink(tmp)
             if attempt < self._retries:
-                _count_retry("GET")
-                await asyncio.sleep(self._backoff.delay(attempt))
+                await self._retry_pause("GET", url, attempt, deadline, last_err)
         assert last_err is not None
         _give_up("GET", url, self._retries + 1, last_err)
         raise last_err

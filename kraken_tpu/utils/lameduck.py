@@ -1,0 +1,83 @@
+"""Shared lameduck-drain plumbing for component HTTP servers.
+
+One implementation of the drain contract (docs/OPERATIONS.md
+"Degradation plane") serves both the agent and the origin: a single
+``lameduck`` flag, the idempotent drain entry that also drains the p2p
+scheduler, the ``POST/GET /debug/lameduck`` operator endpoints, and the
+503+Retry-After refusal every new-work path raises. Drain SEMANTICS --
+which requests count as new work, which in-flight counter gates the
+quiesce -- stay with each server; only the mechanism lives here, so it
+cannot diverge between components.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+_log = logging.getLogger("kraken.lameduck")
+
+# Clients seeing a drain 503 should retry elsewhere-or-later; this is
+# the hint, not a promise (the pod is likely gone by then).
+RETRY_AFTER_SECONDS = "5"
+
+
+class LameduckMixin:
+    """Mix into a component server that owns a ``scheduler`` attribute
+    (p2p Scheduler or None). Hosts override :attr:`inflight_work` with
+    their quiesce signal and call :meth:`add_lameduck_routes` from
+    ``make_app``."""
+
+    lameduck = False
+    lameduck_component = "node"
+
+    def enter_lameduck(self) -> None:
+        """Idempotent drain entry: stop advertising, refuse new work,
+        let in-flight work finish (assembly's drain() waits on
+        :attr:`inflight_work` + the scheduler's conn count)."""
+        if self.lameduck:
+            return
+        self.lameduck = True
+        scheduler = getattr(self, "scheduler", None)
+        if scheduler is not None:
+            scheduler.enter_lameduck()
+        _log.info("%s entering lameduck drain", self.lameduck_component)
+
+    @property
+    def inflight_work(self) -> int:
+        """Drain quiesce signal: requests that must be allowed to
+        finish. Hosts override."""
+        return 0
+
+    def drain_unavailable(self) -> web.HTTPServiceUnavailable:
+        """The refusal every new-work path (and /health) raises while
+        draining."""
+        return web.HTTPServiceUnavailable(
+            text="draining (lameduck)",
+            headers={"Retry-After": RETRY_AFTER_SECONDS},
+        )
+
+    def add_lameduck_routes(self, router) -> None:
+        router.add_post("/debug/lameduck", self._lameduck)
+        router.add_get("/debug/lameduck", self._lameduck_state)
+
+    async def _lameduck(self, req: web.Request) -> web.Response:
+        """Operator drain entry (runbook: docs/OPERATIONS.md). The node
+        keeps running -- the deploy system observes /health flip to 503,
+        waits its grace period, then SIGTERMs for the full drain+stop."""
+        self.enter_lameduck()
+        return web.json_response(self._lameduck_doc())
+
+    async def _lameduck_state(self, req: web.Request) -> web.Response:
+        return web.json_response(self._lameduck_doc())
+
+    def _lameduck_doc(self) -> dict:
+        scheduler = getattr(self, "scheduler", None)
+        return {
+            "lameduck": self.lameduck,
+            "inflight": self.inflight_work,
+            "active_conns": (
+                scheduler.num_active_conns if scheduler is not None else 0
+            ),
+        }

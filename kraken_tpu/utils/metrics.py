@@ -387,6 +387,15 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
                 _profile_lock.release()
         return web.json_response({"trace_dir": out_dir, "seconds": seconds})
 
+    async def healthcheck_endpoint(request):
+        # "Why is this replica being skipped": every live health filter
+        # and breaker in the process, with per-host state, consecutive
+        # fails, remaining open time, probe occupancy, and the latency
+        # EWMA driving brown-out shedding (placement/healthcheck.py).
+        from kraken_tpu.placement.healthcheck import debug_snapshot
+
+        return web.json_response(debug_snapshot())
+
     async def failpoints_get(request):
         # Chaos runbook surface (docs/OPERATIONS.md): list armed sites
         # with hit/fire counts; firings also count on /metrics as
@@ -437,6 +446,7 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
 
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/healthcheck", healthcheck_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)
     app.router.add_get("/debug/jax-profile", jax_profile_endpoint)
     app.router.add_get("/debug/failpoints", failpoints_get)

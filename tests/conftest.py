@@ -25,6 +25,56 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+import pytest  # noqa: E402
+
+# Modules under the task-leak tripwire. Hedging and drain made
+# cancellation the hot regression surface: a losing hedge or a drained
+# conn that is cancelled but never reaped keeps pulling bytes (and
+# holding buffers) forever, and asyncio.run's shutdown would silently
+# cancel it -- hiding exactly the bug. These modules' asyncio.run calls
+# get wrapped so the test FAILS if any task is still pending once the
+# test body returns (short grace for in-flight done-callbacks).
+_TASK_LEAK_MODULES = {"test_chaos", "test_degradation"}
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_asyncio_tasks(request, monkeypatch):
+    import asyncio
+
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _TASK_LEAK_MODULES:
+        yield
+        return
+    leaks: list[str] = []
+    orig_run = asyncio.run
+
+    def checked_run(coro, **kw):
+        async def wrapper():
+            try:
+                return await coro
+            finally:
+                cur = asyncio.current_task()
+                pending: list = []
+                for _ in range(40):  # ~2 s grace: reaping, not sleeping
+                    pending = [
+                        t for t in asyncio.all_tasks()
+                        if t is not cur and not t.done()
+                    ]
+                    if not pending:
+                        break
+                    await asyncio.sleep(0.05)
+                leaks.extend(
+                    f"{t.get_name()}: {t.get_coro()!r}" for t in pending
+                )
+        return orig_run(wrapper(), **kw)
+
+    monkeypatch.setattr(asyncio, "run", checked_run)
+    yield
+    assert not leaks, (
+        "leaked pending asyncio tasks after test body:\n" + "\n".join(leaks)
+    )
+
+
 def pytest_configure(config):
     # Registered here (no pytest.ini exists): tier-1 is `-m 'not slow'`,
     # so the fast chaos subset runs in tier-1 and the soak subset does

@@ -26,7 +26,7 @@ CLI_KEYS = {
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
-    "task_timeout_seconds",
+    "task_timeout_seconds", "rpc",
 }
 
 
@@ -106,6 +106,26 @@ def test_scheduler_sections_construct_scheduler_config():
         assert cfg.bufpool_budget_mb >= 0, path
         seen += 1
     assert seen >= 2  # origin + agent ship the wire-plane knobs
+
+
+def test_rpc_sections_construct_rpc_config():
+    """Every shipped `rpc:` section (deadlines, hedge delay, brown-out
+    threshold, drain timeout) must map onto RPCConfig through the same
+    from_dict the CLI/assembly use -- a typo'd degradation knob must
+    fail here, not at production boot."""
+    from kraken_tpu.utils.deadline import RPCConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        rc = load_config(path).get("rpc")
+        if not rc:
+            continue
+        cfg = RPCConfig.from_dict(rc)  # raises on unknown keys
+        assert cfg.announce_timeout_seconds > 0, path
+        assert cfg.drain_timeout_seconds > 0, path
+        assert cfg.request_deadline_seconds > 0, path
+        seen += 1
+    assert seen >= 3  # agent + origin + tracker ship the rpc knobs
 
 
 def test_cli_keys_match_cli_source():

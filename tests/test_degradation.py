@@ -1,0 +1,764 @@
+"""Overload & degradation plane: deadlines, circuit breakers, hedged
+reads, and lameduck drain (round 8).
+
+The retry-budget interaction tests are the load-bearing ones: a deadline
+of N seconds with a per-attempt timeout of T must yield <= ceil(N/T)
+attempts ACROSS HTTPClient retries and ClusterClient replica walks -- the
+pre-deadline plane multiplied budgets instead (retries x replicas x
+per-attempt timeout). The breaker half-open tests pin the single-probe
+property: after a cooldown exactly ONE request is exposed to a
+previously-failing host.
+
+This module runs under conftest's no-leaked-asyncio-tasks tripwire:
+hedging loses a race on every test here, and a losing hedge that is not
+reaped is precisely the regression class this plane can introduce.
+"""
+
+import asyncio
+import json
+import math
+import os
+import time
+
+import pytest
+from aiohttp import web
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.peer import PeerIDFactory
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement.healthcheck import (
+    ActiveMonitor,
+    PassiveFilter,
+    debug_snapshot,
+)
+from kraken_tpu.tracker.client import TrackerClient
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.backoff import Backoff, DecorrelatedJitter
+from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded, RPCConfig
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+from kraken_tpu.utils.metrics import REGISTRY
+
+NS = "degradation"
+FAST = Backoff(base_seconds=0.01, factor=1.0, max_seconds=0.01, jitter=0)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    failpoints.FAILPOINTS.disarm_all()
+    yield
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow(False)
+
+
+class _FakeOrigin:
+    """A minimal origin read surface: GET blob + stat, with a settable
+    per-request delay and a hit counter -- the brown-out stand-in."""
+
+    def __init__(self, body: bytes = b"", delay: float = 0.0):
+        self.body = body
+        self.delay = delay
+        self.hits = 0
+        self.runner = None
+        self.addr = ""
+
+    async def _blob(self, req):
+        self.hits += 1
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return web.Response(body=self.body)
+
+    async def _stat(self, req):
+        self.hits += 1
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return web.json_response({"size": len(self.body)})
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/namespace/{ns}/blobs/{d}", self._blob)
+        app.router.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
+        # handler_cancellation + tiny shutdown grace: these fakes hold
+        # deliberately-slow handlers, and cleanup() must not serve out
+        # aiohttp's default 60 s goodbye per test.
+        self.runner = web.AppRunner(
+            app, handler_cancellation=True, shutdown_timeout=0.1
+        )
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.addr = f"127.0.0.1:{self.runner.addresses[0][1]}"
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+# -- Deadline type -----------------------------------------------------------
+
+
+def test_deadline_remaining_expired_and_min_timeout():
+    d = Deadline(10.0, component="t", now=100.0)
+    assert d.remaining(now=104.0) == pytest.approx(6.0)
+    assert d.timeout(2.0) <= 2.0  # per-attempt wins while budget is big
+    spent = Deadline(0.5, now=100.0)
+    assert spent.remaining(now=101.0) < 0 and spent.expired
+    assert spent.timeout(2.0) == 0.0  # never negative
+
+
+def test_deadline_exceeded_is_typed_and_counted():
+    c = REGISTRY.counter("rpc_deadline_exceeded_total")
+    before = c.value(component="unit")
+    err = Deadline(0.0, component="unit").exceeded("GET /x")
+    assert isinstance(err, DeadlineExceeded)
+    assert c.value(component="unit") == before + 1
+
+
+def test_rpc_config_rejects_unknown_keys():
+    with pytest.raises(ValueError):
+        RPCConfig.from_dict({"hedge_delay": 1.0})  # typo'd knob
+    cfg = RPCConfig.from_dict({"hedge_delay_seconds": 0.1})
+    assert cfg.hedge_delay_seconds == 0.1
+    assert RPCConfig.from_dict(None).drain_timeout_seconds == 30.0
+
+
+def test_decorrelated_jitter_bounds():
+    import random
+
+    j = DecorrelatedJitter(base_seconds=1.0, max_seconds=10.0)
+    assert j.next(0) == 1.0  # first trip: exactly the base cooldown
+    rng = random.Random(7)
+    prev = 1.0
+    for _ in range(50):
+        prev = j.next(prev, rng)
+        assert 1.0 <= prev <= 10.0
+
+
+# -- retry-budget interaction (satellite: no budget multiplication) ----------
+
+
+def _hang_server():
+    """An aiohttp server whose handler never answers in time."""
+
+    class S:
+        def __init__(self):
+            self.hits = 0
+            self.runner = None
+            self.addr = ""
+
+        async def handler(self, req):
+            self.hits += 1
+            await asyncio.sleep(30)
+            return web.Response(text="late")
+
+        async def start(self):
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", self.handler)
+            self.runner = web.AppRunner(
+                app, handler_cancellation=True, shutdown_timeout=0.1
+            )
+            await self.runner.setup()
+            site = web.TCPSite(self.runner, "127.0.0.1", 0)
+            await site.start()
+            self.addr = f"127.0.0.1:{self.runner.addresses[0][1]}"
+
+        async def stop(self):
+            await self.runner.cleanup()
+
+    return S()
+
+
+def test_http_client_deadline_caps_attempts_at_ceil_n_over_t():
+    """retries=10 would normally mean 11 attempts; a 0.4 s deadline over
+    a 0.15 s per-attempt timeout must stop at <= ceil(0.4/0.15) = 3,
+    raise the TYPED error, and return well before the naive 11x wall."""
+
+    async def main():
+        srv = _hang_server()
+        await srv.start()
+        http = HTTPClient(timeout_seconds=0.15, retries=10, backoff=FAST)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                await http.get(
+                    f"http://{srv.addr}/x",
+                    deadline=Deadline(0.4, component="unit-http"),
+                )
+            wall = time.monotonic() - t0
+            assert srv.hits <= math.ceil(0.4 / 0.15) == 3
+            assert srv.hits >= 2  # it did retry inside the budget
+            assert wall < 2.0  # nowhere near 11 x 0.15 + backoffs
+        finally:
+            await http.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_http_client_without_deadline_keeps_full_retry_budget():
+    async def main():
+        srv = _hang_server()
+        await srv.start()
+        http = HTTPClient(timeout_seconds=0.05, retries=3, backoff=FAST)
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await http.get(f"http://{srv.addr}/x")
+            assert srv.hits == 4  # legacy behavior intact: retries + 1
+        finally:
+            await http.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_cluster_walk_respects_one_budget_across_replicas():
+    """3 replicas x (retries=2 -> 3 attempts) = 9 attempts un-budgeted;
+    one 0.4 s deadline with a 0.15 s per-attempt timeout must cap the
+    TOTAL across the whole walk at ceil(N/T) = 3."""
+
+    async def main():
+        servers = [_hang_server() for _ in range(3)]
+        for s in servers:
+            await s.start()
+        ring = Ring(
+            HostList(static=[s.addr for s in servers]), max_replica=3
+        )
+        cluster = ClusterClient(
+            ring,
+            client_factory=lambda a: BlobClient(
+                a, HTTPClient(timeout_seconds=0.15, retries=2, backoff=FAST)
+            ),
+            deadline_seconds=0.4,
+            component="unit-walk",
+        )
+        try:
+            d = Digest.from_bytes(b"budget")
+            with pytest.raises(DeadlineExceeded):
+                await cluster.download(NS, d)
+            total = sum(s.hits for s in servers)
+            assert total <= 3, f"budget multiplied: {total} attempts"
+            assert total >= 1
+        finally:
+            await cluster.close()
+            for s in servers:
+                await s.stop()
+
+    asyncio.run(main())
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_trips_probes_once_and_reopens_with_backoff():
+    pf = PassiveFilter(fail_threshold=3, cooldown_seconds=10.0)
+    for t in (0, 1, 2):
+        pf.failed("h", now=t)
+    assert not pf.healthy("h", now=3)  # OPEN
+    # Cooldown passes: membership view turns healthy, and exactly ONE
+    # caller gets the probe.
+    assert pf.healthy("h", now=13)
+    assert pf.try_acquire_probe("h", now=13) == "probe"
+    assert pf.try_acquire_probe("h", now=13) is False
+    # Probe fails: re-open with a LONGER (decorrelated) cooldown.
+    pf.failed("h", now=13)
+    s = pf._fails["h"]
+    assert s.open_until > 13 + 10.0 - 1e-9  # at least the base again
+    first_reopen = s.backoff_prev
+    assert first_reopen >= 10.0
+    # Next probe failure grows it again (decorrelated draw >= base).
+    t2 = 13 + first_reopen + 1
+    assert pf.try_acquire_probe("h", now=t2) == "probe"
+    pf.failed("h", now=t2)
+    assert pf._fails["h"].backoff_prev >= 10.0
+    # Probe success closes fully.
+    t3 = t2 + pf._fails["h"].backoff_prev + 1
+    assert pf.try_acquire_probe("h", now=t3) == "probe"
+    pf.succeeded("h")
+    assert pf.healthy("h", now=t3) and pf.try_acquire_probe("h", now=t3) is True
+
+
+def test_breaker_half_open_admits_exactly_one_of_many():
+    """The single-probe property: however many concurrent callers race
+    the half-open transition, exactly one is admitted."""
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=5.0)
+    pf.failed("h", now=0)
+    admitted = [bool(pf.try_acquire_probe("h", now=6.0)) for _ in range(50)]
+    assert sum(admitted) == 1 and admitted[0]
+    # An abandoned probe (cancelled hedge) returns the token.
+    pf.release_probe("h")
+    assert pf.try_acquire_probe("h", now=6.0) == "probe"
+
+
+def test_breaker_stale_failure_streaks_decay():
+    """Sporadic failures hours apart on a low-traffic host must not
+    accumulate into a trip."""
+    pf = PassiveFilter(fail_threshold=2, cooldown_seconds=10.0)
+    pf.failed("h", now=0)
+    pf.failed("h", now=1000)  # way past the cooldown: streak reset
+    assert pf.healthy("h", now=1001)
+
+
+def test_brownout_sheds_to_back_of_order_without_opening():
+    pf = PassiveFilter(brownout_threshold_seconds=0.5)
+    pf.observe("slow:1", True, seconds=2.0)
+    pf.observe("fast:1", True, seconds=0.05)
+    # Slow-but-alive: NOT opened (still healthy for membership)...
+    assert pf.healthy("slow:1") and pf.browned_out("slow:1")
+    # ...but reads walk it last, and the handout shed-set names it.
+    assert pf.order(["slow:1", "fast:1"]) == ["fast:1", "slow:1"]
+    assert pf.unhealthy_hosts() == {"slow:1"}
+    assert REGISTRY.gauge("host_latency_ewma_seconds").value(
+        host="slow:1"
+    ) == pytest.approx(2.0)
+    # EWMA decays as the host recovers; below threshold it rejoins.
+    for _ in range(20):
+        pf.observe("slow:1", True, seconds=0.05)
+    assert not pf.browned_out("slow:1")
+    assert pf.order(["slow:1", "fast:1"]) == ["slow:1", "fast:1"]
+
+
+def test_breaker_order_tiers_open_hosts_last():
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=100.0)
+    pf.failed("dead:1", now=0)
+    # Placement order preserved among healthy; open host shoved last but
+    # never dropped.
+    assert pf.order(["dead:1", "b:1", "a:1"], now=1) == ["b:1", "a:1", "dead:1"]
+
+
+def test_healthcheck_gauges_and_debug_snapshot():
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=100.0,
+                       name="deg-pf")
+    pf.failed("bad:1")
+    assert REGISTRY.gauge("healthcheck_unhealthy_hosts").value(
+        source="deg-pf"
+    ) == 1
+    assert REGISTRY.gauge("breaker_state").value(host="bad:1") == 2  # open
+    snap = debug_snapshot()
+    assert snap["deg-pf"]["hosts"]["bad:1"]["state"] == "open"
+
+    async def active():
+        async def probe(h):
+            return False
+
+        mon = ActiveMonitor(probe, fail_threshold=1, name="deg-mon")
+        await mon.check_all(["x:1"])
+        assert REGISTRY.gauge("healthcheck_unhealthy_hosts").value(
+            source="deg-mon"
+        ) == 1
+        assert debug_snapshot()["deg-mon"]["hosts"]["x:1"]["healthy"] is False
+
+    asyncio.run(active())
+
+
+def test_debug_healthcheck_on_the_metrics_mux():
+    """Operators read breaker verdicts off every component's /debug mux."""
+
+    async def main():
+        from kraken_tpu.utils.metrics import instrument_app
+
+        pf = PassiveFilter(fail_threshold=1, cooldown_seconds=50.0,
+                           name="deg-mux-pf")
+        pf.failed("skipme:1")
+        app = web.Application()
+        instrument_app(app, "deg-mux-test")
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        http = HTTPClient(retries=0)
+        try:
+            doc = json.loads(await http.get(
+                f"http://127.0.0.1:{runner.addresses[0][1]}/debug/healthcheck"
+            ))
+            assert doc["deg-mux-pf"]["hosts"]["skipme:1"]["state"] == "open"
+        finally:
+            await http.close()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# -- hedged reads ------------------------------------------------------------
+
+
+async def _hedge_pair(slow_delay=1.0, hedge_delay=0.05):
+    """Two fake origins; returns (slow, fast, cluster, digest) with the
+    SLOW one first in ring order for the digest."""
+    slow = _FakeOrigin(body=b"S" * 64, delay=slow_delay)
+    fast = _FakeOrigin(body=b"F" * 64)
+    await slow.start()
+    await fast.start()
+    ring = Ring(HostList(static=[slow.addr, fast.addr]), max_replica=2)
+    d = None
+    for i in range(200):
+        cand = Digest.from_bytes(f"hedge-{i}".encode())
+        if ring.locations(cand)[0] == slow.addr:
+            d = cand
+            break
+    assert d is not None
+    cluster = ClusterClient(
+        ring,
+        client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+        hedge_delay_seconds=hedge_delay,
+        component="unit-hedge",
+    )
+    return slow, fast, cluster, d
+
+
+def test_hedge_wins_against_slow_primary_and_loser_is_reaped():
+    async def main():
+        slow, fast, cluster, d = await _hedge_pair()
+        hedges = REGISTRY.counter("rpc_hedges_total")
+        wins = REGISTRY.counter("rpc_hedge_wins_total")
+        h0 = hedges.value(op="download")
+        w0 = wins.value(op="download")
+        try:
+            t0 = time.monotonic()
+            body = await cluster.download(NS, d)
+            wall = time.monotonic() - t0
+            assert body == b"F" * 64  # the hedge's answer won
+            assert wall < 0.8  # nowhere near the 1.0 s brown-out
+            assert hedges.value(op="download") == h0 + 1
+            assert wins.value(op="download") == w0 + 1
+            assert slow.hits == 1 and fast.hits == 1
+            # The loser was cancelled (conftest's task tripwire would
+            # fail this test if its transfer task leaked).
+        finally:
+            await cluster.close()
+            await slow.stop()
+            await fast.stop()
+
+    asyncio.run(main())
+
+
+def test_hedge_lose_failpoint_primary_wins_cleanly():
+    """rpc.hedge.lose delays the hedge: the primary answers first, the
+    hedge is counted but records no win, and its task is reaped."""
+
+    async def main():
+        slow, fast, cluster, d = await _hedge_pair(slow_delay=0.3)
+        failpoints.FAILPOINTS.arm("rpc.hedge.lose", "always+delay:5000")
+        wins = REGISTRY.counter("rpc_hedge_wins_total")
+        w0 = wins.value(op="download")
+        try:
+            body = await cluster.download(NS, d)
+            assert body == b"S" * 64  # primary's answer
+            assert wins.value(op="download") == w0
+        finally:
+            failpoints.FAILPOINTS.disarm_all()
+            await cluster.close()
+            await slow.stop()
+            await fast.stop()
+
+    asyncio.run(main())
+
+
+def test_hedge_disabled_keeps_serial_walk():
+    async def main():
+        slow = _FakeOrigin(body=b"S" * 8, delay=0.2)
+        fast = _FakeOrigin(body=b"F" * 8)
+        await slow.start()
+        await fast.start()
+        ring = Ring(HostList(static=[slow.addr, fast.addr]), max_replica=2)
+        d = next(
+            c for c in (Digest.from_bytes(f"s-{i}".encode()) for i in range(200))
+            if ring.locations(c)[0] == slow.addr
+        )
+        cluster = ClusterClient(
+            ring, client_factory=lambda a: BlobClient(a, HTTPClient(retries=0))
+        )
+        try:
+            assert await cluster.download(NS, d) == b"S" * 8
+            assert fast.hits == 0  # no hedge ever launched
+        finally:
+            await cluster.close()
+            await slow.stop()
+            await fast.stop()
+
+    asyncio.run(main())
+
+
+def test_hedged_stat_falls_through_on_failure():
+    """A dead primary + hedging: the walk still completes (hedge races
+    are an optimization, not a correctness fork)."""
+
+    async def main():
+        fast = _FakeOrigin(body=b"F" * 32)
+        await fast.start()
+        dead_addr = "127.0.0.1:1"  # nothing listens
+        ring = Ring(HostList(static=[dead_addr, fast.addr]), max_replica=2)
+        d = next(
+            c for c in (Digest.from_bytes(f"f-{i}".encode()) for i in range(200))
+            if ring.locations(c)[0] == dead_addr
+        )
+        cluster = ClusterClient(
+            ring,
+            client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+            hedge_delay_seconds=0.05,
+            component="unit-hedge-fail",
+        )
+        try:
+            info = await cluster.stat(NS, d)
+            assert info is not None and info.size == 32
+        finally:
+            await cluster.close()
+            await fast.stop()
+
+    asyncio.run(main())
+
+
+def test_breaker_probe_storm_single_probe_through_cluster():
+    """Half-open probe storm, end to end through the cluster client: a
+    tripped primary whose cooldown just passed sees EXACTLY ONE request
+    from a burst of ten concurrent reads -- the other nine skip to the
+    healthy replica while the (slow) probe is in flight."""
+
+    async def main():
+        flaky = _FakeOrigin(body=b"X" * 16)
+        fast = _FakeOrigin(body=b"X" * 16)
+        await flaky.start()
+        await fast.start()
+        ring = Ring(HostList(static=[flaky.addr, fast.addr]), max_replica=2)
+        d = next(
+            c for c in (Digest.from_bytes(f"p-{i}".encode()) for i in range(200))
+            if ring.locations(c)[0] == flaky.addr
+        )
+        pf = PassiveFilter(fail_threshold=1, cooldown_seconds=0.2)
+        cluster = ClusterClient(
+            ring,
+            client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+            health=pf,
+            component="unit-probe-storm",
+        )
+        try:
+            pf.failed(flaky.addr)  # breaker OPEN
+            await asyncio.sleep(0.25)  # cooldown passes: probe-eligible
+            flaky.delay = 0.3  # the probe is slow; the storm lands NOW
+            results = await asyncio.gather(
+                *(cluster.stat(NS, d) for _ in range(10))
+            )
+            assert all(r is not None and r.size == 16 for r in results)
+            assert flaky.hits == 1, "probe storm leaked past the gate"
+            # The slow-but-successful probe closed the breaker.
+            assert pf.healthy(flaky.addr)
+            assert pf.try_acquire_probe(flaky.addr) is True
+        finally:
+            await cluster.close()
+            await flaky.stop()
+            await fast.stop()
+
+    asyncio.run(main())
+
+
+# -- tracker: announce deadline + handler metering + handout shedding --------
+
+
+def test_announce_timeout_bounds_a_hung_tracker_socket():
+    async def main():
+        srv = _hang_server()
+        await srv.start()
+        peer_id = PeerIDFactory(PeerIDFactory.RANDOM).create("127.0.0.1", 0)
+        tc = TrackerClient(
+            srv.addr, peer_id, "127.0.0.1", 1234,
+            http=HTTPClient(timeout_seconds=0.2, retries=5, backoff=FAST),
+            announce_timeout_seconds=0.3,
+        )
+        meter = REGISTRY.counter("announce_timeouts_total")
+        base = meter.value()
+        try:
+            blob = b"announce"
+            d = Digest.from_bytes(blob)
+            from kraken_tpu.core.metainfo import MetaInfo
+            from kraken_tpu.core.hasher import get_hasher
+
+            mi = MetaInfo(
+                d, len(blob), 64,
+                get_hasher("cpu").hash_pieces(blob, 64).tobytes(),
+            )
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                await tc.announce(d, mi.info_hash, NS, complete=False)
+            assert time.monotonic() - t0 < 2.0  # not 5 x 0.2 + backoffs... and
+            assert meter.value() == base + 1  # ...it is VISIBLE
+        finally:
+            await tc.close()
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_tracker_metainfo_failure_is_metered_not_swallowed(tmp_path):
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+
+        class Exploding:
+            async def get_metainfo(self, ns, d):
+                raise RuntimeError("origin cluster on fire")
+
+        tracker.server.origin_cluster = Exploding()
+        meter = REGISTRY.counter("tracker_handler_errors_total")
+        base = meter.value()
+        http = HTTPClient(retries=0)
+        try:
+            d = Digest.from_bytes(b"somemeta")
+            with pytest.raises(HTTPError) as ei:
+                await http.get(
+                    f"http://{tracker.addr}/namespace/ns/blobs/{d.hex}/metainfo"
+                )
+            assert ei.value.status == 404  # caller contract unchanged
+            assert meter.value() == base + 1  # but the failure is VISIBLE
+        finally:
+            await http.close()
+            await tracker.stop()
+
+    asyncio.run(main())
+
+
+def test_tracker_handout_sheds_unhealthy_origin_peers():
+    from kraken_tpu.core.peer import PeerInfo
+    from kraken_tpu.tracker.server import TrackerServer
+
+    pf = PassiveFilter(fail_threshold=1, cooldown_seconds=100.0)
+    pf.failed("10.0.0.9:7610")  # the origin's HTTP addr trips the breaker
+
+    class FakeCluster:
+        health = pf
+
+    srv = TrackerServer(origin_cluster=FakeCluster())
+    mk = PeerIDFactory(PeerIDFactory.RANDOM)
+    sick_origin = PeerInfo(mk.create("10.0.0.9", 1), "10.0.0.9", 7611,
+                           origin=True, complete=True)
+    ok_origin = PeerInfo(mk.create("10.0.0.8", 1), "10.0.0.8", 7611,
+                         origin=True, complete=True)
+    agent = PeerInfo(mk.create("10.0.0.9", 2), "10.0.0.9", 7612,
+                     origin=False, complete=True)
+    out = srv._shed_unhealthy_origins([sick_origin, agent, ok_origin])
+    # The sick ORIGIN goes last; the agent sharing its IP is untouched
+    # (the breaker knows nothing about agent hosts).
+    assert out[-1] is sick_origin
+    assert out[:2] == [agent, ok_origin]
+
+
+# -- lameduck drain ----------------------------------------------------------
+
+
+def test_origin_lameduck_refuses_new_work_finishes_old(tmp_path):
+    async def main():
+        import aiohttp
+
+        origin = OriginNode(store_root=str(tmp_path / "o"), dedup=False)
+        await origin.start()
+        base = f"http://{origin.addr}"
+        async with aiohttp.ClientSession() as sess:
+            # An upload session opened BEFORE the drain...
+            async with sess.post(
+                f"{base}/namespace/{NS}/blobs/"
+                f"{Digest.from_bytes(b'x').hex}/uploads"
+            ) as r:
+                assert r.status == 200
+                uid = await r.text()
+
+            async with sess.post(f"{base}/debug/lameduck") as r:
+                doc = await r.json()
+                assert doc["lameduck"] is True
+            assert origin.scheduler.lameduck  # p2p plane drains too
+
+            # /health fails -> ring peers and LBs route away.
+            async with sess.get(f"{base}/health") as r:
+                assert r.status == 503
+            # New upload sessions: refused with the retry hint.
+            async with sess.post(
+                f"{base}/namespace/{NS}/blobs/"
+                f"{Digest.from_bytes(b'y').hex}/uploads"
+            ) as r:
+                assert r.status == 503
+                assert r.headers.get("Retry-After")
+            # ...but the in-flight session finishes: PATCH + commit land.
+            blob = os.urandom(2048)
+            d = Digest.from_bytes(blob)
+            async with sess.patch(
+                f"{base}/namespace/{NS}/blobs/{d.hex}/uploads/{uid}",
+                data=blob, headers={"X-Upload-Offset": "0"},
+            ) as r:
+                assert r.status == 204
+            async with sess.put(
+                f"{base}/namespace/{NS}/blobs/{d.hex}/uploads/{uid}/commit"
+            ) as r:
+                assert r.status == 201
+            assert origin.store.in_cache(d)
+            # Reads still serve while draining (the ring needs a beat to
+            # route away; refusing reads would turn a drain into an
+            # availability dip).
+            async with sess.get(f"{base}/namespace/{NS}/blobs/{d.hex}") as r:
+                assert r.status == 200 and await r.read() == blob
+        # Drain with nothing in flight quiesces immediately.
+        t0 = time.monotonic()
+        await origin.drain(timeout=5.0)
+        assert time.monotonic() - t0 < 2.0
+        await origin.stop()
+
+    asyncio.run(main())
+
+
+def test_agent_lameduck_serves_cache_refuses_new_pulls(tmp_path):
+    async def main():
+        import aiohttp
+
+        tracker = TrackerNode(announce_interval_seconds=0.1)
+        await tracker.start()
+        agent = AgentNode(
+            store_root=str(tmp_path / "a"), tracker_addr=tracker.addr
+        )
+        await agent.start()
+        # Seed the agent cache directly: a cache hit during drain.
+        blob = os.urandom(1024)
+        d = Digest.from_bytes(blob)
+        uid = agent.store.create_upload()
+        with open(agent.store.upload_path(uid), "wb") as f:
+            f.write(blob)
+        agent.store.commit_upload(uid, d)
+        base = f"http://{agent.addr}"
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(f"{base}/debug/lameduck") as r:
+                assert (await r.json())["lameduck"] is True
+            async with sess.get(f"{base}/health") as r:
+                assert r.status == 503
+            async with sess.get(f"{base}/readiness") as r:
+                assert r.status == 503
+            # Cache hit: still served (one sendfile, finishes now).
+            async with sess.get(f"{base}/namespace/{NS}/blobs/{d.hex}") as r:
+                assert r.status == 200 and await r.read() == blob
+            # Cache miss would need a NEW swarm pull: refused.
+            miss = Digest.from_bytes(b"not cached")
+            async with sess.get(
+                f"{base}/namespace/{NS}/blobs/{miss.hex}"
+            ) as r:
+                assert r.status == 503
+                assert r.headers.get("Retry-After")
+        await agent.drain(timeout=5.0)
+        await agent.stop()
+        await tracker.stop()
+
+    asyncio.run(main())
+
+
+def test_rpc_reload_applies_live(tmp_path):
+    async def main():
+        origin = OriginNode(
+            store_root=str(tmp_path / "o"), dedup=False,
+            rpc={"announce_timeout_seconds": 5.0},
+        )
+        await origin.start()
+        try:
+            assert origin._tracker_client.announce_timeout == 5.0
+            origin.reload({"rpc": {
+                "announce_timeout_seconds": 1.5,
+                "hedge_delay_seconds": 0.123,
+            }})
+            assert origin._tracker_client.announce_timeout == 1.5
+            assert origin.server.rpc.hedge_delay_seconds == 0.123
+        finally:
+            await origin.stop()
+
+    asyncio.run(main())
