@@ -36,7 +36,7 @@ def main():
     args = ap.parse_args()
 
     t0 = time.time()
-    r = run_sim(
+    kw = dict(
         n_agents=args.agents,
         num_pieces=args.pieces,
         piece_bytes=args.piece_mb << 20,
@@ -47,9 +47,23 @@ def main():
             tuple(int(x) for x in args.layers.split(",")) if args.layers
             else None
         ),
-        restart_at_s=args.restart_at,
-        restart_frac=args.restart_frac,
     )
+    r = run_sim(**kw, restart_at_s=args.restart_at,
+                restart_frac=args.restart_frac)
+    if args.restart_frac > 0 and args.restart_at > 0:
+        # Like-for-like control: the SAME seed and config with the wave
+        # switched off, so "the restart wave cost X seconds of p99" is a
+        # measured delta against an identical swarm, not a comparison
+        # across differently-shaped runs (VERDICT r5 #9).
+        control = run_sim(**kw, restart_at_s=0.0, restart_frac=0.0)
+        r["control_no_wave"] = control
+        if r["p99_s"] is not None and control["p99_s"] is not None:
+            r["restart_wave_p99_delta_s"] = round(
+                r["p99_s"] - control["p99_s"], 3
+            )
+            r["restart_wave_p99_ratio"] = round(
+                r["p99_s"] / control["p99_s"], 3
+            ) if control["p99_s"] else None
     r["bench_wall_s"] = round(time.time() - t0, 2)
     print(json.dumps({
         "metric": f"sim_swarm_pull_p99_s_at_{args.agents}",

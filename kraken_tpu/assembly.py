@@ -38,6 +38,7 @@ from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.deadline import RPCConfig
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter, instrument_app
+from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -113,6 +114,45 @@ def _rpc_config(rpc) -> RPCConfig:
     if isinstance(rpc, RPCConfig):
         return rpc
     return RPCConfig.from_dict(rpc)
+
+
+def _resources_config(resources) -> ResourcesConfig:
+    """Same normalization for the YAML ``resources:`` section."""
+    if isinstance(resources, ResourcesConfig):
+        return resources
+    return ResourcesConfig.from_dict(resources)
+
+
+def _start_sentinel(node, component: str) -> ResourceSentinel:
+    """Build, register, and start a node's resource sentinel. The
+    sustained-breach hook enters lameduck (idempotent, non-blocking):
+    /health flips to 503, the deploy system observes and SIGTERMs for
+    the full drain+stop -- the same operator contract as
+    POST /debug/lameduck."""
+
+    def shed(kinds: list[str]) -> None:
+        REGISTRY.counter(
+            "resource_breach_drains_total",
+            "Lameduck drains entered by the resource sentinel",
+        ).inc(component=component)
+        if node.server is not None:
+            node.server.enter_lameduck()
+        elif node.scheduler is not None:
+            node.scheduler.enter_lameduck()
+
+    sentinel = ResourceSentinel(
+        component,
+        node.resources_config,
+        scheduler=node.scheduler,
+        store=node.store,
+        upload_ttl_seconds=(
+            node.cleanup.config.upload_ttl_seconds
+            if node.cleanup is not None else 6 * 3600
+        ),
+        on_sustained_breach=shed,
+    )
+    sentinel.start()
+    return sentinel
 
 
 async def _drain_node(server, scheduler, timeout: float,
@@ -278,6 +318,7 @@ class OriginNode:
         fsck: bool = True,
         task_timeout_seconds: float = 1800.0,
         rpc: dict | RPCConfig | None = None,
+        resources: dict | ResourcesConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -357,6 +398,11 @@ class OriginNode:
         # Overload & degradation knobs (YAML `rpc:` -- deadlines, hedge
         # delay, brown-out threshold, drain timeout; live-reloadable).
         self.rpc = _rpc_config(rpc)
+        # Resource sentinel (utils/resources.py): periodic fd/RSS/task/
+        # bufpool/conn/orphan audit with YAML budgets (`resources:`);
+        # a sustained breach can opt into the lameduck drain.
+        self.resources_config = _resources_config(resources)
+        self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
         self.monitor: Optional[ActiveMonitor] = None
@@ -499,6 +545,10 @@ class OriginNode:
                 on_corrupt=self._on_scrub_corrupt,
             )
             self.scrubber.start()
+        # Resource sentinel: the in-process fd/RSS/task/orphan auditor
+        # (utils/resources.py); budgets from the YAML `resources:`
+        # section, surfaced on /debug/resources and /metrics.
+        self.sentinel = _start_sentinel(self, "origin")
         # Seed everything already on disk (origin startup behavior). A blob
         # whose metainfo sidecar was lost (partial disk restore, manual
         # cleanup) gets its metainfo REGENERATED -- otherwise it would stay
@@ -561,6 +611,10 @@ class OriginNode:
             )
         if cfg.get("rpc") is not None:
             self.apply_rpc(_rpc_config(cfg["rpc"]))
+        if cfg.get("resources") is not None:
+            self.resources_config = _resources_config(cfg["resources"])
+            if self.sentinel is not None:
+                self.sentinel.apply(self.resources_config)
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -701,6 +755,8 @@ class OriginNode:
             self._cleanup_task.cancel()
         if self._reseed_task:
             self._reseed_task.cancel()
+        if self.sentinel:
+            self.sentinel.stop()
         if self.scrubber:
             self.scrubber.stop()
         for t in list(self._repair_tasks):
@@ -716,6 +772,10 @@ class OriginNode:
             await self._health_http.close()
         if self.server:
             await self.server.close_heal_cluster()
+        # After the listeners are down: no handler can enqueue anymore,
+        # so the retry store's sqlite handle can be released (leak found
+        # by the soak harness's fd audit).
+        self.retry.close()
         # LAST: the clean-shutdown stamp bounds the next boot's fsck
         # crash-window verify to blobs written after this instant.
         await asyncio.to_thread(write_clean_shutdown, self.store)
@@ -779,6 +839,7 @@ class BuildIndexNode:
         self.retry.stop()
         if self._runner:
             await self._runner.cleanup()
+        self.retry.close()
 
 
 class ProxyNode:
@@ -862,6 +923,7 @@ class AgentNode:
         scrub: dict | ScrubConfig | None = None,
         fsck: bool = True,
         rpc: dict | RPCConfig | None = None,
+        resources: dict | ResourcesConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -916,6 +978,9 @@ class AgentNode:
         )
         # Overload & degradation knobs (YAML `rpc:`; live-reloadable).
         self.rpc = _rpc_config(rpc)
+        # Resource sentinel budgets (YAML `resources:`; live-reloadable).
+        self.resources_config = _resources_config(resources)
+        self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
         self.scheduler: Optional[Scheduler] = None
@@ -1006,6 +1071,7 @@ class AgentNode:
                 on_corrupt=self._on_scrub_corrupt,
             )
             self.scrubber.start()
+        self.sentinel = _start_sentinel(self, "agent")
         if self.build_index_addr:
             from kraken_tpu.buildindex.server import TagClient
             from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -1037,6 +1103,10 @@ class AgentNode:
                     self.rpc.announce_timeout_seconds
                 )
             _log.info("rpc config reloaded", extra={"node": self.addr})
+        if cfg.get("resources") is not None:
+            self.resources_config = _resources_config(cfg["resources"])
+            if self.sentinel is not None:
+                self.sentinel.apply(self.resources_config)
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,
@@ -1056,6 +1126,8 @@ class AgentNode:
             self.scheduler.enter_lameduck()
         if self._cleanup_task:
             self._cleanup_task.cancel()
+        if self.sentinel:
+            self.sentinel.stop()
         if self.scrubber:
             self.scrubber.stop()
         if self.scheduler:

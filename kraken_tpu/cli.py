@@ -383,6 +383,13 @@ def main(argv: list[str] | None = None) -> None:
         scrub_cfg["bytes_per_second"] = args.scrub_bps
     fsck_enabled = bool(cfg.get("fsck", True))
 
+    # YAML: resources: {interval_seconds, max_open_fds, max_rss_mb,
+    # max_tasks, max_bufpool_leased, max_conns, max_orphans,
+    # breach_streak, drain_on_breach} -- the resource sentinel's sample
+    # period and budgets (docs/OPERATIONS.md "Resource budgets"). Absent
+    # = observe-only defaults; SIGHUP live-reloads budgets.
+    resources_cfg = cfg.get("resources")
+
     # YAML: tls: {cert: path, key: path[, client_ca: path]} -- terminate
     # TLS on the HTTP listener (the reference fronts components with
     # nginx; here the listener itself terminates). With ``client_ca`` the
@@ -558,6 +565,7 @@ def main(argv: list[str] | None = None) -> None:
                 cfg.get("task_timeout_seconds", 1800.0)
             ),
             rpc=rpc_cfg,
+            resources=resources_cfg,
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -598,6 +606,7 @@ def main(argv: list[str] | None = None) -> None:
             scrub=scrub_cfg,
             fsck=fsck_enabled,
             rpc=rpc_cfg,
+            resources=resources_cfg,
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -269,7 +269,7 @@ class JaxPieceHasher(PieceHasher):
             ]
 
         if tail:
-            tail_digests = self.hash_batch(tail)
+            tail_digests = self._hash_batch_raw(tail)
             if outs:
                 out = np.concatenate(
                     [_digest_bytes(jnp.concatenate(outs)), tail_digests]
@@ -289,6 +289,24 @@ class JaxPieceHasher(PieceHasher):
     # -- arbitrary piece batch (agent verify hot loop) ---------------------
 
     def hash_batch(self, pieces: list[bytes | memoryview]) -> np.ndarray:
+        if not pieces:
+            return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        start = time.perf_counter()
+        out = self._hash_batch_raw(pieces)
+        # The agent VERIFY loop is the other north-star hot path: a TPU
+        # agent that never moves hasher_bytes_total{hasher="tpu"} is
+        # indistinguishable from one silently verifying on the CPU
+        # (exactly the gap the live-wire e2e test pins). Recording lives
+        # HERE, not in _hash_batch_raw: hash_pieces routes its ragged
+        # tail through the raw variant and records the blob's FULL total
+        # itself -- metrics here too would double-count the tail.
+        _record_hash_metrics(
+            "tpu", sum(len(memoryview(p)) for p in pieces), len(pieces),
+            time.perf_counter() - start,
+        )
+        return out
+
+    def _hash_batch_raw(self, pieces: list[bytes | memoryview]) -> np.ndarray:
         if not pieces:
             return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
         views = [memoryview(p) for p in pieces]

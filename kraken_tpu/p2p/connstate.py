@@ -49,10 +49,35 @@ class Blacklist:
     """Peers that misbehaved (bad pieces, handshake errors, conn churn);
     entries expire with exponential backoff on repeat offenses."""
 
+    # Expunge cadence: every N adds, sweep entries long past expiry.
+    # Amortized O(1) per add; keeps the map bounded on a long-lived node
+    # churning torrents forever (the soak harness's leak audit caught
+    # the append-only original -- every soft-blacklisted dial to a busy
+    # seeder stayed resident for the process lifetime).
+    _EXPUNGE_EVERY = 256
+    # Entries linger this many multiples of max backoff past expiry so a
+    # repeat offender re-appearing shortly after its ban still escalates
+    # instead of starting fresh.
+    _EXPUNGE_GRACE_FACTOR = 2.0
+
     def __init__(self, config: ConnStateConfig):
         self._config = config
         # (peer, info_hash) -> (until_ts, offense_count)
         self._entries: dict[tuple[PeerID, InfoHash], tuple[float, int]] = {}
+        self._adds_since_expunge = 0
+
+    def _maybe_expunge(self, now: float) -> None:
+        self._adds_since_expunge += 1
+        if self._adds_since_expunge < self._EXPUNGE_EVERY:
+            return
+        self._adds_since_expunge = 0
+        grace = (
+            self._config.blacklist_backoff.max_seconds
+            * self._EXPUNGE_GRACE_FACTOR
+        )
+        for key, (until, _count) in list(self._entries.items()):
+            if now - until > grace:
+                del self._entries[key]
 
     def add(
         self, peer: PeerID, h: InfoHash, now: float | None = None,
@@ -63,6 +88,7 @@ class Blacklist:
         a full seeder must retry within seconds, not back off for minutes
         like a peer that served corrupt pieces."""
         now = time.monotonic() if now is None else now
+        self._maybe_expunge(now)
         _until, count = self._entries.get((peer, h), (0.0, 0))
         if soft:
             delay = self._config.soft_blacklist_seconds
@@ -161,3 +187,9 @@ class ConnState:
     def clear_torrent(self, h: InfoHash) -> None:
         self._pending.pop(h, None)
         self._active.pop(h, None)
+        # Blacklist rows deliberately survive the torrent: the same
+        # blob re-pulled after eviction has the SAME info_hash, so a
+        # corrupt peer's escalating verdict must greet the re-pull, not
+        # reset with every eviction cycle. Boundedness comes from the
+        # amortized expired-entry expunge above, which keeps escalation
+        # memory for the grace window and no longer.

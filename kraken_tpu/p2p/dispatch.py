@@ -81,6 +81,15 @@ class Dispatcher:
         self.done: asyncio.Future[None] = (
             asyncio.get_running_loop().create_future()
         )
+        # Per-torrent lifecycle counters for the completion summary
+        # (networkevent torrent_summary -- torrentlog parity): every
+        # payload byte in/out, every peer ever adopted, every
+        # blacklist-feeding drop.
+        self._created = asyncio.get_running_loop().time()
+        self._bytes_down = 0
+        self._bytes_up = 0
+        self._peers_seen: set[PeerID] = set()
+        self._blacklist_events = 0
         if torrent.complete():
             self.done.set_result(None)
 
@@ -106,10 +115,12 @@ class Dispatcher:
             has = _bits_to_set(peer_bitfield, self.torrent.num_pieces)
         except PieceError as e:
             conn.close()
+            self._blacklist_events += 1  # the summary counts EVERY ban
             self._on_peer_failure(conn.peer_id, str(e))
             return False
         peer = _Peer(conn, has, asyncio.get_running_loop().time())
         self._peers[conn.peer_id] = peer
+        self._peers_seen.add(conn.peer_id)
         if hasattr(conn, "set_payload_handler"):
             # Hot-path: the conn's recv loop hands PIECE_PAYLOAD frames
             # here synchronously, bypassing the recv queue + pump await
@@ -136,6 +147,7 @@ class Dispatcher:
         if peer.pump is not None:
             peer.pump.cancel()
         if reason:
+            self._blacklist_events += 1
             self._on_peer_failure(peer_id, reason)
         if not self._peers:
             # No live conns -> shed the cached fd (reopened on the next
@@ -298,6 +310,7 @@ class Dispatcher:
     async def _serve_piece(self, peer: _Peer, idx: int) -> None:
         data = await self.torrent.read_piece_async(idx)
         await peer.conn.send(Message.piece_payload(idx, data))
+        self._bytes_up += len(data)
         # A completed send is progress: an honest-but-slow link keeps
         # earning its churn exemption one delivered piece at a time.
         peer.last_useful = asyncio.get_running_loop().time()
@@ -342,6 +355,7 @@ class Dispatcher:
             "receive_piece", self.torrent.info_hash.hex,
             peer=peer.conn.peer_id.hex, piece=idx, size=len(data),
         )
+        self._bytes_down += len(data)
         if self.torrent.has_piece(idx):
             self.requests.clear_piece(idx)
             await self._request_more(peer)
@@ -361,6 +375,23 @@ class Dispatcher:
                 self.events.emit(
                     "torrent_complete", self.torrent.info_hash.hex,
                     blob=self.torrent.metainfo.digest.hex,
+                )
+                # The lifecycle rollup, once, at the moment of
+                # completion: bytes_up keeps counting afterwards (the
+                # peer seeds on), but the download story -- how long,
+                # from how many peers, against how much misbehavior --
+                # is settled exactly here.
+                now = asyncio.get_running_loop().time()
+                self.events.emit(
+                    "torrent_summary", self.torrent.info_hash.hex,
+                    blob=self.torrent.metainfo.digest.hex,
+                    pieces=self.torrent.num_pieces,
+                    length=self.torrent.metainfo.length,
+                    peers=len(self._peers_seen),
+                    bytes_down=self._bytes_down,
+                    bytes_up=self._bytes_up,
+                    duration_s=round(now - self._created, 3),
+                    blacklist_events=self._blacklist_events,
                 )
             for other in list(self._peers.values()):
                 try:

@@ -21,6 +21,12 @@ class Name:
     REQUEST_PIECE = "request_piece"
     RECEIVE_PIECE = "receive_piece"
     TORRENT_COMPLETE = "torrent_complete"
+    # One structured line per completed download with the operative
+    # numbers (pieces, peers used, bytes up/down, duration, blacklist
+    # events) -- the reference's per-torrent torrentlog summary, riding
+    # the same JSONL stream so offline swarm analysis gets lifecycle
+    # rollups without re-deriving them from the piece events.
+    TORRENT_SUMMARY = "torrent_summary"
     ANNOUNCE = "announce"
 
 

@@ -161,8 +161,12 @@ class ShardedPieceHasher(PieceHasher):
                     )
                 )
             )
-        if n > n_full:  # ragged tail piece
-            out.append(self._fallback.hash_batch([view[n_full * piece_length :]]))
+        if n > n_full:  # ragged tail piece (raw: this call records the
+            # blob's FULL total below -- the metric-wrapping hash_batch
+            # would double-count the tail bytes under hasher="tpu")
+            out.append(
+                self._fallback._hash_batch_raw([view[n_full * piece_length :]])
+            )
         # Same north-star gauges as the single-chip hashers (GB/s,
         # occupancy) -- a sharded origin must not go dark on dashboards.
         record_hash_metrics(

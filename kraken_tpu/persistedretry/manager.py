@@ -272,3 +272,14 @@ class Manager:
     def stop(self) -> None:
         if self._task:
             self._task.cancel()
+
+    def close(self) -> None:
+        """Release the task store's sqlite handle. Call AFTER stop()
+        and after the node's listeners are down: a request handler
+        mid-commit may still enqueue until then, and the poll task's
+        cancellation lands at its next await -- neither touches the DB
+        afterwards (it lives on the loop thread). Without this, every
+        node start/stop cycle strands one sqlite fd -- the exact slow
+        EMFILE class the resource sentinel + soak harness exist to
+        catch (and did)."""
+        self.store.close()

@@ -387,6 +387,15 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
                 _profile_lock.release()
         return web.json_response({"trace_dir": out_dir, "seconds": seconds})
 
+    async def resources_endpoint(request):
+        # "What is this process holding": fds, RSS, task census by
+        # creation site, bufpool leases, conns, store debris -- plus
+        # every node sentinel's budgets and breach state
+        # (utils/resources.py; docs/OPERATIONS.md "Resource budgets").
+        from kraken_tpu.utils.resources import debug_snapshot as resources_snap
+
+        return web.json_response(resources_snap())
+
     async def healthcheck_endpoint(request):
         # "Why is this replica being skipped": every live health filter
         # and breaker in the process, with per-host state, consecutive
@@ -447,6 +456,7 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/debug/healthcheck", healthcheck_endpoint)
+    app.router.add_get("/debug/resources", resources_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)
     app.router.add_get("/debug/jax-profile", jax_profile_endpoint)
     app.router.add_get("/debug/failpoints", failpoints_get)

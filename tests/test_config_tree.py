@@ -26,7 +26,7 @@ CLI_KEYS = {
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
-    "task_timeout_seconds", "rpc",
+    "task_timeout_seconds", "rpc", "resources",
 }
 
 
@@ -126,6 +126,29 @@ def test_rpc_sections_construct_rpc_config():
         assert cfg.request_deadline_seconds > 0, path
         seen += 1
     assert seen >= 3  # agent + origin + tracker ship the rpc knobs
+
+
+def test_resources_sections_construct_resources_config():
+    """Every shipped `resources:` section (sentinel period + budgets)
+    must map onto ResourcesConfig through the same from_dict the
+    CLI/assembly use -- a typo'd budget knob must fail here, not at
+    production boot (where it would silently disable the sentinel's
+    teeth)."""
+    from kraken_tpu.utils.resources import ResourcesConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        rc = load_config(path).get("resources")
+        if not rc:
+            continue
+        cfg = ResourcesConfig.from_dict(rc)  # raises on unknown keys
+        assert cfg.interval_seconds > 0, path
+        assert cfg.breach_streak >= 1, path
+        # Shipped defaults must be observe-only: budgets that drain by
+        # default would shed healthy nodes on under-provisioned rigs.
+        assert cfg.drain_on_breach is False, path
+        seen += 1
+    assert seen >= 2  # agent + origin ship the sentinel knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -142,6 +142,55 @@ def test_blacklist_backoff_expiry():
     assert not cs.can_dial(pid(2), h) is False  # unrelated peer unaffected
 
 
+def test_blacklist_bounded_under_torrent_churn():
+    """Fleet-survival regression (found by the soak harness's leak
+    audit): blacklist entries must not accumulate forever on a node
+    churning torrents -- long-expired verdicts expunge on an amortized
+    sweep, and a removed torrent's rows go with it."""
+    from kraken_tpu.utils.backoff import Backoff
+
+    cfg = ConnStateConfig()
+    cfg.blacklist_backoff = Backoff(
+        base_seconds=1, factor=2, max_seconds=10, jitter=0
+    )
+    cs = ConnState(cfg)
+    bl = cs.blacklist
+
+    def ihx(i: int) -> InfoHash:
+        return InfoHash(f"{i:064x}")
+
+    # Thousands of distinct (peer, torrent) bans land early, then the
+    # node keeps running: once adds continue far past their expiry (and
+    # the escalation grace), the amortized sweep must reclaim the old
+    # verdicts instead of retaining every (peer, torrent) pair forever.
+    for i in range(2000):
+        bl.add(pid(i % 50), ihx(i), now=float(i) * 0.001)
+    assert len(bl._entries) == 2000  # nothing expired yet: all kept
+    for i in range(bl._EXPUNGE_EVERY + 1):  # guarantees one sweep fires
+        bl.add(pid(i % 50), ihx(10_000 + i), now=10_000.0)
+    assert len(bl._entries) <= 2 * bl._EXPUNGE_EVERY
+
+    # Verdicts SURVIVE clear_torrent: an evicted blob re-pulled later
+    # has the same info_hash, and a corrupt peer's escalation must
+    # greet the re-pull instead of resetting every eviction cycle.
+    h, h2 = ihx(12345), ihx(12346)
+    bl.add(pid(1), h, now=10_000.0)
+    bl.add(pid(1), h2, now=10_000.0)
+    cs.clear_torrent(h)
+    assert bl.blocked(pid(1), h, now=10_000.5)
+    assert bl.blocked(pid(1), h2, now=10_000.5)
+
+    # Recent (within the escalation grace) entries survive the sweep,
+    # so a repeat offender still escalates.
+    bl2 = ConnState(cfg).blacklist
+    bl2.add(pid(1), ih(1), now=0.0)  # expires at 1.0
+    for i in range(bl2._EXPUNGE_EVERY + 1):
+        bl2.add(pid(2), ih(2), now=5.0)  # sweep runs at now=5
+    assert (pid(1), ih(1)) in bl2._entries  # 4 s past expiry < 20 s grace
+    bl2.add(pid(1), ih(1), now=5.0)
+    assert bl2._entries[(pid(1), ih(1))][1] == 2  # escalated, not reset
+
+
 # -- piecerequest -----------------------------------------------------------
 
 def test_request_manager_pipeline_and_dedup():

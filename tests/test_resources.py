@@ -1,0 +1,358 @@
+"""Resource sentinel tests (kraken_tpu/utils/resources.py).
+
+The sentinel is the fleet-survival plane's eyes: these pin the sampling
+primitives (fd/RSS/task census), the orphan-scan classification against
+LIVE store state (an active upload or a resumable ``.part`` must never
+read as debris), budget-breach firing + the sustained-breach latch that
+enters lameduck, live reload, and the ``/debug/resources`` surface on
+real assembled nodes.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.store import CAStore, PieceStatusMetadata
+from kraken_tpu.store.metadata import NamespaceMetadata
+from kraken_tpu.utils.metrics import REGISTRY
+from kraken_tpu.utils.resources import (
+    ResourceSentinel,
+    ResourcesConfig,
+    open_fd_count,
+    rss_bytes,
+    scan_store_orphans,
+    task_census,
+)
+
+
+def _breaches(kind: str) -> float:
+    return REGISTRY.counter("resource_budget_breaches_total").value(kind=kind)
+
+
+# -- config ----------------------------------------------------------------
+
+def test_resources_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="max_open_fdz"):
+        ResourcesConfig.from_dict({"max_open_fdz": 10})
+    cfg = ResourcesConfig.from_dict(None)
+    assert cfg.interval_seconds > 0 and cfg.breach_streak >= 1
+
+
+# -- process probes --------------------------------------------------------
+
+def test_process_probes_report_positive_numbers():
+    fds = open_fd_count()
+    rss = rss_bytes()
+    assert fds is not None and fds > 0
+    assert rss is not None and rss > (1 << 20)
+
+
+def test_fd_probe_tracks_an_actual_open():
+    before = open_fd_count()
+    with open(os.devnull):
+        during = open_fd_count()
+    after = open_fd_count()
+    assert during == before + 1
+    assert after == before
+
+
+def test_task_census_tags_by_creation_site():
+    async def main():
+        async def leaky_worker():
+            await asyncio.sleep(30)
+
+        tasks = [asyncio.create_task(leaky_worker()) for _ in range(3)]
+        await asyncio.sleep(0)
+        total, top = task_census()
+        for t in tasks:
+            t.cancel()
+        return total, top
+
+    total, top = asyncio.run(main())
+    assert total >= 3
+    site = next((s for s in top if "leaky_worker" in s), None)
+    assert site is not None, f"no leaky_worker site in {top}"
+    assert top[site] == 3
+    # The tag is greppable: file, line, qualname.
+    assert "test_resources.py" in site and ":" in site
+
+
+# -- orphan scan -----------------------------------------------------------
+
+def _backdate(path: str, seconds: float) -> None:
+    t = time.time() - seconds
+    os.utime(path, (t, t))
+
+
+def test_orphan_scan_counts_only_real_debris(tmp_path):
+    store = CAStore(str(tmp_path / "s"))
+
+    # Committed healthy blob + its namespace sidecar: never debris.
+    blob = os.urandom(1000)
+    d = Digest.from_bytes(blob)
+    store.create_cache_file(d, iter([blob]))
+    store.set_metadata(d, NamespaceMetadata("ns"))
+    _backdate(store.cache_path(d), 7200)
+
+    # LIVE upload spool (fresh mtime) vs abandoned one (idle past TTL).
+    store.create_upload()
+    stale_uid = store.create_upload()
+    _backdate(store.upload_path(stale_uid), 7200)
+
+    # Resumable in-progress download: ``.part`` + piece-bitfield
+    # sidecar. NEVER debris while the .part is fresh -- and the sidecar
+    # stays protected even when backdated, as long as its .part exists.
+    d2 = Digest.from_bytes(b"partial")
+    store.allocate_partial_file(d2, 4096)
+    store.set_metadata(d2, PieceStatusMetadata(4))
+    md_path = store._md_path(store.cache_path(d2), PieceStatusMetadata.name)
+    _backdate(md_path, 7200)
+
+    # True orphan sidecar: no data file, no .part beside it.
+    d3 = Digest.from_bytes(b"ghost")
+    orphan = store._md_path(store.cache_path(d3), "namespace")
+    os.makedirs(os.path.dirname(orphan), exist_ok=True)
+    with open(orphan, "wb"):
+        pass
+    _backdate(orphan, 7200)
+
+    # tmp-sidecar survivor (crashed set_metadata).
+    tmp_md = store.cache_path(d) + "._md_namespace.tmp999.1"
+    with open(tmp_md, "wb"):
+        pass
+    _backdate(tmp_md, 7200)
+
+    counts = scan_store_orphans(
+        store, upload_ttl_seconds=3600, min_age_seconds=60
+    )
+    assert counts["stale_spool"] == 1  # the live spool is NOT counted
+    assert counts["stale_partial"] == 0  # fresh .part = active download
+    assert counts["orphan_sidecar"] == 1  # d3 only; d2's bitfield spared
+    assert counts["tmp_sidecar"] == 1
+    assert counts["quarantine"] == 0
+
+    # The .part past the TTL becomes debris (fsck's sweep rule); its
+    # bitfield sidecar still is not counted while the .part exists.
+    _backdate(store.partial_path(d2), 7200)
+    counts = scan_store_orphans(
+        store, upload_ttl_seconds=3600, min_age_seconds=60
+    )
+    assert counts["stale_partial"] == 1
+    assert counts["orphan_sidecar"] == 1
+
+    # Quarantined blobs count (operator-visible damage evidence).
+    store.quarantine_cache_file(d)
+    counts = scan_store_orphans(
+        store, upload_ttl_seconds=3600, min_age_seconds=60
+    )
+    assert counts["quarantine"] == 1
+
+    # Fresh debris under min_age is invisible: the live-race guard (a
+    # sidecar between write and rename must not read as an orphan).
+    fresh = store._md_path(store.cache_path(Digest.from_bytes(b"x")), "namespace")
+    os.makedirs(os.path.dirname(fresh), exist_ok=True)
+    with open(fresh, "wb"):
+        pass
+    c2 = scan_store_orphans(store, upload_ttl_seconds=3600, min_age_seconds=60)
+    assert c2["orphan_sidecar"] == counts["orphan_sidecar"]
+
+
+# -- budgets, streaks, latch, reload ---------------------------------------
+
+def test_budget_breach_counts_and_sustained_hook_latches():
+    fired: list[list[str]] = []
+
+    async def main():
+        sentinel = ResourceSentinel(
+            "test-node",
+            {"max_tasks": 1, "breach_streak": 2, "drain_on_breach": True,
+             "interval_seconds": 999},
+            on_sustained_breach=fired.append,
+        )
+        try:
+            async def sleeper():
+                await asyncio.sleep(30)
+
+            tasks = [asyncio.create_task(sleeper()) for _ in range(3)]
+            await asyncio.sleep(0)
+            before = _breaches("tasks")
+
+            s1 = await sentinel.sample()
+            assert "tasks" in s1["breached"]
+            assert fired == []  # streak 1 < breach_streak 2
+            s2 = await sentinel.sample()
+            assert "tasks" in s2["breached"]
+            assert len(fired) == 1 and fired[0] == ["tasks"]
+            await sentinel.sample()
+            assert len(fired) == 1  # latched: no re-fire while breached
+            assert _breaches("tasks") == before + 3  # every breach counts
+
+            # Live reload: raising the budget clears the breach (and the
+            # latch); dropping it again re-arms the hook.
+            sentinel.apply({"max_tasks": 10_000, "breach_streak": 2,
+                            "drain_on_breach": True, "interval_seconds": 999})
+            s4 = await sentinel.sample()
+            assert s4["breached"] == []
+            sentinel.apply({"max_tasks": 1, "breach_streak": 2,
+                            "drain_on_breach": True, "interval_seconds": 999})
+            await sentinel.sample()
+            await sentinel.sample()
+            assert len(fired) == 2
+
+            for t in tasks:
+                t.cancel()
+        finally:
+            sentinel.stop()
+
+    asyncio.run(main())
+
+
+def test_drain_on_breach_false_never_fires_hook():
+    fired = []
+
+    async def main():
+        sentinel = ResourceSentinel(
+            "observe-only",
+            {"max_tasks": 0, "max_open_fds": 1, "breach_streak": 1,
+             "drain_on_breach": False, "interval_seconds": 999},
+            on_sustained_breach=fired.append,
+        )
+        try:
+            before = _breaches("fds")
+            s = await sentinel.sample()
+            assert "fds" in s["breached"]  # any real process has > 1 fd
+            assert _breaches("fds") == before + 1
+            assert fired == []  # counted + warned, never drained
+        finally:
+            sentinel.stop()
+
+    asyncio.run(main())
+
+
+# -- live nodes: /debug/resources + breach -> lameduck ---------------------
+
+def test_debug_resources_and_breach_drain_on_live_nodes(tmp_path):
+    from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    async def main():
+        import json
+
+        tracker = TrackerNode()
+        await tracker.start()
+        # Origin: observe-only budgets -- a forced fd breach counts but
+        # must NOT drain.
+        origin = OriginNode(
+            store_root=str(tmp_path / "o"),
+            tracker_addr=tracker.addr,
+            dedup=False,
+            resources={"interval_seconds": 999, "max_open_fds": 1,
+                       "breach_streak": 1, "drain_on_breach": False},
+        )
+        await origin.start()
+        # Agent: task budget with teeth -- a sustained breach enters
+        # lameduck (the leaking-node-sheds-itself contract).
+        agent = AgentNode(
+            store_root=str(tmp_path / "a"),
+            tracker_addr=tracker.addr,
+            resources={"interval_seconds": 999, "max_tasks": 1,
+                       "breach_streak": 1, "drain_on_breach": True},
+        )
+        await agent.start()
+        http = HTTPClient()
+        try:
+            # The debug surface is live on BOTH muxes and carries the
+            # process probes plus each node's sentinel.
+            for node in (origin, agent):
+                doc = json.loads(
+                    await http.get(f"http://{node.addr}/debug/resources")
+                )
+                assert doc["process"]["open_fds"] > 0
+                assert doc["process"]["rss_bytes"] > 0
+                comps = {
+                    s["last_sample"]["component"] if s["last_sample"] else None
+                    for s in doc["sentinels"].values()
+                }
+                names = {k.split("/")[0] for k in doc["sentinels"]}
+                assert {"origin", "agent"} <= names, (comps, names)
+
+            # Forced origin fd breach: counter moves, no drain.
+            before = _breaches("fds")
+            s = await origin.sentinel.sample()
+            assert "fds" in s["breached"]
+            assert _breaches("fds") == before + 1
+            assert origin.server.lameduck is False
+            ok = await http.get(f"http://{origin.addr}/health")
+            assert ok == b"ok"
+
+            # Forced agent task breach: sustained (streak 1) -> the node
+            # sheds itself. /health flips to 503 and new pulls refuse.
+            s = await agent.sentinel.sample()
+            assert "tasks" in s["breached"]
+            assert agent.server.lameduck is True
+            from kraken_tpu.utils.httputil import HTTPError
+
+            with pytest.raises(HTTPError) as ei:
+                await http.get(f"http://{agent.addr}/health", retry_5xx=False)
+            assert ei.value.status == 503
+            assert REGISTRY.counter("resource_breach_drains_total").value(
+                component="agent"
+            ) >= 1
+            # The drain shows on the debug surface too.
+            doc = json.loads(
+                await http.get(f"http://{agent.addr}/debug/resources")
+            )
+            assert any(
+                v["breach_latched"] for v in doc["sentinels"].values()
+            )
+        finally:
+            await http.close()
+            await agent.stop()
+            await origin.stop()
+            await tracker.stop()
+
+    asyncio.run(main())
+
+
+def test_sentinel_samples_node_planes(tmp_path):
+    """The sentinel's sample carries the node's OWN planes: bufpool
+    lease counts from its scheduler and debris from its store."""
+    from kraken_tpu.assembly import AgentNode, TrackerNode
+
+    async def main():
+        tracker = TrackerNode()
+        await tracker.start()
+        agent = AgentNode(
+            store_root=str(tmp_path / "a"),
+            tracker_addr=tracker.addr,
+            resources={"interval_seconds": 999,
+                       "orphan_min_age_seconds": 0.0},
+        )
+        await agent.start()
+        try:
+            # Plant one provable orphan sidecar in the agent's store.
+            ghost = agent.store._md_path(
+                agent.store.cache_path(Digest.from_bytes(b"ghost")),
+                "namespace",
+            )
+            os.makedirs(os.path.dirname(ghost), exist_ok=True)
+            with open(ghost, "wb"):
+                pass
+            _backdate(ghost, 10)
+            s = await agent.sentinel.sample()
+            assert s["orphans"]["orphan_sidecar"] == 1
+            assert s["orphans_total"] == 1
+            assert s["bufpool_leased"] == 0
+            assert s["conns"] == 0
+            assert s["open_fds"] > 0 and s["tasks"] > 0
+            assert REGISTRY.gauge("resource_orphan_files").value(
+                component="agent", kind="orphan_sidecar"
+            ) == 1
+        finally:
+            await agent.stop()
+            await tracker.stop()
+
+    asyncio.run(main())

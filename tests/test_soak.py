@@ -1,24 +1,51 @@
-"""Chaos soak: continuous push/pull traffic WHILE an origin dies and
-revives. Every other failure test freezes the world around one injected
-fault; real clusters take faults under load. This drives the whole stack
--- chunked uploads, ring replication, P2P pulls through agents, repair --
-concurrently with the outage and asserts nothing is lost and nothing is
-corrupt at the end.
+"""Two-tier soak harness: the long-lived-fleet survival tests.
 
-Kept to ~15 s wall so it stays in the default suite; crank BLOBS /
-durations for a longer manual soak.
+Tier 1 (unmarked, ~20 s): the chaos soak -- continuous push/pull traffic
+WHILE an origin dies and revives -- now closed out by a resource audit.
+Every other failure test freezes the world around one injected fault;
+real clusters take faults under load, and real fleets die of what the
+fault tests never measure: the fd that didn't close, the task that was
+never reaped, the pooled buffer that never came back, the spool file
+nobody swept. The sentinel (kraken_tpu/utils/resources.py) is the
+oracle: after the drive, fd delta 0, bufpool fully returned, stores
+free of debris -- and the conftest task tripwire asserts zero leaked
+asyncio tasks.
+
+Tier 2 (``slow`` + ``soak`` markers, gated on ``KT_SOAK=1``,
+5-10 min): the origin soak a production fleet hits weekly but no test
+runs -- conn churn, watermark eviction, repeated torrent
+create/teardown, seeded failpoints (disconnects, announce errors,
+ENOSPC mid-PATCH) -- asserting fd count stable, RSS slope ~ 0 by least
+squares over the sentinel's sample history, and a clean store at exit:
+
+    KT_SOAK=1 python -m pytest tests/test_soak.py -q -m slow
+
+``KT_SOAK_SECONDS`` overrides the default 600 s load window (shorter
+windows measure the allocator warm-up ramp, not steady state -- see the
+``rss_curve_mb`` report field). Measured numbers are recorded in
+PERF.md ("Fleet-survival soak").
 """
 
 import asyncio
+import gc
+import json
 import os
+import random
 import socket
+import time
+
+import pytest
 
 from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.origin.metainfogen import PieceLengthConfig
 from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.placement.healthcheck import PassiveFilter
+from kraken_tpu.store.cleanup import CleanupConfig
+from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+from kraken_tpu.utils.resources import open_fd_count, scan_store_orphans
 
 BLOBS = 14
 BLOB_BYTES = 96_000
@@ -30,7 +57,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _origin(tmp_path, name, addrs, port):
+def _origin(tmp_path, name, addrs, port, **kw):
     node = OriginNode(
         store_root=str(tmp_path / name),
         http_port=port,
@@ -39,15 +66,62 @@ def _origin(tmp_path, name, addrs, port):
         dedup=False,
         health_interval_seconds=0.2,
         health_fail_threshold=2,
+        **kw,
     )
     return node
 
+
+async def _settle_fds(baseline: int, seconds: float = 5.0) -> int:
+    """Wait (bounded) for deferred closes -- transports retired via
+    call_soon, lingering objects waiting on GC -- then return the fd
+    count. The soak asserts against BASELINE, so a leak fails after the
+    full grace, never flakily before it."""
+    deadline = time.monotonic() + seconds
+    while True:
+        gc.collect()
+        n = open_fd_count()
+        if n is not None and n <= baseline:
+            return n
+        if time.monotonic() >= deadline:
+            return n
+        await asyncio.sleep(0.1)
+
+
+def _strict_debris(store) -> dict:
+    """Post-teardown debris scan: NOTHING transient is acceptable once a
+    node has stopped cleanly, so every class counts at any age."""
+    return scan_store_orphans(
+        store, upload_ttl_seconds=0.001, min_age_seconds=0.0
+    )
+
+
+def _lsq_slope(points: list[tuple[float, float]]) -> float:
+    """Least-squares slope (units/second) over (t, value) samples."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    mt = sum(t for t, _ in points) / n
+    mv = sum(v for _, v in points) / n
+    denom = sum((t - mt) ** 2 for t, _ in points)
+    if denom == 0:
+        return 0.0
+    return sum((t - mt) * (v - mv) for t, v in points) / denom
+
+
+# -- tier 1: chaos mini-soak with the resource audit -----------------------
 
 def test_soak_push_pull_through_origin_outage(tmp_path):
     asyncio.run(_drive(tmp_path))
 
 
 async def _drive(tmp_path):
+    # The fd baseline is taken INSIDE the loop (the loop's own epoll and
+    # self-pipe fds exist on both sides of the measurement) before any
+    # node exists; after teardown the process must be back to exactly
+    # this number -- the whole-stack fd-hygiene contract.
+    gc.collect()
+    fd_baseline = open_fd_count()
+
     ports = [_free_port() for _ in range(3)]
     addrs = [f"127.0.0.1:{p}" for p in ports]
 
@@ -57,12 +131,24 @@ async def _drive(tmp_path):
         ring_refresh_seconds=0.2,
     )
     await tracker.start()
+    # Spool hygiene is part of the soak contract: the victim origin dies
+    # mid-upload, stranding a spool file its client will never commit.
+    # The production wall-clock sweep must reclaim it before the final
+    # audit -- the same plane that keeps a real origin's upload/ dir
+    # bounded (store/cleanup.py).
+    cleanup = CleanupConfig(
+        tti_seconds=3600.0,
+        interval_seconds=0.5,
+        upload_ttl_seconds=3.0,
+    )
     origins = {}
+    all_nodes = []
     for i in range(3):
-        n = _origin(tmp_path, f"o{i}", addrs, ports[i])
+        n = _origin(tmp_path, f"o{i}", addrs, ports[i], cleanup=cleanup)
         n.tracker_addr = tracker.addr
         await n.start()
         origins[i] = n
+        all_nodes.append(n)
 
     health = PassiveFilter(fail_threshold=1, cooldown_seconds=0.5)
     cluster = ClusterClient(
@@ -79,10 +165,12 @@ async def _drive(tmp_path):
         )
         await a.start()
         agents.append(a)
+        all_nodes.append(a)
 
     http = HTTPClient(timeout_seconds=30)
     uploaded: dict[str, bytes] = {}  # digest hex -> bytes, as they land
     errors: list[str] = []
+    dead_nodes: list = []  # stopped nodes whose stores no sweep serves
 
     async def uploader():
         """One blob every ~0.25 s, through the outage. Uploads ride the
@@ -127,12 +215,17 @@ async def _drive(tmp_path):
         later, while traffic continues."""
         await asyncio.sleep(1.5)
         victim = 1
+        dead_nodes.append(origins[victim])
         await origins[victim].stop()
         await asyncio.sleep(2.0)
-        reborn = _origin(tmp_path / "reborn", f"o{victim}", addrs, ports[victim])
+        reborn = _origin(
+            tmp_path / "reborn", f"o{victim}", addrs, ports[victim],
+            cleanup=cleanup,
+        )
         reborn.tracker_addr = tracker.addr
         await reborn.start()
         origins[victim] = reborn
+        all_nodes.append(reborn)
 
     uploading = asyncio.create_task(uploader())
     chaos_task = asyncio.create_task(chaos())
@@ -154,6 +247,58 @@ async def _drive(tmp_path):
                     f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
                 )
                 assert got == blob, f"final pull differs: {hexd[:8]}"
+
+        # Torrent create/teardown churn: evict pulled blobs from an
+        # agent and pull them again -- the full unseed -> re-announce ->
+        # re-allocate -> re-download cycle, the lifecycle a fleet runs
+        # thousands of times a day (each cycle must return every fd,
+        # lease, and task it took).
+        victim_agent = agents[0]
+        for hexd, blob in list(uploaded.items())[:3]:
+            await http.delete(f"http://{victim_agent.addr}/blobs/{hexd}")
+            got = await http.get(
+                f"http://{victim_agent.addr}/namespace/ns/blobs/{hexd}"
+            )
+            assert got == blob, f"re-pull after delete differs: {hexd[:8]}"
+
+        # The dead victim's store has no node sweeping it anymore --
+        # exactly what production handles with the boot-time fsck on
+        # that root. Run the same reconciliation offline; anything it
+        # cannot reclaim is a real leak and fails the audit below.
+        from kraken_tpu.store.recovery import run_fsck
+
+        for n in dead_nodes:
+            await asyncio.to_thread(
+                run_fsck, n.store,
+                upload_ttl_seconds=3.0, expect_namespace=True,
+            )
+        # Let the live nodes' wall-clock sweeps reclaim any spool an
+        # interrupted upload stranded (upload_ttl 3 s + sweep interval).
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(
+                not os.listdir(n.store.upload_dir) for n in all_nodes
+            ):
+                break
+            await asyncio.sleep(0.25)
+
+        # -- the resource audit (tier-1 sentinel contract) ----------------
+        # Bufpool fully returned: every piece ever received gave its
+        # lease back (the wire plane's no-leak invariant under churn,
+        # outage, AND delete/re-pull).
+        for n in all_nodes:
+            sched = n.scheduler
+            if sched is not None:
+                assert sched._bufpool.leased == 0, (
+                    f"{n.store.root}: {sched._bufpool.leased} leases out"
+                )
+        # Zero debris in any store: no spool, no .part/.alloc, no orphan
+        # or tmp sidecars, nothing quarantined.
+        for n in all_nodes:
+            debris = _strict_debris(n.store)
+            assert not any(debris.values()), (
+                f"{n.store.root}: debris after soak: {debris}"
+            )
     finally:
         for t in (uploading, chaos_task, *pullers):
             if not t.done():
@@ -165,3 +310,328 @@ async def _drive(tmp_path):
         for n in origins.values():
             await n.stop()
         await tracker.stop()
+
+    # fd delta 0: everything the soak opened -- listeners, p2p conns,
+    # torrent fds, sqlite retry DBs, aiohttp sessions -- is closed.
+    fd_after = await _settle_fds(fd_baseline)
+    assert fd_after == fd_baseline, (
+        f"fd leak: {fd_baseline} before soak, {fd_after} after"
+    )
+
+
+# -- tier 2: gated origin soak (KT_SOAK=1, -m slow) ------------------------
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_origin_soak_fleet_survival(tmp_path):
+    """5-10 min of what a production origin lives through in a week:
+    continuous ingest, watermark eviction, conn churn, torrent
+    create/teardown, seeded faults -- with the sentinel sampling every
+    second and the exit asserting the fleet-survival invariants."""
+    # 600 s default: the RSS curve's allocator-ratchet knee takes
+    # ~300 s to converge on this rig (see rss_curve_mb in the report);
+    # the slope audit needs a fully-converged second half. Shorter
+    # windows measure the ramp and false-positive.
+    seconds = float(os.environ.get("KT_SOAK_SECONDS", "600"))
+    report = asyncio.run(_long_soak(tmp_path, seconds))
+    print("\nSOAK_REPORT " + json.dumps(report))
+    assert not report["errors"], "\n".join(report["errors"])
+    assert report["fd_delta_teardown"] == 0, report
+    # Steady-state drift bands (measured headroom in PERF.md): an fd
+    # leaked per torrent cycle would drift hundreds over the run; RSS
+    # creep past ~32 KiB/s compounds to >100 MiB/hour -- the weekly OOM.
+    assert abs(report["fd_slope_per_min"]) < 2.0, report
+    assert abs(report["rss_slope_kib_per_s"]) < 32.0, report
+    assert report["bufpool_leased"] == 0, report
+    assert report["debris"] == 0, report
+
+
+async def _long_soak(tmp_path, seconds: float) -> dict:
+    # A 5-min soak emits thousands of INFO records (aiohttp access log
+    # per announce/pull, per-torrent completion lines). In production
+    # they stream to stdout; under pytest the logging plugin RETAINS
+    # every record in memory for the test report -- which reads as a
+    # steady ~300 KiB/s RSS "leak" that is pure harness accumulation
+    # (confirmed: the same load outside pytest plateaus). Suppress
+    # below-WARNING records for the soak window so the sentinel
+    # measures the product, not the test runner.
+    import logging
+
+    logging.disable(logging.INFO)
+    try:
+        return await _long_soak_inner(tmp_path, seconds)
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+async def _long_soak_inner(tmp_path, seconds: float) -> dict:
+    gc.collect()
+    fd_baseline = open_fd_count()
+    rng = random.Random(1)
+
+    # Seeded faults, the production failpoint plane (utils/failpoints.py):
+    # random disconnects mid-transfer, announce errors, ENOSPC mid-PATCH.
+    # Deterministic per seed; disarmed (and verified clean) at exit.
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow()
+    failpoints.FAILPOINTS.arm("p2p.conn.disconnect", "prob:0.002+seed:17")
+    failpoints.FAILPOINTS.arm("tracker.announce.error", "prob:0.02+seed:23")
+    failpoints.FAILPOINTS.arm("origin.patch.write", "prob:0.01+seed:29")
+
+    ports = [_free_port() for _ in range(2)]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    tracker = TrackerNode(
+        announce_interval_seconds=0.5,
+        peer_ttl_seconds=10.0,
+        ring_refresh_seconds=1.0,
+    )
+    await tracker.start()
+
+    # Small pieces + tight watermarks: a 300 s run then covers hundreds
+    # of torrent lifecycles and dozens of eviction sweeps.
+    # Watermarks sized so the fill phase ends within the first ~quarter
+    # of the run at this rig's measured ingest rate: the slope audit
+    # needs a long steady-state window (store at watermark, eviction
+    # churning), not a ramp.
+    origin_cleanup = CleanupConfig(
+        tti_seconds=3600.0,
+        high_watermark_bytes=12 << 20,
+        low_watermark_bytes=8 << 20,
+        interval_seconds=1.0,
+        upload_ttl_seconds=5.0,
+    )
+    resources = {"interval_seconds": 1.0, "orphan_min_age_seconds": 30.0}
+    # Announce pacing must stay production-SHAPED at test scale: two
+    # origins seeding ~125 torrents each against one in-process tracker
+    # on a small rig would, at the default 100/s per-scheduler cap, put
+    # a 300+ rps announce storm on the shared event loop and starve the
+    # data plane (measured: 2 s/upload, announce deadlines firing).
+    announce_pacing = {
+        "announce_interval_seconds": 1.0,
+        "seed_announce_interval_seconds": 5.0,
+        "max_announce_rate": 20.0,
+    }
+    origins = []
+    for i in range(2):
+        n = _origin(
+            tmp_path, f"o{i}", addrs, ports[i],
+            cleanup=origin_cleanup,
+            piece_lengths=PieceLengthConfig(table=((0, 32 * 1024),)),
+            resources=resources,
+            scheduler_config_doc=dict(announce_pacing),
+        )
+        n.tracker_addr = tracker.addr
+        await n.start()
+        origins.append(n)
+
+    health = PassiveFilter(fail_threshold=2, cooldown_seconds=1.0)
+    cluster = ClusterClient(
+        Ring(HostList(static=addrs), max_replica=2,
+             health_filter=health.filter),
+        client_factory=lambda a: BlobClient(a, HTTPClient(retries=1)),
+        health=health,
+    )
+    tracker.server.origin_cluster = cluster
+
+    from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+    agents = []
+    for i in range(2):
+        a = AgentNode(
+            store_root=str(tmp_path / f"a{i}"),
+            tracker_addr=tracker.addr,
+            cleanup=CleanupConfig(
+                tti_seconds=3600.0,
+                high_watermark_bytes=8 << 20,
+                low_watermark_bytes=6 << 20,
+                interval_seconds=1.0,
+                upload_ttl_seconds=5.0,
+            ),
+            scheduler_config=SchedulerConfig(
+                conn_churn_idle_seconds=2.0,
+                **announce_pacing,
+            ),
+            resources=resources,
+        )
+        await a.start()
+        agents.append(a)
+
+    all_nodes = [*origins, *agents]
+    http = HTTPClient(timeout_seconds=60)
+    uploaded: list[tuple[str, bytes]] = []  # recent (hex, bytes)
+    counters = {"uploads": 0, "upload_failures": 0, "pulls": 0,
+                "pull_misses": 0, "deletes": 0}
+    errors: list[str] = []
+    stop_load = asyncio.Event()
+
+    async def uploader():
+        i = 0
+        while not stop_load.is_set():
+            blob = os.urandom(192_000) + i.to_bytes(4, "big")
+            d = Digest.from_bytes(blob)
+            try:
+                await cluster.upload("ns", d, blob)
+                uploaded.append((d.hex, blob))
+                counters["uploads"] += 1
+                del uploaded[:-40]  # older blobs may be evicted; drop refs
+            except Exception:
+                # Injected ENOSPC / replica churn: the pusher's retry is
+                # the next cycle, exactly like a real client.
+                counters["upload_failures"] += 1
+            i += 1
+            await asyncio.sleep(0.25)
+
+    async def puller(agent, name):
+        while not stop_load.is_set():
+            if not uploaded:
+                await asyncio.sleep(0.2)
+                continue
+            hexd, blob = rng.choice(uploaded[-20:])
+            try:
+                got = await asyncio.wait_for(
+                    http.get(
+                        f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+                    ),
+                    30,
+                )
+                counters["pulls"] += 1
+                if got != blob:
+                    errors.append(f"{name} {hexd[:8]}: BYTES DIFFER")
+            except (HTTPError, asyncio.TimeoutError):
+                counters["pull_misses"] += 1  # eviction/fault churn
+                await asyncio.sleep(0.2)
+                continue
+            if rng.random() < 0.1:
+                # Torrent teardown: evict locally, next pull recreates
+                # the torrent from scratch through the swarm.
+                try:
+                    await http.delete(f"http://{agent.addr}/blobs/{hexd}")
+                    counters["deletes"] += 1
+                except HTTPError:
+                    pass
+            await asyncio.sleep(rng.uniform(0.05, 0.3))
+
+    load = [
+        asyncio.create_task(uploader()),
+        *(asyncio.create_task(puller(a, f"agent{i}"))
+          for i, a in enumerate(agents)),
+    ]
+
+    # KT_SOAK_TRACEMALLOC=1: python-heap diff between mid-run and end,
+    # printed with the report -- the "is the RSS slope heap or
+    # allocator" diagnostic for when the band ever trips.
+    trace = os.environ.get("KT_SOAK_TRACEMALLOC") == "1"
+    snap_mid = None
+    if trace:
+        import tracemalloc
+
+        tracemalloc.start(10)
+
+    t0 = time.monotonic()
+    await asyncio.sleep(seconds / 2)
+    if trace:
+        import tracemalloc
+
+        gc.collect()
+        snap_mid = tracemalloc.take_snapshot()
+    await asyncio.sleep(seconds / 2)
+    stop_load.set()
+    await asyncio.gather(*load, return_exceptions=True)
+    if trace:
+        import tracemalloc
+
+        gc.collect()
+        snap_end = tracemalloc.take_snapshot()
+        print("\n=== python-heap growth, mid-run -> end ===")
+        for s in snap_end.compare_to(snap_mid, "lineno")[:15]:
+            print(s)
+        cur, peak = tracemalloc.get_traced_memory()
+        print(f"traced current={cur/1e6:.1f}MB peak={peak/1e6:.1f}MB")
+        tracemalloc.stop()
+
+    # Settle: disarm faults, let in-flight pieces land, conns churn out,
+    # and the wall-clock sweeps reclaim every failed upload's spool
+    # (upload_ttl 5 s + interval 1 s) before the strict audit.
+    failpoints.FAILPOINTS.disarm_all()
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if all(not os.listdir(n.store.upload_dir) for n in all_nodes):
+            break
+        await asyncio.sleep(0.5)
+
+    # Sentinel sample series (1 Hz, from the origins' own sentinels):
+    # fd and RSS slopes over the steady-state window -- the first half
+    # is excluded (allocator warm-up, store fill to the watermark, pool
+    # warm-up all RATCHET memory by design; the leak question is what
+    # happens once eviction churn holds the store at the watermark).
+    hist = list(origins[0].sentinel.history)
+    cut = max(2, len(hist) // 2)
+    fd_series = [(t, fd) for t, fd, _ in hist[cut:] if fd is not None]
+    rss_series = [(t, rss) for t, _, rss in hist[cut:] if rss is not None]
+    fd_slope = _lsq_slope(fd_series)
+    rss_slope = _lsq_slope(rss_series)
+
+    leased = sum(
+        n.scheduler._bufpool.leased
+        for n in all_nodes if n.scheduler is not None
+    )
+    retained_mb = sum(
+        n.scheduler._bufpool.retained_bytes
+        for n in all_nodes if n.scheduler is not None
+    ) / (1 << 20)
+    controls = {
+        f"node{i}": len(n.scheduler._controls)
+        for i, n in enumerate(all_nodes) if n.scheduler is not None
+    }
+    last = origins[0].sentinel.last_sample or {}
+    samples = len(hist)
+
+    await http.close()
+    await cluster.close()
+    for a in agents:
+        await a.stop()
+    for n in origins:
+        await n.stop()
+    await tracker.stop()
+
+    debris_by_store = {
+        n.store.root: _strict_debris(n.store) for n in all_nodes
+    }
+    debris_total = sum(
+        sum(d.values()) for d in debris_by_store.values()
+    )
+    for root, d in debris_by_store.items():
+        if any(d.values()):
+            errors.append(f"debris in {root}: {d}")
+
+    fd_after = await _settle_fds(fd_baseline, seconds=10.0)
+
+    return {
+        "seconds": round(time.monotonic() - t0, 1),
+        "counters": counters,
+        "sentinel_samples": samples,
+        "fd_baseline": fd_baseline,
+        "fd_after_teardown": fd_after,
+        "fd_delta_teardown": fd_after - fd_baseline,
+        "fd_slope_per_min": round(fd_slope * 60.0, 3),
+        "rss_slope_kib_per_s": round(rss_slope / 1024.0, 3),
+        "rss_first_mb": round(rss_series[0][1] / (1 << 20), 1)
+        if rss_series else None,
+        "rss_last_mb": round(rss_series[-1][1] / (1 << 20), 1)
+        if rss_series else None,
+        # Decimated full-run curve (MB): the shape is the diagnostic --
+        # concave-flattening = allocator ratchet converging (transient
+        # peaks, heap flat; see TESTING.md), linear = a real leak.
+        "rss_curve_mb": [
+            round(rss / (1 << 20), 1)
+            for _t, _fd, rss in hist[:: max(1, len(hist) // 20)]
+            if rss is not None
+        ],
+        "bufpool_leased": leased,
+        "bufpool_retained_mb": round(retained_mb, 1),
+        "torrent_controls": controls,
+        "tasks_last_sample": last.get("tasks"),
+        "top_task_sites": last.get("top_task_sites"),
+        "debris": debris_total,
+        "errors": errors,
+    }

@@ -62,9 +62,11 @@ class FakeTracker:
         return _Client()
 
 
-def make_peer(tmp_path, name: str, tracker: FakeTracker, seed_blob: bytes | None = None):
+def make_peer(tmp_path, name: str, tracker: FakeTracker, seed_blob: bytes | None = None,
+              events=None):
     """Build a scheduler with its own store. If ``seed_blob``, preload and
-    seed it (origin-style)."""
+    seed it (origin-style). ``events`` is an optional networkevent
+    Producer (swarm tracing assertions)."""
     store = CAStore(str(tmp_path / name))
     verifier = BatchedVerifier()
     ref: dict = {}
@@ -87,6 +89,7 @@ def make_peer(tmp_path, name: str, tracker: FakeTracker, seed_blob: bytes | None
             retry_tick_seconds=0.2,
             dial_timeout_seconds=2.0,
         ),
+        events=events,
     )
     ref["s"] = sched
     return sched, store
@@ -455,5 +458,128 @@ def test_tracker_outage_mid_pull_data_plane_survives(tmp_path):
             if not kill_task.done():
                 kill_task.cancel()
             await stop_all(seeder, leecher, late)
+
+    asyncio.run(main())
+
+
+def test_torrent_summary_emitted_on_completion(tmp_path):
+    """Every completed download leaves ONE torrent_summary line in the
+    networkevents JSONL stream -- the per-torrent lifecycle rollup
+    (pieces, peers used, bytes up/down, duration, blacklist events;
+    upstream torrentlog parity). Seeders (complete at creation) emit
+    none: there is no download story to tell."""
+    import io
+    import json
+
+    from kraken_tpu.p2p.networkevent import Producer
+
+    async def main():
+        blob = os.urandom(100_000)
+        mi = make_metainfo(blob)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        sink = io.StringIO()
+        seeder_events = Producer("seeder-pid")
+        leecher_events = Producer("leecher-pid", sink=sink)
+        seeder, _ = make_peer(
+            tmp_path, "seeder", tracker, seed_blob=blob,
+            events=seeder_events,
+        )
+        leecher, lstore = make_peer(
+            tmp_path, "leecher", tracker, events=leecher_events,
+        )
+        await start_all(seeder, leecher)
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 15)
+            assert lstore.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(seeder, leecher)
+
+        lines = [json.loads(ln) for ln in sink.getvalue().splitlines()]
+        summaries = [e for e in lines if e["name"] == "torrent_summary"]
+        assert len(summaries) == 1, [e["name"] for e in lines]
+        s = summaries[0]
+        assert s["info_hash"] == mi.info_hash.hex
+        assert s["blob"] == mi.digest.hex
+        assert s["pieces"] == mi.num_pieces
+        assert s["length"] == len(blob)
+        assert s["peers"] >= 1
+        # Endgame can duplicate a piece; bytes_down covers at least the
+        # blob, and this leecher never served.
+        assert s["bytes_down"] >= len(blob)
+        assert s["bytes_up"] == 0
+        assert s["duration_s"] >= 0
+        assert s["blacklist_events"] == 0
+        # The summary rides the SAME stream as the piece events, after
+        # its own torrent_complete.
+        names = [e["name"] for e in lines]
+        assert names.index("torrent_complete") < names.index("torrent_summary")
+        assert "receive_piece" in names
+        # A pure seeder never completes a download: no summary.
+        assert not [
+            e for e in seeder_events.events if e["name"] == "torrent_summary"
+        ]
+
+    asyncio.run(main())
+
+
+def test_torrent_summary_counts_blacklist_events(tmp_path):
+    """A pull that survives a corrupt peer reports the ban in its
+    summary (the operator's at-a-glance 'this pull fought misbehavior'
+    signal)."""
+    from kraken_tpu.p2p.networkevent import Producer
+    from kraken_tpu.p2p.storage import Torrent
+
+    async def main():
+        blob = os.urandom(60_000)
+        mi = make_metainfo(blob)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+
+        events = Producer("leecher-pid")
+        evil, _ = make_peer(tmp_path, "evil", tracker, seed_blob=blob)
+        # The corrupt seeder serves flipped bytes (same shape the chaos
+        # tier uses: the read path lies, the wire stays honest).
+        orig_read = Torrent.read_piece
+
+        def corrupt_read(self, i):
+            data = orig_read(self, i)
+            return bytes([data[0] ^ 0xFF]) + data[1:]
+
+        evil_torrents = []
+        orig_create = evil.archive.create_torrent
+
+        def tracked_create(metainfo):
+            t = orig_create(metainfo)
+            evil_torrents.append(t)
+            t.read_piece = corrupt_read.__get__(t, Torrent)
+            return t
+
+        evil.archive.create_torrent = tracked_create
+        honest, _ = make_peer(tmp_path, "honest", tracker, seed_blob=blob)
+        leecher, lstore = make_peer(
+            tmp_path, "leecher", tracker, events=events
+        )
+        await start_all(evil, honest, leecher)
+        try:
+            evil.seed(mi, NS)
+            honest.seed(mi, NS)
+            await asyncio.wait_for(leecher.download(NS, mi.digest), 20)
+            assert lstore.read_cache_file(mi.digest) == blob
+        finally:
+            await stop_all(evil, honest, leecher)
+
+        summaries = [
+            e for e in events.events if e["name"] == "torrent_summary"
+        ]
+        assert len(summaries) == 1
+        # The leecher may or may not have dialed the corrupt seeder
+        # first, but when it did, the ban must be in the rollup.
+        banned = [
+            e for e in events.events if e["name"] == "blacklist_conn"
+        ]
+        assert summaries[0]["blacklist_events"] == len(banned)
 
     asyncio.run(main())

@@ -10,7 +10,11 @@ What it proves that the CPU suite cannot: ``--hasher tpu`` selected via
 the production CLI path compiles and runs the Pallas kernel inside a real
 origin process (axon PJRT plugin, first compile 20-40 s), its metainfo
 feeds a real P2P pull by a CPU agent, and the north-star gauges move on
-the origin's /metrics endpoint.
+the origin's /metrics endpoint. The other two production hasher modes
+get the same treatment: an agent whose BatchedVerifier batches through
+the real chip (``--hasher tpu`` on the RECEIVE side), and an origin
+running ``--hasher tpu-sharded`` (shard_map over the local device set,
+a 1-device mesh on this rig).
 """
 
 import asyncio
@@ -52,6 +56,23 @@ def _spawn(args, *, tpu: bool):
         if line.startswith("READY "):
             return proc, json.loads(line[6:])
     raise RuntimeError(f"component died: {args}")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _hasher_bytes(metrics: str, hasher: str) -> float:
+    """Sum of hasher_bytes_total for one hasher label in a /metrics dump."""
+    total = 0.0
+    for ln in metrics.splitlines():
+        if ln.startswith("hasher_bytes_total") and f'hasher="{hasher}"' in ln:
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
 
 
 def test_tpu_hasher_serves_real_pull(tmp_path):
@@ -112,6 +133,138 @@ def test_tpu_hasher_serves_real_pull(tmp_path):
             ]
             assert tpu_lines, f"tpu hasher never ran:\n{metrics[:2000]}"
             assert float(tpu_lines[0].rsplit(" ", 1)[1]) >= len(blob), tpu_lines
+
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_agent_tpu_verifier_verifies_real_pull(tmp_path):
+    """The OTHER unexercised hasher mode on the receive side: an agent
+    with ``--hasher tpu`` runs its BatchedVerifier batches through the
+    real chip. A CPU origin seeds; the agent's P2P pull verifies every
+    piece on the device -- proven by bit-identical bytes AND the agent's
+    own ``hasher_bytes_total{hasher="tpu"}`` covering the blob."""
+    procs = []
+    try:
+        # Pick the origin's port up front so the tracker can be born
+        # knowing it (no kill-and-respawn dance, no second compile).
+        oport = _free_port()
+        tracker, tinfo = _spawn(
+            ["tracker", "--origins", f"127.0.0.1:{oport}"], tpu=False
+        )
+        procs.append(tracker)
+        origin, oinfo = _spawn(
+            ["origin", "--store", str(tmp_path / "origin"),
+             "--port", str(oport),
+             "--hasher", "cpu", "--tracker", tinfo["addr"]],
+            tpu=False,
+        )
+        procs.append(origin)
+        agent, ainfo = _spawn(
+            ["agent", "--store", str(tmp_path / "agent"),
+             "--hasher", "tpu", "--tracker", tinfo["addr"]],
+            tpu=True,
+        )
+        procs.append(agent)
+
+        async def drive():
+            from kraken_tpu.core.digest import Digest
+            from kraken_tpu.origin.client import BlobClient
+            from kraken_tpu.utils.httputil import HTTPClient
+
+            # 48 MiB = a dozen 4 MiB pieces: enough arrivals to form
+            # real device verify batches, small enough for the first
+            # Mosaic compile to stay minutes-scale.
+            blob = os.urandom(48 * 1024 * 1024)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(oinfo["addr"], HTTPClient(timeout_seconds=600))
+            await oc.upload("ns", d, blob)
+            http = HTTPClient(timeout_seconds=600)
+            got = await http.get(
+                f"http://{ainfo['addr']}/namespace/ns/blobs/{d.hex}"
+            )
+            assert got == blob, "pulled bytes differ"
+            metrics = (
+                await http.get(f"http://{ainfo['addr']}/metrics")
+            ).decode()
+            await oc.close()
+            await http.close()
+            hashed = _hasher_bytes(metrics, "tpu")
+            assert hashed >= len(blob), (
+                f"agent verified {hashed} bytes on the tpu hasher, "
+                f"expected >= {len(blob)}:\n{metrics[:2000]}"
+            )
+            assert "verify_pieces_total" in metrics, metrics[:2000]
+
+        asyncio.run(drive())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_tpu_sharded_origin_serves_real_pull(tmp_path):
+    """``hasher: tpu-sharded`` assembled through the production CLI on
+    the real chip (a 1-device mesh: shard_map over the local device set,
+    however many that is). Upload -> sharded metainfo-gen -> GET
+    metainfo -> real agent pull, with the sharded plane's own gauges
+    moving on the origin."""
+    procs = []
+    try:
+        oport = _free_port()
+        tracker, tinfo = _spawn(
+            ["tracker", "--origins", f"127.0.0.1:{oport}"], tpu=False
+        )
+        procs.append(tracker)
+        origin, oinfo = _spawn(
+            ["origin", "--store", str(tmp_path / "origin"),
+             "--port", str(oport),
+             "--hasher", "tpu-sharded", "--tracker", tinfo["addr"]],
+            tpu=True,
+        )
+        procs.append(origin)
+        agent, ainfo = _spawn(
+            ["agent", "--store", str(tmp_path / "agent"),
+             "--tracker", tinfo["addr"]],
+            tpu=False,
+        )
+        procs.append(agent)
+
+        async def drive():
+            from kraken_tpu.core.digest import Digest
+            from kraken_tpu.origin.client import BlobClient
+            from kraken_tpu.utils.httputil import HTTPClient
+
+            blob = os.urandom(48 * 1024 * 1024)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(oinfo["addr"], HTTPClient(timeout_seconds=600))
+            await oc.upload("ns", d, blob)
+            http = HTTPClient(timeout_seconds=600)
+            got = await http.get(
+                f"http://{ainfo['addr']}/namespace/ns/blobs/{d.hex}"
+            )
+            assert got == blob, "pulled bytes differ"
+            metrics = (
+                await http.get(f"http://{oinfo['addr']}/metrics")
+            ).decode()
+            await oc.close()
+            await http.close()
+            hashed = _hasher_bytes(metrics, "tpu-sharded")
+            assert hashed >= len(blob), (
+                f"sharded hasher covered {hashed} bytes, expected >= "
+                f"{len(blob)}:\n{metrics[:2000]}"
+            )
 
         asyncio.run(drive())
     finally:
