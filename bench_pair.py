@@ -51,7 +51,8 @@ from kraken_tpu.core.hasher import get_hasher
 from kraken_tpu.core.metainfo import MetaInfo
 
 
-async def run_pair(blob_mb: int, piece_kb: int, root: str) -> dict:
+async def run_pair(blob_mb: int, piece_kb: int, root: str,
+                   workers: int = 0) -> dict:
     rng = np.random.default_rng(0)
     blob = rng.integers(0, 256, size=blob_mb << 20, dtype=np.uint8).tobytes()
     d = Digest.from_bytes(blob)
@@ -61,12 +62,17 @@ async def run_pair(blob_mb: int, piece_kb: int, root: str) -> dict:
 
     tracker = InMemoryTracker()
     tracker.metainfos[d.hex] = metainfo
-    origin = make_peer(root, "origin", tracker, seed_blobs=[blob])
+    origin = make_peer(root, "origin", tracker, seed_blobs=[blob],
+                       data_plane_workers=workers)
     agent = make_peer(root, "agent", tracker)
     await origin.start()
     origin.seed(metainfo, NS)
     await agent.start()
 
+    # CPU accounting window: download through the stops below, so worker
+    # children are reaped (os.times only credits children after waitpid)
+    # and the seed-serve CPU rows can split main-loop vs shard cost.
+    cpu0 = os.times()
     t0 = time.perf_counter()
     await agent.download(NS, d)
     wall = time.perf_counter() - t0
@@ -88,12 +94,24 @@ async def run_pair(blob_mb: int, piece_kb: int, root: str) -> dict:
     }
     await origin.stop()
     await agent.stop()
+    cpu1 = os.times()
     return {
         "blob_mb": blob_mb,
         "piece_kb": piece_kb,
         "pieces": metainfo.num_pieces,
+        "workers": workers,
         "wall_s": round(wall, 4),
         "goodput_mbps": round(len(blob) / wall / 1e6, 1),
+        # Main-process CPU (both endpoints' loops + verify threads) and
+        # reaped-children CPU (the worker shards' serve cost) over the
+        # download window -- the seed_cpu_per_byte row's raw inputs.
+        "cpu_main_s": round(
+            (cpu1.user - cpu0.user) + (cpu1.system - cpu0.system), 4
+        ),
+        "cpu_children_s": round(
+            (cpu1.children_user - cpu0.children_user)
+            + (cpu1.children_system - cpu0.children_system), 4
+        ),
         **pool_stats,
     }
 
@@ -310,7 +328,7 @@ def run_brownout(hedge_delay_s: float = 0.1, slow_s: float = 0.5,
 NS_BROWNOUT = "bench-brownout"
 
 
-def _run_repeats(args, knockout: bool) -> list[dict]:
+def _run_repeats(args, knockout: bool, workers: int = 0) -> list[dict]:
     results = []
     for _ in range(args.repeats):
         with tempfile.TemporaryDirectory() as root:
@@ -319,7 +337,10 @@ def _run_repeats(args, knockout: bool) -> list[dict]:
                 prof.enable()
             ctx = knockout_endpoints() if knockout else contextlib.nullcontext()
             with ctx:
-                r = asyncio.run(run_pair(args.blob_mb, args.piece_kb, root))
+                r = asyncio.run(
+                    run_pair(args.blob_mb, args.piece_kb, root,
+                             workers=workers)
+                )
             if args.profile and not knockout:
                 prof.disable()
                 s = io.StringIO()
@@ -328,6 +349,227 @@ def _run_repeats(args, knockout: bool) -> list[dict]:
             results.append(r)
             print(json.dumps({**r, "knockout": knockout}))
     return results
+
+
+def run_workers_scaling(args) -> None:
+    """Round 8 honesty row #1: pair goodput with the seed-serve plane on
+    the main loop (workers=0, the PR-6 stack) vs sharded across 2 worker
+    processes -- median±spread of ``--repeats`` runs each, same rig,
+    same harness. On a pair the serve side is a small slice of the
+    critical path (the leech half -- recv copies, verify, write -- binds
+    it), so expect single-digit gains HERE; the serve-plane rows below
+    are where the multi-core claim is measured."""
+
+    def med(vals):
+        return statistics.median(sorted(vals))
+
+    r0 = _run_repeats(args, knockout=False, workers=0)
+    r2 = _run_repeats(args, knockout=False, workers=2)
+    g0 = sorted(r["goodput_mbps"] for r in r0)
+    g2 = sorted(r["goodput_mbps"] for r in r2)
+    print(json.dumps({
+        "metric": "workers_scaling",
+        "unit": "MB/s",
+        "workers0_mbps": med(g0),
+        "workers0_min": g0[0], "workers0_max": g0[-1],
+        "workers2_mbps": med(g2),
+        "workers2_min": g2[0], "workers2_max": g2[-1],
+        "median_of": len(g0),
+        "speedup": round(med(g2) / med(g0), 3) if med(g0) else None,
+    }))
+
+
+# -- the serve-isolated harness (seed_cpu_per_byte) ------------------------
+
+_LEECH_PIPELINE = 16
+
+
+def _leech_proc(port: int, ih_hex: str, name_hex: str, num_pieces: int,
+                piece_len: int, rounds: int, q) -> None:
+    """Raw leecher subprocess: handshake, pipeline PIECE_REQUESTs,
+    read-and-discard payloads. Runs OUTSIDE the origin's process so the
+    origin's os.times() isolates serve-side cost; reports its own bytes,
+    wall, and CPU (subtracted from the parent's children-CPU so shard
+    CPU can be attributed exactly)."""
+    import socket as socket_mod
+
+    import msgpack
+
+    s = socket_mod.create_connection(("127.0.0.1", port))
+    f = s.makefile("rwb")
+
+    def send_msg(t: int, header: dict, payload: bytes = b"") -> None:
+        h = msgpack.packb(header)
+        f.write(
+            bytes([t]) + len(h).to_bytes(4, "big")
+            + len(payload).to_bytes(4, "big") + h + payload
+        )
+
+    def read_frame():
+        pre = f.read(9)
+        if len(pre) < 9:
+            raise ConnectionResetError("seeder closed")
+        hl = int.from_bytes(pre[1:5], "big")
+        pl = int.from_bytes(pre[5:9], "big")
+        if hl:
+            f.read(hl)
+        left = pl
+        while left:
+            chunk = f.read(min(left, 1 << 20))
+            if not chunk:
+                raise ConnectionResetError("seeder closed mid-payload")
+            left -= len(chunk)
+        return pre[0], pl
+
+    send_msg(0, {
+        "peer_id": os.urandom(20).hex(), "info_hash": ih_hex,
+        "name": name_hex, "namespace": "bench-serve",
+        "num_pieces": num_pieces,
+    }, bytes((num_pieces + 7) // 8))
+    f.flush()
+    read_frame()  # the seeder's handshake reply
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        nxt = got = outstanding = 0
+        while got < num_pieces:
+            while outstanding < _LEECH_PIPELINE and nxt < num_pieces:
+                send_msg(2, {"index": nxt})
+                nxt += 1
+                outstanding += 1
+            f.flush()
+            t, pl = read_frame()
+            if t == 3:
+                got += 1
+                outstanding -= 1
+                total += pl
+    wall = time.perf_counter() - t0
+    tm = os.times()
+    q.put((total, wall, tm.user + tm.system))
+    s.close()
+
+
+async def _seed_serve_once(root: str, blob: bytes, metainfo,
+                           workers: int, leechers: int,
+                           rounds: int) -> dict:
+    import multiprocessing
+
+    from bench_swarm import make_peer
+
+    tracker = InMemoryTracker(interval=30.0)
+    tracker.metainfos[metainfo.digest.hex] = metainfo
+    origin = make_peer(root, "origin", tracker, seed_blobs=[blob],
+                       data_plane_workers=workers)
+    await origin.start()
+    origin.seed(metainfo, NS)
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    cpu0 = os.times()
+    t0 = time.perf_counter()
+    procs = [
+        ctx.Process(
+            target=_leech_proc,
+            args=(origin.port, metainfo.info_hash.hex, metainfo.digest.hex,
+                  metainfo.num_pieces, metainfo.piece_length, rounds, q),
+            daemon=True,
+        )
+        for _ in range(leechers)
+    ]
+    for p in procs:
+        p.start()
+    results = [await asyncio.to_thread(q.get) for _ in procs]
+    for p in procs:
+        await asyncio.to_thread(p.join)
+    wall = time.perf_counter() - t0
+    await origin.stop()  # reaps shards: their CPU lands in children
+    cpu1 = os.times()
+    total = sum(r[0] for r in results)
+    leech_cpu = sum(r[2] for r in results)
+    main = (cpu1.user - cpu0.user) + (cpu1.system - cpu0.system)
+    children = (
+        (cpu1.children_user - cpu0.children_user)
+        + (cpu1.children_system - cpu0.children_system)
+    )
+    return {
+        "bytes": total,
+        "goodput_mbps": round(total / wall / 1e6, 1),
+        "main_cpu_s": round(main, 3),
+        "shard_cpu_s": round(max(0.0, children - leech_cpu), 3),
+    }
+
+
+def run_seed_serve(args, leechers: int = 2, rounds: int = 4) -> None:
+    """Round 8 honesty rows #2-3: the serve plane ISOLATED -- the origin
+    scheduler alone in this process, raw leecher subprocesses pulling
+    every piece ``rounds`` times and discarding, so ``os.times`` splits
+    the serve cost exactly:
+
+    - ``seed_serve_goodput_mbps``: the origin's aggregate serve rate,
+      workers=0 (every serve on the main loop) vs workers=2 (sendfile
+      in shards);
+    - ``seed_cpu_per_byte``: what serving one byte costs the origin's
+      MAIN LOOP (the scarce resource -- it also runs ingest, hashing,
+      breakers, announce) before vs after, plus the total including
+      shard CPU (on kernels where sendfile is emulated the total moves
+      little; the loop liberation is the durable win).
+    """
+
+    def med(vals):
+        return statistics.median(sorted(vals))
+
+    rng = np.random.default_rng(0)
+    blob = rng.integers(
+        0, 256, size=args.blob_mb << 20, dtype=np.uint8
+    ).tobytes()
+    d = Digest.from_bytes(blob)
+    piece_len = args.piece_kb << 10
+    hashes = get_hasher("cpu").hash_pieces(blob, piece_len)
+    metainfo = MetaInfo(d, len(blob), piece_len, hashes.tobytes())
+
+    rows: dict[int, list[dict]] = {0: [], 2: []}
+    for workers in (0, 2):
+        for _ in range(args.repeats):
+            with tempfile.TemporaryDirectory() as root:
+                r = asyncio.run(_seed_serve_once(
+                    root, blob, metainfo, workers, leechers, rounds
+                ))
+                rows[workers].append(r)
+                print(json.dumps({
+                    "metric": "seed_serve_run", "workers": workers, **r
+                }))
+    g0 = sorted(r["goodput_mbps"] for r in rows[0])
+    g2 = sorted(r["goodput_mbps"] for r in rows[2])
+    print(json.dumps({
+        "metric": "seed_serve_goodput_mbps",
+        "unit": "MB/s",
+        "leechers": leechers,
+        "workers0_mbps": med(g0), "workers0_min": g0[0], "workers0_max": g0[-1],
+        "workers2_mbps": med(g2), "workers2_min": g2[0], "workers2_max": g2[-1],
+        "median_of": len(g0),
+    }))
+    nbytes = rows[0][0]["bytes"]
+    loop_before = med([r["main_cpu_s"] for r in rows[0]]) / nbytes
+    loop_after = med([r["main_cpu_s"] for r in rows[2]]) / nbytes
+    total_before = loop_before  # workers=0: the loop IS the serve cost
+    total_after = (
+        med([r["main_cpu_s"] + r["shard_cpu_s"] for r in rows[2]]) / nbytes
+    )
+    print(json.dumps({
+        "metric": "seed_cpu_per_byte",
+        "unit": "ns/B",
+        "loop_before_ns_per_byte": round(loop_before * 1e9, 3),
+        "loop_after_ns_per_byte": round(loop_after * 1e9, 3),
+        "loop_reduction_pct": (
+            round(100 * (1 - loop_after / loop_before), 1)
+            if loop_before > 0 else None
+        ),
+        "total_before_ns_per_byte": round(total_before * 1e9, 3),
+        "total_after_ns_per_byte": round(total_after * 1e9, 3),
+        "total_reduction_pct": (
+            round(100 * (1 - total_after / total_before), 1)
+            if total_before > 0 else None
+        ),
+    }))
 
 
 def _summarize(metric: str, results: list[dict]) -> None:
@@ -361,11 +603,26 @@ def main() -> None:
                     help="skip the tracemalloc recv_alloc_per_piece sample")
     ap.add_argument("--skip-brownout", action="store_true",
                     help="skip the hedged-read brown-out row")
+    ap.add_argument("--skip-workers", action="store_true",
+                    help="skip the workers_scaling + seed_cpu_per_byte"
+                         " rows (multi-core data plane)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="data_plane_workers for the headline rows (the"
+                         " scaling rows always compare 0 vs 2)")
     args = ap.parse_args()
 
-    _summarize("pair_goodput_mbps", _run_repeats(args, knockout=False))
+    _summarize(
+        "pair_goodput_mbps",
+        _run_repeats(args, knockout=False, workers=args.workers),
+    )
     if not args.skip_knockout:
-        _summarize("pump_ceiling_mbps", _run_repeats(args, knockout=True))
+        _summarize(
+            "pump_ceiling_mbps",
+            _run_repeats(args, knockout=True, workers=args.workers),
+        )
+    if not args.skip_workers:
+        run_workers_scaling(args)
+        run_seed_serve(args)
     if not args.skip_alloc:
         print(json.dumps(run_alloc_sample()))
     if not args.skip_brownout:

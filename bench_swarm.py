@@ -85,7 +85,8 @@ class InMemoryTracker:
         return _Client()
 
 
-def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256):
+def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256,
+              data_plane_workers=0):
     from kraken_tpu.p2p.connstate import ConnStateConfig
 
     store = CAStore(os.path.join(root, name))
@@ -111,6 +112,9 @@ def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256):
             announce_interval_seconds=0.5,
             retry_tick_seconds=0.5,
             max_announce_rate=2000.0,
+            # Multi-core seed-serve plane (p2p/shardpool.py): >0 forks
+            # worker processes that serve seed conns via sendfile.
+            data_plane_workers=data_plane_workers,
             # Origins are servers: a 10-conn cap on the only initial seeder
             # strangles the flash crowd's first wave.
             conn_state=ConnStateConfig(

@@ -126,6 +126,12 @@ def main(argv: list[str] | None = None) -> None:
                           help="background integrity-scrub read budget in"
                                " bytes/sec (overrides scrub.bytes_per_second;"
                                " 0 = unthrottled)")
+    p_origin.add_argument("--data-plane-workers", type=int, default=None,
+                          help="seed-serve worker processes (overrides"
+                               " scheduler.data_plane_workers): inbound"
+                               " seed conns are fd-passed to them and"
+                               " pieces go out via sendfile, off the main"
+                               " loop; 0 = single-loop serving")
 
     p_agent = sub.add_parser("agent")
     _common(p_agent)
@@ -145,6 +151,10 @@ def main(argv: list[str] | None = None) -> None:
                          help="background integrity-scrub read budget in"
                               " bytes/sec (overrides scrub.bytes_per_second;"
                               " 0 = unthrottled)")
+    p_agent.add_argument("--data-plane-workers", type=int, default=None,
+                         help="seed-serve worker processes (overrides"
+                              " scheduler.data_plane_workers); a completed"
+                              " agent seeds its swarm off the download loop")
 
     p_bi = sub.add_parser("build-index")
     _common(p_bi)
@@ -383,6 +393,14 @@ def main(argv: list[str] | None = None) -> None:
         scrub_cfg["bytes_per_second"] = args.scrub_bps
     fsck_enabled = bool(cfg.get("fsck", True))
 
+    # --data-plane-workers overrides the scheduler section's knob (the
+    # multi-core seed-serve plane; docs/OPERATIONS.md "Data-plane
+    # workers") without needing a config edit on the host.
+    scheduler_cfg = cfg.get("scheduler")
+    if getattr(args, "data_plane_workers", None) is not None:
+        scheduler_cfg = dict(scheduler_cfg or {})
+        scheduler_cfg["data_plane_workers"] = args.data_plane_workers
+
     # YAML: resources: {interval_seconds, max_open_fds, max_rss_mb,
     # max_tasks, max_bufpool_leased, max_conns, max_orphans,
     # breach_streak, drain_on_breach} -- the resource sentinel's sample
@@ -552,7 +570,7 @@ def main(argv: list[str] | None = None) -> None:
             dedup_index=cfg.get("dedup_index", "dict"),
             dedup_budget_bytes=cfg.get("dedup_budget_bytes"),
             dedup_low_j_bands=cfg.get("dedup_low_j_bands"),
-            scheduler_config_doc=cfg.get("scheduler"),
+            scheduler_config_doc=scheduler_cfg,
             p2p_bandwidth=cfg.get("p2p_bandwidth"),
             ssl_context=ssl_context,
             durability=cfg.get("durability", "rename"),
@@ -575,7 +593,6 @@ def main(argv: list[str] | None = None) -> None:
         # None = not requested; 0 = requested on an ephemeral port.
         from kraken_tpu.p2p.scheduler import SchedulerConfig
 
-        scheduler_cfg = cfg.get("scheduler")
         registry_port = pick(args.registry_port, "registry_port", None)
         build_index = pick(args.build_index, "build_index", "")
         if registry_port is not None and not build_index:

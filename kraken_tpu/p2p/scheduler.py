@@ -36,6 +36,7 @@ from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
 from kraken_tpu.p2p.dispatch import Dispatcher
 from kraken_tpu.p2p.networkevent import NoopProducer, Producer
 from kraken_tpu.p2p.piecerequest import RequestManager
+from kraken_tpu.p2p.shardpool import ShardPool
 from kraken_tpu.p2p.storage import Torrent
 from kraken_tpu.p2p.wire import Message, WireError, send_message
 
@@ -94,6 +95,7 @@ class SchedulerConfig:
         conn_churn_idle_seconds: float = 4.0,
         wire_send_batch: int = 16,
         bufpool_budget_mb: int = 256,
+        data_plane_workers: int = 0,
     ):
         self.announce_interval = announce_interval_seconds
         self.dial_timeout = dial_timeout_seconds
@@ -123,6 +125,12 @@ class SchedulerConfig:
         # recv payload pool's retained-byte budget.
         self.wire_send_batch = wire_send_batch
         self.bufpool_budget_mb = bufpool_budget_mb
+        # Multi-core seed-serve plane (p2p/shardpool.py; docs/
+        # OPERATIONS.md "Data-plane workers"): fork this many worker
+        # processes and hand them seed-only inbound conns, served via
+        # sendfile off the main loop. 0 = everything on the main loop
+        # (the pre-round-8 behavior). SIGHUP-resizable.
+        self.data_plane_workers = data_plane_workers
 
     @classmethod
     def from_dict(cls, doc: dict) -> "SchedulerConfig":
@@ -211,6 +219,11 @@ class Scheduler:
             budget_bytes=self.config.bufpool_budget_mb << 20
         )
         self._server: Optional[asyncio.base_events.Server] = None
+        # Multi-core seed-serve plane (p2p/shardpool.py): created at
+        # start() when data_plane_workers > 0; seed-only inbound conns
+        # are handed to worker processes via fd passing and served with
+        # sendfile, off this loop entirely.
+        self._shardpool: Optional[ShardPool] = None
         self._announce_queue = AnnounceQueue()
         self._announce_pump_task: Optional[asyncio.Task] = None
         self._announce_tasks: set[asyncio.Task] = set()
@@ -228,11 +241,30 @@ class Scheduler:
         timeouts, and conn limits apply from the next tick or admission
         decision; per-torrent dispatchers keep their pipeline settings
         until their torrent is recreated (new torrents use the new
-        values). No torrent state is dropped."""
+        values). No torrent state is dropped. The seed-serve worker pool
+        resizes live: grown shards spawn, shrunk shards drain and exit."""
         self.config = config
         self.conn_state.reconfigure(config.conn_state)
         self._bufpool.set_budget(config.bufpool_budget_mb << 20)
+        pool = getattr(self, "_shardpool", None)
+        if pool is not None:
+            pool.reconfigure(config.conn_churn_idle)
+            pool.resize(config.data_plane_workers)
+        elif (
+            config.data_plane_workers > 0
+            and getattr(self, "_server", None) is not None
+        ):
+            self._start_shardpool()
         _log.info("scheduler config reloaded")
+
+    def _start_shardpool(self) -> None:
+        self._shardpool = ShardPool(
+            self.config.data_plane_workers,
+            churn_idle_seconds=self.config.conn_churn_idle,
+            on_conn_closed=self._shard_conn_closed,
+            component="origin" if self.is_origin else "agent",
+        )
+        self._shardpool.start()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -240,6 +272,8 @@ class Scheduler:
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
+        if self.config.data_plane_workers > 0:
+            self._start_shardpool()
         self._announce_pump_task = asyncio.create_task(self._announce_pump())
 
     async def stop(self) -> None:
@@ -254,6 +288,9 @@ class Scheduler:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self._shardpool is not None:
+            await self._shardpool.stop()
+            self._shardpool = None
 
     @property
     def addr(self) -> str:
@@ -261,8 +298,12 @@ class Scheduler:
 
     @property
     def num_active_conns(self) -> int:
-        """Live peer conns -- the drain loop's quiesce signal."""
-        return len(self._conn_owners)
+        """Live peer conns -- the drain loop's quiesce signal. Counts
+        BOTH halves of the data plane: main-loop conns and the ones
+        handed to worker shards (a drain must wait for in-flight worker
+        serves exactly like in-flight dispatcher pieces)."""
+        shard = self._shardpool.num_conns if self._shardpool else 0
+        return len(self._conn_owners) + shard
 
     def enter_lameduck(self) -> None:
         """Drain mode: seed announces stop (the tracker's peer TTL ages
@@ -274,6 +315,11 @@ class Scheduler:
         they complete and churn out; assembly's drain() waits on
         :attr:`num_active_conns`."""
         self.lameduck = True
+        if self._shardpool is not None:
+            # Fan the drain out: worker shards stop taking handoffs,
+            # let in-flight serves finish, and churn their conns out --
+            # the same SIGTERM semantics as the main loop.
+            self._shardpool.enter_lameduck()
         _log.info("scheduler entering lameduck drain")
 
     # -- public API --------------------------------------------------------
@@ -315,6 +361,11 @@ class Scheduler:
         if ctl is None:
             return
         self._digest_to_hash.pop(ctl.torrent.metainfo.digest, None)
+        if self._shardpool is not None:
+            # Worker shards drop their long-lived blob fd and close the
+            # torrent's conns gracefully (the remotes requeue elsewhere)
+            # -- a seeder must not keep serving bytes it just evicted.
+            self._shardpool.evict(ctl.torrent.metainfo.digest.hex)
         self._announce_queue.remove(h)
         ctl.cancel_tasks()
         ctl.dispatcher.close()
@@ -512,7 +563,95 @@ class Scheduler:
         if ctl is None or not self.conn_state.promote(theirs.peer_id, h):
             writer.close()
             return
+        if self._try_handoff(ctl, reader, writer, theirs):
+            return
         self._adopt(ctl, reader, writer, theirs)
+
+    def _try_handoff(
+        self,
+        ctl: _TorrentControl,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        theirs: HandshakeResult,
+    ) -> bool:
+        """Classify + ship a seed-only inbound conn to a worker shard.
+
+        Seed-only means OUR torrent is complete: this conn will never
+        request a piece, never touch the verifier or bufpool -- it only
+        serves, which is exactly the half of the data plane the worker
+        processes own (p2p/shardpool.py). Leech conns (we still need
+        pieces) and bandwidth-shaped nodes (the egress token bucket is
+        in-process state a worker cannot share) stay on the main loop.
+        Returns False to fall through to the normal in-loop adopt; the
+        conn-state slot reserved by promote() travels with the conn and
+        is released by the worker's closed verdict.
+        """
+        pool = self._shardpool
+        if pool is None or not pool.can_accept:
+            return False
+        if not ctl.torrent.complete() or self.bandwidth is not None:
+            return False
+        transport = writer.transport
+        sock = transport.get_extra_info("socket")
+        if sock is None:
+            return False  # exotic transport (tests' mocks): keep in-loop
+        h = ctl.torrent.info_hash
+        try:
+            transport.pause_reading()
+        except (RuntimeError, NotImplementedError):
+            return False
+        # Frames the remote pipelined behind its handshake already sit in
+        # the parent's StreamReader; they must travel with the fd or the
+        # worker would start mid-stream.
+        residual = bytes(getattr(reader, "_buffer", b""))
+        desc = {
+            "peer": theirs.peer_id.hex,
+            "ih": h.hex,
+            "name": ctl.torrent.metainfo.digest.hex,
+            "plen": ctl.torrent.metainfo.piece_length,
+            "len": ctl.torrent.metainfo.length,
+            "np": ctl.torrent.num_pieces,
+            "path": ctl.torrent.blob_path,
+            "residual": residual,
+        }
+        try:
+            dup = sock.dup()
+        except OSError:
+            transport.resume_reading()
+            return False
+        try:
+            ok = pool.try_handoff(dup.fileno(), desc)
+        finally:
+            # send_fds installed a kernel-held reference in the control
+            # message; on failure this dup is simply dropped.
+            dup.close()
+        if not ok:
+            transport.resume_reading()
+            return False
+        # The worker owns the conn now: retire the parent-side transport
+        # WITHOUT closing the connection (the in-flight SCM_RIGHTS ref
+        # keeps it alive until the worker adopts the fd).
+        transport.abort()
+        self.events.emit(
+            "add_active_conn", h.hex, peer=theirs.peer_id.hex, shard=True
+        )
+        return True
+
+    def _shard_conn_closed(self, desc: dict, reason: str,
+                           misbehavior: bool) -> None:
+        """A worker shard reported one of its conns closed: release the
+        conn-state slot the handoff carried, and feed misbehavior
+        verdicts into the same blacklist path main-loop conns use."""
+        peer = PeerID(desc["peer"])
+        h = InfoHash(desc["ih"])
+        if misbehavior:
+            self._peer_failed(peer, h, f"shard conn misbehavior: {reason}")
+        else:
+            self.conn_state.remove(peer, h)
+        self.events.emit(
+            "drop_active_conn", h.hex, peer=peer.hex, reason=reason,
+            detail="shard",
+        )
 
     def _bitfield_for(self, hs: HandshakeResult) -> tuple[bytes, int]:
         """Inbound handshake: find or create local state for the torrent.

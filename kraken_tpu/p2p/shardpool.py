@@ -1,0 +1,937 @@
+"""Multi-core seed-serve plane: sharded worker processes + sendfile serves.
+
+The round-5/7 residual decomposition (PERF.md) pinned the remaining
+data-plane bound to ONE core: the raw wire moves 1.0-1.4 GB/s while the
+full stack does ~30% of it, all of it on the single event loop. The
+leech half of the plane (verify -> bitfield -> commit) is already
+off-GIL via the HashPool; the seed half -- read a piece, frame it, push
+it down a socket -- still burned the main loop per byte. This module
+shards that half across worker PROCESSES and makes each serve nearly
+free:
+
+- A :class:`ShardPool` supervisor forks ``data_plane_workers`` child
+  processes (``scheduler:`` YAML knob on agent+origin, SIGHUP-resizable),
+  each running its own event loop and conn pump.
+- The scheduler's acceptor classifies inbound conns after the handshake:
+  **seed-only conns** (our torrent is complete -- we will only ever
+  serve) are handed to a worker via ``socket.send_fds`` together with a
+  compact torrent descriptor (info hash, piece length, blob path, any
+  bytes the parent's StreamReader already buffered). Leech conns stay on
+  the main loop untouched.
+- Workers serve PIECE_REQUESTs straight from a long-lived per-torrent
+  blob fd: the 9-byte prefix + msgpack header go out under ``TCP_CORK``,
+  the payload rides ``loop.sock_sendfile`` -- page cache to socket,
+  skipping bufpool and userspace entirely on the seed hot path. A stale
+  fd or an evicted blob closes the conn gracefully between frames; the
+  remote re-announces and re-pulls (requeues) from healthy peers.
+- Control flows over one ``AF_UNIX``/``SOCK_SEQPACKET`` socketpair per
+  worker: parent -> worker conn handoffs (+fd), evict / lameduck / stop;
+  worker -> parent per-shard counters (aggregated onto the main metrics
+  mux under ``shard="data_plane_shard{n}"`` labels) and conn-closed /
+  misbehavior verdicts, which the scheduler feeds back into connstate
+  and the blacklist exactly as for main-loop conns.
+- Lameduck drain fans out: the acceptor already refuses new conns, the
+  workers let in-flight serves finish, and the drain loop's quiesce
+  signal (:attr:`Scheduler.num_active_conns`) counts worker conns, so
+  SIGTERM semantics from the degradation plane are preserved.
+
+Workers are forked (not spawned): they inherit the armed failpoint
+registry and logging config, cost no re-import, and run nothing but
+stdlib + msgpack -- no JAX, no aiohttp, no store machinery. A crashed
+worker is detected by control-socket EOF: its conn slots are released,
+``data_plane_worker_crashes_total`` counts it, the resource sentinel
+flags it as a breach, and the supervisor respawns the shard.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import logging
+import multiprocessing
+import os
+import signal
+import socket
+import time
+from typing import Callable, Optional
+
+import msgpack
+
+from kraken_tpu.p2p.wire import MAX_HEADER, MAX_PAYLOAD, MsgType
+from kraken_tpu.utils import failpoints
+
+_log = logging.getLogger("kraken.p2p.shard")
+
+# Worker-side recv chunk and control-message bound. SEQPACKET preserves
+# message boundaries; the only large field is the handoff residual (the
+# few frames a fast leecher pipelined behind its handshake).
+_CTRL_RECV = 1 << 18
+_RECV_CHUNK = 1 << 16
+
+# Parent-side identity of a handed-off conn, for slot release + events.
+ConnClosedFn = Callable[[dict, str, bool], None]
+
+
+def _cork(sock: socket.socket, on: bool) -> None:
+    """Batch header+payload into MSS-sized segments (Linux TCP_CORK);
+    uncorking flushes. Elsewhere fall back to toggling NODELAY, which
+    gives the same flush-on-uncork edge without the strict batching."""
+    try:
+        if hasattr(socket, "TCP_CORK"):
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_CORK, 1 if on else 0)
+        else:  # pragma: no cover - non-Linux
+            sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 0 if on else 1
+            )
+    except OSError:
+        pass  # best-effort: correctness never depends on corking
+
+
+class _Misbehavior(Exception):
+    """Protocol violation by the remote (oversize payload, garbage
+    header, out-of-range index): the conn closes and the verdict flows
+    back to the parent's blacklist."""
+
+
+_HAVE_SENDFILE = hasattr(os, "sendfile")
+# errnos meaning "sendfile cannot serve THIS file/socket pair" (exotic
+# fs, emulated kernel): fall back to pread+send for the serve, never
+# fail the conn over the transport mechanism.
+_SENDFILE_UNSUPPORTED = {
+    getattr(errno, name, -1)
+    for name in ("EINVAL", "ENOSYS", "EOPNOTSUPP", "ENOTSUP", "ESPIPE")
+}
+
+
+# ---------------------------------------------------------------------------
+# Worker side (child process)
+# ---------------------------------------------------------------------------
+
+class _WorkerTorrent:
+    __slots__ = (
+        "name", "path", "piece_length", "length", "num_pieces",
+        "file", "evicted_evt", "conns",
+    )
+
+    def __init__(self, desc: dict):
+        self.name = desc["name"]
+        self.path = desc["path"]
+        self.piece_length = desc["plen"]
+        self.length = desc["len"]
+        self.num_pieces = desc["np"]
+        self.file = None  # long-lived blob fd, opened on first serve
+        self.evicted_evt = asyncio.Event()
+        self.conns: set["_WorkerConn"] = set()
+
+    def piece_length_of(self, i: int) -> int:
+        return min(self.piece_length, self.length - i * self.piece_length)
+
+    def open(self):
+        if self.file is None:
+            # Buffered binary handle: sock_sendfile's native path only
+            # uses fileno() (positional os.sendfile -- safe concurrently).
+            self.file = open(self.path, "rb")
+        return self.file
+
+    def close(self) -> None:
+        if self.file is not None:
+            try:
+                self.file.close()
+            finally:
+                self.file = None
+
+
+class _WorkerConn:
+    __slots__ = ("cid", "sock", "torrent", "buf", "task", "peer", "ih")
+
+    def __init__(self, cid: int, sock: socket.socket, torrent: _WorkerTorrent,
+                 desc: dict):
+        self.cid = cid
+        self.sock = sock
+        self.torrent = torrent
+        self.buf = bytearray(desc.get("residual") or b"")
+        self.task: Optional[asyncio.Task] = None
+        self.peer = desc["peer"]
+        self.ih = desc["ih"]
+
+
+class _WorkerState:
+    """Everything one shard process owns. Runs inside ``asyncio.run``."""
+
+    def __init__(self, ctrl: socket.socket, shard: int, cfg: dict):
+        self.ctrl = ctrl
+        self.shard = shard
+        # Idle churn mirrors the dispatcher's conn churn: a seed conn
+        # that carries nothing for 2x the churn window frees its slot
+        # (the remote redials if it still wants bytes).
+        self.idle_timeout = max(1.0, 2.0 * float(cfg.get("churn_idle", 4.0)))
+        self.torrents: dict[str, _WorkerTorrent] = {}
+        self.conns: dict[int, _WorkerConn] = {}
+        self.bytes_up = 0
+        self.serves = 0
+        self.lameduck = False
+        self._stop_evt = asyncio.Event()
+        self._stats_dirty = True
+
+    # -- control channel ---------------------------------------------------
+
+    def _on_ctrl(self) -> None:
+        while True:
+            try:
+                data, fds, _flags, _addr = socket.recv_fds(
+                    self.ctrl, _CTRL_RECV, 4
+                )
+            except BlockingIOError:
+                return
+            except OSError:
+                data, fds = b"", []
+            if not data:
+                # Parent closed its end (stop/crash): drain and exit.
+                self._stop_evt.set()
+                return
+            try:
+                msg = msgpack.unpackb(data)
+                self._handle_ctrl(msg, fds)
+            except Exception:
+                for fd in fds:
+                    os.close(fd)
+                _log.exception("shard %d: bad control message", self.shard)
+
+    def _handle_ctrl(self, msg: dict, fds: list[int]) -> None:
+        t = msg.get("t")
+        if t == "conn":
+            if not fds:
+                return
+            if self._stop_evt.is_set() or self.lameduck:
+                # Late handoff into a draining worker (the parent sent
+                # the conn before it saw our drain state): refuse by
+                # closing -- the remote soft-retries another peer. The
+                # closed verdict MUST still flow back, or the parent's
+                # conn slot leaks and the drain wait never quiesces.
+                for fd in fds:
+                    os.close(fd)
+                self._send(
+                    {"t": "closed", "cid": msg["cid"],
+                     "reason": "worker_refused", "detail": "draining",
+                     "mis": False}
+                )
+                return
+            sock = socket.socket(fileno=fds[0])
+            for fd in fds[1:]:
+                os.close(fd)
+            sock.setblocking(False)
+            try:
+                # A whole piece should fit in the send buffer: sendfile
+                # then completes in one or two syscalls instead of
+                # ping-ponging EAGAIN -> add_writer -> retry per few
+                # hundred KB (each round trip is an epoll_ctl pair plus
+                # a loop wakeup -- measured 2x serve CPU on small
+                # default buffers).
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDBUF,
+                    max(4 << 20, msg.get("plen", 0) * 2),
+                )
+            except OSError:
+                pass
+            torrent = self.torrents.get(msg["name"])
+            if torrent is None or torrent.evicted_evt.is_set():
+                torrent = _WorkerTorrent(msg)
+                self.torrents[msg["name"]] = torrent
+            conn = _WorkerConn(msg["cid"], sock, torrent, msg)
+            torrent.conns.add(conn)
+            self.conns[conn.cid] = conn
+            conn.task = asyncio.create_task(self._conn_loop(conn))
+            self._stats_dirty = True
+        elif t == "evict":
+            torrent = self.torrents.get(msg["name"])
+            if torrent is not None:
+                # Graceful: conn loops observe the event BETWEEN frames,
+                # so an in-flight sendfile completes (the unlinked inode
+                # stays readable through the open fd), then the conn
+                # closes and the remote requeues elsewhere.
+                torrent.evicted_evt.set()
+                if not torrent.conns:
+                    torrent.close()
+                    self.torrents.pop(msg["name"], None)
+        elif t == "lameduck":
+            self.lameduck = True
+        elif t == "stop":
+            self._stop_evt.set()
+        elif t == "cfg":
+            self.idle_timeout = max(
+                1.0, 2.0 * float(msg.get("churn_idle", 4.0))
+            )
+
+    # -- frame plumbing ----------------------------------------------------
+
+    async def _readexactly(self, conn: _WorkerConn, n: int) -> bytes:
+        loop = asyncio.get_running_loop()
+        while len(conn.buf) < n:
+            chunk = await loop.sock_recv(conn.sock, _RECV_CHUNK)
+            if not chunk:
+                raise ConnectionResetError("remote closed")
+            conn.buf += chunk
+        out = bytes(conn.buf[:n])
+        del conn.buf[:n]
+        return out
+
+    async def _read_frame(self, conn: _WorkerConn) -> tuple[int, dict]:
+        """One wire frame (p2p/wire.py layout). Payload bytes -- always
+        unsolicited on a seed conn -- are drained and dropped to keep
+        framing; oversize or malformed input is misbehavior."""
+        prefix = await self._readexactly(conn, 9)
+        mtype = prefix[0]
+        header_len = int.from_bytes(prefix[1:5], "big")
+        payload_len = int.from_bytes(prefix[5:9], "big")
+        if header_len > MAX_HEADER or payload_len > MAX_PAYLOAD:
+            raise _Misbehavior(
+                f"oversized frame: header={header_len} payload={payload_len}"
+            )
+        if payload_len > max(conn.torrent.piece_length, 1 << 20):
+            raise _Misbehavior(f"oversize payload: {payload_len}")
+        raw_header = await self._readexactly(conn, header_len) if header_len else b""
+        try:
+            header = msgpack.unpackb(raw_header) if header_len else {}
+            if not isinstance(header, dict):
+                raise ValueError("header not a map")
+        except Exception as e:
+            raise _Misbehavior(f"malformed header: {e}") from e
+        # Drain-and-drop any payload: a seeder never asked for one.
+        remaining = payload_len
+        while remaining:
+            got = await self._readexactly(conn, min(remaining, _RECV_CHUNK))
+            remaining -= len(got)
+        return mtype, header
+
+    async def _wait_writable(self, sock: socket.socket) -> None:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        fd = sock.fileno()
+
+        def ready() -> None:
+            loop.remove_writer(fd)
+            if not fut.done():
+                fut.set_result(None)
+
+        loop.add_writer(fd, ready)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            loop.remove_writer(fd)
+            raise
+
+    async def _sendfile(self, conn: _WorkerConn, f, offset: int,
+                        count: int) -> None:
+        """Nonblocking ``os.sendfile`` with an inline fast path: after
+        the previous piece drains, the (piece-sized, see SO_SNDBUF at
+        adoption) send buffer almost always has room, so the common
+        case is ONE syscall and zero event-loop round trips --
+        ``loop.sock_sendfile``'s per-chunk add_writer/remove_writer
+        dance measured at 2x the serve CPU on this path."""
+        loop = asyncio.get_running_loop()
+        fd = conn.sock.fileno()
+        sent = 0
+        while sent < count:
+            try:
+                n = os.sendfile(fd, f.fileno(), offset + sent, count - sent)
+            except BlockingIOError:
+                await self._wait_writable(conn.sock)
+                continue
+            if n == 0:
+                raise ConnectionResetError("sendfile: remote closed")
+            sent += n
+            if sent < count:
+                # Partial: buffer full mid-piece; wait before retrying
+                # rather than spinning EAGAIN.
+                await self._wait_writable(conn.sock)
+        await asyncio.sleep(0)  # serve fairness between conns of a shard
+
+    async def _serve_piece(self, conn: _WorkerConn, idx: int) -> None:
+        """The hot path: prefix+header corked, payload via sendfile from
+        the long-lived blob fd -- piece bytes never enter this process's
+        userspace (page cache -> socket in the kernel)."""
+        hit = failpoints.fire("p2p.shard.serve.disconnect")
+        if hit:
+            if hit.delay_s:
+                await asyncio.sleep(hit.delay_s)
+            raise ConnectionResetError("failpoint p2p.shard.serve.disconnect")
+        t = conn.torrent
+        ln = t.piece_length_of(idx)
+        header = msgpack.packb({"index": idx})
+        head = (
+            bytes([int(MsgType.PIECE_PAYLOAD)])
+            + len(header).to_bytes(4, "big")
+            + ln.to_bytes(4, "big")
+            + header
+        )
+        loop = asyncio.get_running_loop()
+        f = t.open()  # FileNotFoundError here = evicted under us
+        _cork(conn.sock, True)
+        try:
+            await loop.sock_sendall(conn.sock, head)
+            if _HAVE_SENDFILE:
+                try:
+                    await self._sendfile(
+                        conn, f, idx * t.piece_length, ln
+                    )
+                except OSError as e:
+                    if e.errno not in _SENDFILE_UNSUPPORTED:
+                        raise
+                    # Kernel/fs without sendfile for this pair: the
+                    # pread fallback is correct, one userspace copy.
+                    await self._serve_pread(conn, f, idx, ln)
+            else:  # pragma: no cover - non-Linux
+                await self._serve_pread(conn, f, idx, ln)
+        finally:
+            _cork(conn.sock, False)
+        self.bytes_up += ln
+        self.serves += 1
+        self._stats_dirty = True
+
+    async def _serve_pread(self, conn: _WorkerConn, f, idx: int,
+                           ln: int) -> None:
+        loop = asyncio.get_running_loop()
+        data = os.pread(f.fileno(), ln, idx * conn.torrent.piece_length)
+        if len(data) != ln:
+            raise OSError(f"short read on piece {idx}")
+        await loop.sock_sendall(conn.sock, data)
+
+    async def _handle_frame(self, conn: _WorkerConn, mtype: int,
+                            header: dict) -> None:
+        if mtype == MsgType.PIECE_REQUEST:
+            idx = header.get("index")
+            t = conn.torrent
+            if not isinstance(idx, int) or not 0 <= idx < t.num_pieces:
+                raise _Misbehavior(f"piece index out of range: {idx!r}")
+            await self._serve_piece(conn, idx)
+        elif mtype == MsgType.ERROR:
+            raise ConnectionResetError(header.get("detail", "peer error"))
+        # ANNOUNCE_PIECE / COMPLETE / CANCEL_PIECE / BITFIELD /
+        # PIECE_PAYLOAD (already drained): progress chatter from the
+        # leecher -- nothing for a pure seeder to act on.
+
+    async def _conn_loop(self, conn: _WorkerConn) -> None:
+        reason, detail, mis = "remote_closed", "", False
+        t = conn.torrent
+        evict_wait = asyncio.ensure_future(t.evicted_evt.wait())
+        stop_wait = asyncio.ensure_future(self._stop_evt.wait())
+        recv: Optional[asyncio.Future] = None
+        try:
+            while True:
+                if t.evicted_evt.is_set():
+                    reason = "evicted"
+                    break
+                if self._stop_evt.is_set():
+                    reason = "drain_stop"
+                    break
+                recv = asyncio.ensure_future(self._read_frame(conn))
+                done, _pending = await asyncio.wait(
+                    {recv, evict_wait, stop_wait},
+                    timeout=self.idle_timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if recv not in done:
+                    recv.cancel()
+                    recv = None
+                    if evict_wait in done:
+                        reason = "evicted"
+                    elif stop_wait in done:
+                        reason = "drain_stop"
+                    else:
+                        reason = "idle_conn"
+                    break
+                mtype, header = recv.result()
+                recv = None
+                # In-flight serves run INLINE here: eviction and drain
+                # take effect between frames, never mid-sendfile.
+                await self._handle_frame(conn, mtype, header)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+            reason, detail = "connection_error", str(e)
+        except _Misbehavior as e:
+            reason, detail, mis = "misbehavior", str(e), True
+        except asyncio.CancelledError:
+            reason = "cancelled"
+        except Exception as e:  # a bad conn must not kill the shard
+            reason, detail = "serve_error", str(e)
+        finally:
+            if recv is not None:
+                recv.cancel()
+            evict_wait.cancel()
+            stop_wait.cancel()
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            self.conns.pop(conn.cid, None)
+            t.conns.discard(conn)
+            if not t.conns:
+                # Shed the blob fd with the last conn (release_fd parity:
+                # a long-lived shard must not hold fds for idle torrents).
+                t.close()
+                # Identity-guarded: an evicted torrent may have been
+                # replaced in the registry by a fresh handoff after a
+                # re-pull; popping by name alone would evict the NEW
+                # object's registration and orphan its conns from any
+                # later evict fan-out.
+                if (
+                    t.evicted_evt.is_set()
+                    and self.torrents.get(t.name) is t
+                ):
+                    self.torrents.pop(t.name, None)
+            self._send(
+                {"t": "closed", "cid": conn.cid, "reason": reason,
+                 "detail": detail, "mis": mis}
+            )
+            self._stats_dirty = True
+
+    # -- stats + lifecycle -------------------------------------------------
+
+    def _send(self, msg: dict) -> None:
+        try:
+            self.ctrl.send(msgpack.packb(msg))
+        except (BlockingIOError, OSError):
+            pass  # parent backlogged or gone; stats are best-effort
+
+    def _send_stats(self) -> None:
+        times = os.times()
+        self._send({
+            "t": "stats",
+            "conns": len(self.conns),
+            "bytes_up": self.bytes_up,
+            "serves": self.serves,
+            "cpu_s": times.user + times.system,
+            "lameduck": self.lameduck,
+        })
+        self._stats_dirty = False
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.ctrl.setblocking(False)
+        loop.add_reader(self.ctrl.fileno(), self._on_ctrl)
+        self._send({"t": "ready", "pid": os.getpid()})
+        try:
+            while not self._stop_evt.is_set():
+                try:
+                    await asyncio.wait_for(self._stop_evt.wait(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
+                if self._stats_dirty or self.conns:
+                    self._send_stats()
+        finally:
+            # Graceful drain: conn loops observed _stop_evt and are
+            # finishing their in-flight serve; give them a beat, then cut.
+            tasks = [c.task for c in list(self.conns.values()) if c.task]
+            if tasks:
+                await asyncio.wait(tasks, timeout=1.0)
+            for c in list(self.conns.values()):
+                if c.task:
+                    c.task.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+            for t in list(self.torrents.values()):
+                t.close()
+            self._send_stats()
+            loop.remove_reader(self.ctrl.fileno())
+            try:
+                self.ctrl.close()
+            except OSError:
+                pass
+
+
+def _worker_main(ctrl: socket.socket, parent_fd: int, shard: int,
+                 cfg: dict) -> None:
+    """Child-process entry (fork start method). Resets inherited signal
+    plumbing -- the parent's asyncio handlers reference a loop this
+    process must never touch -- then runs the shard's own loop."""
+    try:
+        signal.set_wakeup_fd(-1)
+    except (ValueError, OSError):  # pragma: no cover
+        pass
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent ^C handles us
+    try:
+        signal.signal(signal.SIGHUP, signal.SIG_IGN)
+    except (OSError, ValueError):  # pragma: no cover
+        pass
+    if parent_fd >= 0:
+        # The fork duplicated the PARENT's end of the socketpair into
+        # this process; holding it open would mask parent-death EOF.
+        try:
+            os.close(parent_fd)
+        except OSError:
+            pass
+    try:
+        asyncio.run(_WorkerState(ctrl, shard, cfg).run())
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Parent side (supervisor)
+# ---------------------------------------------------------------------------
+
+class _Worker:
+    __slots__ = (
+        "shard", "proc", "sock", "conns", "retiring",
+        "last_bytes", "last_serves", "cpu_s",
+    )
+
+    def __init__(self, shard: int, proc, sock: socket.socket):
+        self.shard = shard
+        self.proc = proc
+        self.sock = sock
+        self.conns = 0  # parent-side estimate (handoffs - closes)
+        self.retiring = False
+        self.last_bytes = 0
+        self.last_serves = 0
+        self.cpu_s = 0.0
+
+    @property
+    def label(self) -> str:
+        return f"data_plane_shard{self.shard}"
+
+
+class ShardPool:
+    """Supervisor for the seed-serve worker processes. One per scheduler;
+    all methods run on the scheduler's event loop."""
+
+    def __init__(
+        self,
+        size: int,
+        *,
+        churn_idle_seconds: float = 4.0,
+        on_conn_closed: ConnClosedFn | None = None,
+        component: str = "p2p",
+    ):
+        self._target = max(0, size)
+        self.churn_idle = churn_idle_seconds
+        self._on_conn_closed = on_conn_closed or (lambda desc, r, m: None)
+        self.component = component
+        self._workers: dict[int, _Worker] = {}
+        self._conns: dict[int, tuple[int, dict]] = {}  # cid -> (shard, desc)
+        self._next_cid = 0
+        self._stopping = False
+        self.lameduck = False
+        self._reap_tasks: set[asyncio.Task] = set()
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        self._c_handoffs = REGISTRY.counter(
+            "data_plane_handoffs_total",
+            "Seed conns handed to worker shards, by shard",
+        )
+        self._c_fallbacks = REGISTRY.counter(
+            "data_plane_handoff_fallbacks_total",
+            "Seed conns kept on the main loop (no shard could take them)",
+        )
+        self._c_crashes = REGISTRY.counter(
+            "data_plane_worker_crashes_total",
+            "Worker shards that exited without being asked to",
+        )
+        self._g_workers = REGISTRY.gauge(
+            "data_plane_workers", "Configured seed-serve worker processes"
+        )
+        self._g_alive = REGISTRY.gauge(
+            "data_plane_workers_alive", "Live seed-serve worker processes"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        for shard in range(self._target):
+            self._spawn(shard)
+        self._g_workers.set(self._target, component=self.component)
+
+    def _spawn(self, shard: int) -> None:
+        parent_sock, child_sock = socket.socketpair(
+            socket.AF_UNIX, socket.SOCK_SEQPACKET
+        )
+        ctx = multiprocessing.get_context("fork")
+        proc = ctx.Process(
+            target=_worker_main,
+            args=(
+                child_sock, parent_sock.fileno(), shard,
+                {"churn_idle": self.churn_idle},
+            ),
+            daemon=True,  # backstop: never outlive the node process
+            name=f"kraken-data-plane-shard{shard}",
+        )
+        proc.start()
+        child_sock.close()
+        parent_sock.setblocking(False)
+        w = _Worker(shard, proc, parent_sock)
+        self._workers[shard] = w
+        asyncio.get_running_loop().add_reader(
+            parent_sock.fileno(), self._on_worker_msg, shard
+        )
+        self._g_alive.set(self.alive_workers, component=self.component)
+        _log.info(
+            "data-plane shard spawned",
+            extra={"shard": shard, "pid": proc.pid},
+        )
+
+    def resize(self, size: int) -> None:
+        """SIGHUP live resize: grow spawns fresh shards; shrink retires
+        the highest shards -- they finish in-flight serves, close their
+        conns, and exit; their slots release through the normal closed
+        verdicts."""
+        size = max(0, size)
+        self._target = size
+        self._g_workers.set(size, component=self.component)
+        live = sorted(
+            s for s, w in self._workers.items() if not w.retiring
+        )
+        for shard in range(size):
+            if shard not in self._workers:
+                self._spawn(shard)
+        for shard in live:
+            if shard >= size:
+                w = self._workers[shard]
+                w.retiring = True
+                self._send(w, {"t": "stop"})
+
+    def enter_lameduck(self) -> None:
+        self.lameduck = True
+        for w in self._workers.values():
+            self._send(w, {"t": "lameduck"})
+
+    def evict(self, name_hex: str) -> None:
+        """A blob left the store (eviction, quarantine, unseed): every
+        shard drops its fd and closes that torrent's conns gracefully."""
+        for w in self._workers.values():
+            self._send(w, {"t": "evict", "name": name_hex})
+
+    def reconfigure(self, churn_idle_seconds: float) -> None:
+        self.churn_idle = churn_idle_seconds
+        for w in self._workers.values():
+            self._send(w, {"t": "cfg", "churn_idle": churn_idle_seconds})
+
+    async def stop(self) -> None:
+        """Graceful teardown: ask every worker to drain, join with a
+        bound, hard-kill stragglers, release any conn slots still
+        attributed to shards. Reaps every child -- zero orphans is the
+        soak harness's audit line."""
+        self._stopping = True
+        workers = list(self._workers.values())
+        self._workers.clear()
+        loop = asyncio.get_running_loop()
+        for w in workers:
+            try:
+                loop.remove_reader(w.sock.fileno())
+            except (OSError, ValueError):
+                pass
+            self._send(w, {"t": "stop"})
+
+        def _join_all() -> None:
+            deadline = time.monotonic() + 3.0
+            for w in workers:
+                w.proc.join(max(0.1, deadline - time.monotonic()))
+            for w in workers:
+                if w.proc.is_alive():
+                    w.proc.terminate()
+                    w.proc.join(1.0)
+                if w.proc.is_alive():  # pragma: no cover - last resort
+                    w.proc.kill()
+                    w.proc.join(1.0)
+
+        await asyncio.to_thread(_join_all)
+        for w in workers:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+            try:
+                w.proc.close()  # releases the mp sentinel fd
+            except Exception:  # pragma: no cover
+                pass
+        for cid, (shard, desc) in list(self._conns.items()):
+            self._conns.pop(cid, None)
+            self._safe_conn_closed(desc, "pool_stop", False)
+        self._g_alive.set(0, component=self.component)
+        for t in list(self._reap_tasks):
+            t.cancel()
+        if self._reap_tasks:
+            await asyncio.gather(*self._reap_tasks, return_exceptions=True)
+
+    # -- handoff -----------------------------------------------------------
+
+    @property
+    def can_accept(self) -> bool:
+        return (
+            not self._stopping
+            and not self.lameduck
+            and any(not w.retiring for w in self._workers.values())
+        )
+
+    @property
+    def num_conns(self) -> int:
+        """Live handed-off conns -- counted into the scheduler's drain
+        quiesce signal."""
+        return len(self._conns)
+
+    def try_handoff(self, fd: int, desc: dict) -> bool:
+        """Ship a handshaken seed conn (by fd) to the least-loaded shard.
+        False = no shard could take it right now (all retiring, control
+        channel backlogged); the caller keeps the conn on the main loop."""
+        if not self.can_accept:
+            self._c_fallbacks.inc()
+            return False
+        cid = self._next_cid
+        self._next_cid += 1
+        payload = msgpack.packb({"t": "conn", "cid": cid, **desc})
+        candidates = sorted(
+            (w for w in self._workers.values() if not w.retiring),
+            key=lambda w: w.conns,
+        )
+        for w in candidates:
+            try:
+                socket.send_fds(w.sock, [payload], [fd])
+            except (BlockingIOError, OSError):
+                continue
+            w.conns += 1
+            self._conns[cid] = (w.shard, desc)
+            self._c_handoffs.inc(shard=w.label)
+            return True
+        self._c_fallbacks.inc()
+        return False
+
+    # -- worker messages ---------------------------------------------------
+
+    def _send(self, w: _Worker, msg: dict) -> None:
+        try:
+            w.sock.send(msgpack.packb(msg))
+        except (BlockingIOError, OSError):
+            pass  # worker gone or backlogged; EOF handling catches death
+
+    def _on_worker_msg(self, shard: int) -> None:
+        w = self._workers.get(shard)
+        if w is None:
+            return
+        while True:
+            try:
+                data = w.sock.recv(_CTRL_RECV)
+            except BlockingIOError:
+                return
+            except OSError:
+                data = b""
+            if not data:
+                self._worker_gone(shard)
+                return
+            try:
+                self._handle_worker_msg(w, msgpack.unpackb(data))
+            except Exception:
+                _log.exception("bad message from shard %d", shard)
+
+    def _handle_worker_msg(self, w: _Worker, msg: dict) -> None:
+        t = msg.get("t")
+        if t == "stats":
+            from kraken_tpu.utils.metrics import record_data_plane_shard
+
+            w.cpu_s = float(msg.get("cpu_s", 0.0))
+            record_data_plane_shard(
+                w.label,
+                conns=msg.get("conns", 0),
+                bytes_delta=max(0, msg.get("bytes_up", 0) - w.last_bytes),
+                serves_delta=max(0, msg.get("serves", 0) - w.last_serves),
+                cpu_seconds=w.cpu_s,
+            )
+            w.last_bytes = msg.get("bytes_up", w.last_bytes)
+            w.last_serves = msg.get("serves", w.last_serves)
+        elif t == "closed":
+            entry = self._conns.pop(msg.get("cid"), None)
+            w.conns = max(0, w.conns - 1)
+            if entry is not None:
+                _shard, desc = entry
+                self._safe_conn_closed(
+                    desc, msg.get("reason", ""), bool(msg.get("mis"))
+                )
+        elif t == "ready":
+            pass
+
+    def _safe_conn_closed(self, desc: dict, reason: str, mis: bool) -> None:
+        try:
+            self._on_conn_closed(desc, reason, mis)
+        except Exception:
+            _log.exception("shard conn-closed callback failed")
+
+    def _worker_gone(self, shard: int) -> None:
+        w = self._workers.pop(shard, None)
+        if w is None:
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            loop.remove_reader(w.sock.fileno())
+        except (OSError, ValueError):
+            pass
+        try:
+            w.sock.close()
+        except OSError:
+            pass
+        # Every conn this shard held is gone with it: release the slots
+        # so the remotes can redial (onto another shard or the main loop).
+        for cid, (s, desc) in list(self._conns.items()):
+            if s == shard:
+                self._conns.pop(cid, None)
+                self._safe_conn_closed(desc, "worker_exit", False)
+        expected = w.retiring or self._stopping
+        if not expected:
+            self._c_crashes.inc(shard=w.label)
+            _log.warning(
+                "data-plane shard died unexpectedly; respawning",
+                extra={"shard": shard, "pid": w.proc.pid},
+            )
+
+        def _reap_and_respawn() -> None:
+            t = asyncio.create_task(self._reap(w, shard))
+            self._reap_tasks.add(t)
+            t.add_done_callback(self._reap_tasks.discard)
+
+        _reap_and_respawn()
+        self._g_alive.set(self.alive_workers, component=self.component)
+
+    async def _reap(self, w: _Worker, shard: int) -> None:
+        def _join() -> None:
+            w.proc.join(2.0)
+            if w.proc.is_alive():  # pragma: no cover
+                w.proc.terminate()
+                w.proc.join(1.0)
+
+        await asyncio.to_thread(_join)
+        try:
+            w.proc.close()
+        except Exception:  # pragma: no cover
+            pass
+        # Respawn on crash, but ALSO when a retiring shard exits while
+        # the target has grown back over it (shrink-then-grow race: the
+        # grow saw the old shard still in the table and spawned nothing,
+        # so this exit is the only chance to restore the pool size).
+        if (
+            not self._stopping
+            and shard < self._target
+            and shard not in self._workers
+        ):
+            self._spawn(shard)
+
+    # -- introspection (sentinel / tests) ----------------------------------
+
+    @property
+    def alive_workers(self) -> int:
+        return sum(1 for w in self._workers.values() if w.proc.is_alive())
+
+    @property
+    def expected_workers(self) -> int:
+        return self._target
+
+    def worker_info(self) -> list[dict]:
+        """Per-shard pid/liveness/conn snapshot for the resource sentinel
+        (child fd+RSS aggregation, crash reap-check) and /debug surfaces."""
+        return [
+            {
+                "shard": w.shard,
+                "pid": w.proc.pid,
+                "alive": w.proc.is_alive(),
+                "retiring": w.retiring,
+                "conns": w.conns,
+                "cpu_s": w.cpu_s,
+            }
+            for w in sorted(self._workers.values(), key=lambda w: w.shard)
+        ]

@@ -212,6 +212,13 @@ class Torrent:
     def num_pieces(self) -> int:
         return self.metainfo.num_pieces
 
+    @property
+    def blob_path(self) -> str:
+        """Filesystem path of the backing file (the committed cache path
+        once complete) -- what the seed-serve worker shards open for
+        their long-lived sendfile fd."""
+        return self._path
+
     def complete(self) -> bool:
         return self._status is None or self._status.complete()
 

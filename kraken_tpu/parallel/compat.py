@@ -1,0 +1,134 @@
+"""JAX version-compat shim for the parallel hash planes.
+
+The sharded hash plane was written against ``jax.shard_map`` -- an API
+that only exists on recent JAX releases. Older installs (including this
+repo's pinned toolchain) ship it as ``jax.experimental.shard_map`` with
+the replication-check kwarg spelled ``check_rep`` instead of
+``check_vma``; ``pjit`` similarly migrated from
+``jax.experimental.pjit`` into ``jax.jit`` itself. Every prior round
+left 5 ``test_parallel`` + 2 ``test_multihost`` failures standing on
+exactly this skew.
+
+This module centralizes the resolution, following the Titanax
+``compile_step_with_plan`` pattern (SNIPPETS.md [2]): prefer the
+explicit-sharding compile path (``pjit`` + ``NamedSharding``), fall
+back to the experimental spelling, and raise a TYPED error -- with a
+remediation hint -- when the running JAX exposes neither, instead of an
+AttributeError deep inside a compile cache.
+
+Everything in :mod:`kraken_tpu.parallel` goes through these shims; no
+other module may touch ``jax.shard_map`` / ``pjit`` directly.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class ParallelCompatError(RuntimeError):
+    """The running JAX exposes none of the APIs a parallel plane needs.
+
+    Carries a remediation hint (what to upgrade / which config to avoid)
+    so the error is actionable at the operator level, not a stack trace
+    into version-skewed internals."""
+
+    def __init__(self, message: str, hint: str = ""):
+        self.hint = hint
+        super().__init__(f"{message} ({hint})" if hint else message)
+
+
+def _resolve_shard_map() -> tuple[Callable[..., Any] | None, str]:
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "jax.shard_map"
+    try:  # the pre-0.5 spelling
+        from jax.experimental.shard_map import shard_map as exp_fn
+
+        return exp_fn, "jax.experimental.shard_map"
+    except Exception:
+        return None, ""
+
+
+def _resolve_pjit() -> tuple[Callable[..., Any] | None, str]:
+    # Modern JAX: jax.jit IS pjit (accepts in/out_shardings); the
+    # experimental module remains as an alias. Prefer the explicit pjit
+    # symbol when present so the intent -- compile with shardings --
+    # survives in the resolved name.
+    try:
+        from jax.experimental.pjit import pjit as exp_pjit
+
+        return exp_pjit, "jax.experimental.pjit"
+    except Exception:
+        pass
+    fn = getattr(jax, "jit", None)
+    if fn is not None and "out_shardings" in inspect.signature(fn).parameters:
+        return fn, "jax.jit"
+    return None, ""
+
+
+_SHARD_MAP, SHARD_MAP_SOURCE = _resolve_shard_map()
+_PJIT, PJIT_SOURCE = _resolve_pjit()
+
+
+def shard_map(
+    f: Callable[..., Any],
+    *,
+    mesh: Mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+) -> Callable[..., Any]:
+    """Per-device map over ``mesh`` -- ``jax.shard_map`` semantics on
+    every supported JAX.
+
+    The replication-safety analysis kwarg is normalized here: new JAX
+    calls it ``check_vma``, the experimental spelling ``check_rep``;
+    whichever the resolved function takes gets the caller's value.
+    """
+    if _SHARD_MAP is None:
+        raise ParallelCompatError(
+            "no shard_map in this JAX install",
+            "need jax.shard_map or jax.experimental.shard_map; upgrade "
+            "JAX or run with hasher: cpu/tpu (single-chip)",
+        )
+    params = inspect.signature(_SHARD_MAP).parameters
+    kwargs: dict[str, Any] = {}
+    if "check_vma" in params:
+        kwargs["check_vma"] = check_vma
+    elif "check_rep" in params:
+        kwargs["check_rep"] = check_vma
+    return _SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def jit_with_sharding(
+    f: Callable[..., Any], mesh: Mesh, out_spec: PartitionSpec
+) -> Callable[..., Any]:
+    """Compile ``f`` with an explicit ``NamedSharding`` output placement.
+
+    The preferred path is ``pjit`` + ``NamedSharding`` (the modern
+    explicit-sharding compile); on installs where only plain ``jax.jit``
+    grew the ``out_shardings`` kwarg that resolves to the same thing.
+    """
+    if _PJIT is None:
+        raise ParallelCompatError(
+            "no sharding-aware jit (pjit) in this JAX install",
+            "need jax.experimental.pjit.pjit or jax.jit with "
+            "out_shardings; upgrade JAX",
+        )
+    return _PJIT(f, out_shardings=NamedSharding(mesh, out_spec))
+
+
+def describe() -> dict:
+    """What the shim resolved -- surfaced by the dryrun and debuggable
+    from a REPL when a rig's JAX is in question."""
+    return {
+        "jax": getattr(jax, "__version__", "unknown"),
+        "shard_map": SHARD_MAP_SOURCE or None,
+        "pjit": PJIT_SOURCE or None,
+    }

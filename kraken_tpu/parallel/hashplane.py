@@ -22,6 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kraken_tpu.parallel import compat
 from kraken_tpu.core.hasher import (
     DIGEST_SIZE,
     PieceHasher,
@@ -55,7 +56,10 @@ def _sharded_fn(
             )
         return _sha256_uniform(data_u8, pad_block, unpadded_blocks)
 
-    mapped = jax.shard_map(
+    # Through the version shim (parallel/compat.py): jax.shard_map on
+    # new JAX, jax.experimental.shard_map (check_rep spelling) on the
+    # pinned toolchain, typed ParallelCompatError when neither exists.
+    mapped = compat.shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P("pieces", None), P()),
@@ -65,7 +69,7 @@ def _sharded_fn(
         check_vma=False,
     )
     out_spec = P() if replicate else P("pieces", None)
-    return jax.jit(mapped, out_shardings=NamedSharding(mesh, out_spec))
+    return compat.jit_with_sharding(mapped, mesh, out_spec)
 
 
 def sharded_hash_pieces(

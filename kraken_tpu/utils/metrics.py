@@ -191,6 +191,36 @@ def record_hash_pool_metrics(
     ).set(queued, pool=pool)
 
 
+def record_data_plane_shard(
+    shard: str, *, conns: int, bytes_delta: float, serves_delta: float,
+    cpu_seconds: float, registry: Registry = REGISTRY,
+) -> None:
+    """Aggregate one seed-serve worker's counters onto the main metrics
+    mux (p2p/shardpool.py publishes them over the control pipe; workers
+    have no HTTP listener of their own). Labeled ``shard=
+    "data_plane_shard{n}"`` so a hot shard, an idle shard, and a
+    crash-looping shard are distinguishable on one dashboard; deltas
+    keep counter semantics across worker restarts."""
+    registry.gauge(
+        "data_plane_worker_conns",
+        "Live seed conns served by each worker shard",
+    ).set(conns, shard=shard)
+    registry.gauge(
+        "data_plane_worker_cpu_seconds",
+        "Cumulative CPU (user+sys) of each worker shard",
+    ).set(cpu_seconds, shard=shard)
+    if bytes_delta:
+        registry.counter(
+            "data_plane_worker_bytes_sent_total",
+            "Piece payload bytes served by worker shards (sendfile path)",
+        ).inc(bytes_delta, shard=shard)
+    if serves_delta:
+        registry.counter(
+            "data_plane_worker_serves_total",
+            "Piece serves completed by worker shards",
+        ).inc(serves_delta, shard=shard)
+
+
 # Wire-plane buffer pool gauges -- bufpool_leased / bufpool_hit_ratio /
 # bufpool_retained_bytes (label `pool`) -- are registered and maintained
 # by utils/bufpool.py, which caches the Gauge refs at pool construction:

@@ -87,6 +87,26 @@ def rss_bytes() -> int | None:
         return None
 
 
+def child_fd_count(pid: int) -> int | None:
+    """Open fds of a CHILD process (a data-plane worker shard), or None
+    when it is gone / off-Linux. With worker processes the self-probes
+    above go blind to half the data plane; the sentinel aggregates these
+    per-child numbers into the same gauges."""
+    try:
+        return len(os.listdir(f"/proc/{pid}/fd"))
+    except OSError:
+        return None
+
+
+def child_rss_bytes(pid: int) -> int | None:
+    """Resident set size of a child process, or None when gone."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def _task_site(task: "asyncio.Task") -> str:
     """Tag a task by the code object of its coroutine -- the creation
     site an operator can actually grep for."""
@@ -365,12 +385,44 @@ class ResourceSentinel:
             self.scheduler.num_active_conns
             if self.scheduler is not None else 0
         )
+        # Data-plane worker shards (p2p/shardpool.py): a forked child's
+        # fds and RSS are invisible to /proc/self -- aggregate them into
+        # the same budgets, and reap-check: a shard that died without
+        # being asked counts as a BREACH ("workers"), never as silence.
+        workers = []
+        shardpool = getattr(self.scheduler, "_shardpool", None)
+        if shardpool is not None:
+            workers = shardpool.worker_info()
+        worker_fds = 0
+        worker_rss = 0
+        workers_alive = 0
+        for winfo in workers:
+            if winfo.get("alive"):
+                workers_alive += 1
+            wfds = child_fd_count(winfo["pid"]) if winfo.get("pid") else None
+            wrss = child_rss_bytes(winfo["pid"]) if winfo.get("pid") else None
+            winfo["open_fds"] = wfds
+            winfo["rss_bytes"] = wrss
+            worker_fds += wfds or 0
+            worker_rss += wrss or 0
+        workers_expected = (
+            shardpool.expected_workers if shardpool is not None else 0
+        )
+        if fds is not None:
+            fds += worker_fds
+        if rss is not None:
+            rss += worker_rss
         sample = {
             "component": self.component,
             "ts": time.time(),
             "open_fds": fds,
             "rss_bytes": rss,
             "rss_mb": (rss / (1 << 20)) if rss is not None else None,
+            "worker_fds": worker_fds,
+            "worker_rss_bytes": worker_rss,
+            "workers": workers,
+            "workers_alive": workers_alive,
+            "workers_expected": workers_expected,
             "tasks": tasks,
             "top_task_sites": top,
             "bufpool_leased": pool.leased if pool is not None else 0,
@@ -398,6 +450,24 @@ class ResourceSentinel:
     def _check_budgets(self, sample: dict) -> list[str]:
         cfg = self.config
         breached: list[str] = []
+        # Reap-check, no budget knob: a dead worker shard is ALWAYS a
+        # breach -- the supervisor respawns it, but the death must count
+        # (crash-looping shards show up as a climbing breach counter,
+        # not as a mysteriously slow data plane).
+        if sample.get("workers_alive", 0) < sample.get("workers_expected", 0):
+            breached.append("workers")
+            self._streaks["workers"] = self._streaks.get("workers", 0) + 1
+            self._breaches.inc(kind="workers")
+            _log.warning(
+                "resource breach: data-plane worker shard dead",
+                extra={
+                    "component": self.component,
+                    "alive": sample.get("workers_alive"),
+                    "expected": sample.get("workers_expected"),
+                },
+            )
+        else:
+            self._streaks.pop("workers", None)
         for kind, budget_field, sample_field in _BUDGETS:
             budget = getattr(cfg, budget_field)
             value = sample.get(sample_field)

@@ -97,6 +97,7 @@ def test_scheduler_sections_construct_scheduler_config():
     from kraken_tpu.p2p.scheduler import SchedulerConfig
 
     seen = 0
+    workers_shipped = 0
     for comp, path in _component_files():
         sc = load_config(path).get("scheduler")
         if not sc:
@@ -104,8 +105,18 @@ def test_scheduler_sections_construct_scheduler_config():
         cfg = SchedulerConfig.from_dict(sc)  # raises on unknown keys
         assert cfg.wire_send_batch >= 1, path
         assert cfg.bufpool_budget_mb >= 0, path
+        # Multi-core data plane (round 8): the knob must construct, and
+        # the SHIPPED default must be 0 -- forking serve shards is an
+        # explicit operator decision, never a config-refresh surprise.
+        assert cfg.data_plane_workers >= 0, path
+        if "data_plane_workers" in sc:
+            assert cfg.data_plane_workers == 0, (
+                f"{path}: shipped data_plane_workers must default to 0"
+            )
+            workers_shipped += 1
         seen += 1
     assert seen >= 2  # origin + agent ship the wire-plane knobs
+    assert workers_shipped >= 2  # origin + agent register the knob
 
 
 def test_rpc_sections_construct_rpc_config():

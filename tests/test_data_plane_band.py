@@ -31,13 +31,15 @@ import tempfile
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 
 
-def _median_wall(n: int, blob_mb: int, piece_kb: int) -> float:
+def _median_wall(n: int, blob_mb: int, piece_kb: int,
+                 workers: int = 0) -> float:
     from bench_pair import run_pair
 
     walls = []
     for _ in range(n):
         with tempfile.TemporaryDirectory() as root:
-            r = asyncio.run(run_pair(blob_mb, piece_kb, root))
+            r = asyncio.run(run_pair(blob_mb, piece_kb, root,
+                                     workers=workers))
             walls.append(r["wall_s"])
     return statistics.median(walls)
 
@@ -60,4 +62,38 @@ def test_pair_pump_knockout_regression_band(monkeypatch):
         f"(full {full:.3f}s / knockout {knockout:.3f}s): the endpoint "
         "machinery cost moved -- see this file's docstring before "
         "re-pinning"
+    )
+
+
+def test_pair_pump_knockout_band_with_workers(monkeypatch):
+    """The same ratio gate with the seed half sharded onto worker
+    processes (round 8, p2p/shardpool.py): the knockout still strictly
+    removes agent-side work (verify + data write -- serve-side sendfile
+    is untouched by it), so the ratio must hold in the same band. A
+    ratio below 0.8 would mean the worker plane broke the knockout; one
+    past 3.0 would mean the handoff re-introduced per-piece machinery
+    on the main loop. Skipped on single-core rigs, where forking a
+    serve shard measures scheduler contention, not the plane."""
+    import os
+
+    import pytest
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("workers band needs >= 2 cores")
+
+    from kraken_tpu.p2p import storage as st
+
+    full = _median_wall(3, blob_mb=64, piece_kb=256, workers=2)
+
+    async def _verified(self, data, expected):
+        return True
+
+    monkeypatch.setattr(st.BatchedVerifier, "verify", _verified)
+    monkeypatch.setattr(st.Torrent, "_write_at", lambda self, i, data: None)
+    knockout = _median_wall(3, blob_mb=64, piece_kb=256, workers=2)
+
+    ratio = full / knockout
+    assert 0.8 <= ratio <= 3.0, (
+        f"workers-on pump-knockout ratio {ratio:.2f} outside [0.8, 3.0] "
+        f"(full {full:.3f}s / knockout {knockout:.3f}s)"
     )

@@ -319,6 +319,122 @@ async def _drive(tmp_path):
     )
 
 
+# -- tier 1: mini-soak with the multi-core data plane ----------------------
+
+def test_mini_soak_with_data_plane_workers(tmp_path):
+    """The worker-shard lifecycle under real node churn: pulls served
+    through forked shards (sendfile path), delete -> re-pull torrent
+    cycles (evict fan-out to workers), then full teardown. The audit is
+    the fleet-survival contract extended to the children: fd delta
+    exactly 0 in the parent, bufpool fully returned, zero store debris,
+    and ZERO orphaned worker processes after stop."""
+    asyncio.run(_drive_workers(tmp_path))
+
+
+async def _drive_workers(tmp_path):
+    gc.collect()
+    fd_baseline = open_fd_count()
+
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    tracker = TrackerNode(
+        announce_interval_seconds=0.1,
+        peer_ttl_seconds=5.0,
+        ring_refresh_seconds=0.2,
+    )
+    await tracker.start()
+    origin = _origin(
+        tmp_path, "o0", [addr], port,
+        scheduler_config_doc={"data_plane_workers": 2},
+    )
+    origin.tracker_addr = tracker.addr
+    await origin.start()
+    cluster = ClusterClient(
+        Ring(HostList(static=[addr]), max_replica=1),
+        client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+    )
+    tracker.server.origin_cluster = cluster
+    agent = AgentNode(
+        store_root=str(tmp_path / "a0"), tracker_addr=tracker.addr
+    )
+    await agent.start()
+    http = HTTPClient(timeout_seconds=30)
+    worker_pids: list[int] = []
+    try:
+        pool = origin.scheduler._shardpool
+        assert pool is not None and pool.alive_workers == 2
+        worker_pids = [w["pid"] for w in pool.worker_info()]
+
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        def served_bytes() -> float:
+            c = REGISTRY.counter("data_plane_worker_bytes_sent_total")
+            return sum(
+                c.value(shard=f"data_plane_shard{i}") for i in range(2)
+            )
+        served0 = served_bytes()
+
+        blobs: dict[str, bytes] = {}
+        for i in range(4):
+            blob = os.urandom(BLOB_BYTES) + i.to_bytes(4, "big")
+            d = Digest.from_bytes(blob)
+            await cluster.upload("ns", d, blob)
+            blobs[d.hex] = blob
+        for hexd, blob in blobs.items():
+            got = await http.get(
+                f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+            )
+            assert got == blob, f"worker-served pull differs: {hexd[:8]}"
+        # Torrent churn THROUGH the worker plane: delete + re-pull runs
+        # the evict fan-out (workers drop fds, close conns) and fresh
+        # handoffs, the cycle a fleet runs thousands of times a day.
+        for hexd, blob in list(blobs.items())[:2]:
+            await http.delete(f"http://{agent.addr}/blobs/{hexd}")
+            got = await http.get(
+                f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+            )
+            assert got == blob, f"re-pull after delete differs: {hexd[:8]}"
+
+        # The bytes genuinely moved through shards (stats pipe lands on
+        # a 0.25 s cadence -- poll briefly).
+        deadline = time.monotonic() + 5.0
+        while served_bytes() <= served0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        assert served_bytes() > served0, "no bytes served via worker shards"
+
+        # Leases fully returned on both schedulers (the agent received
+        # through the bufpool; origin serves bypassed it entirely).
+        for sched in (origin.scheduler, agent.scheduler):
+            for _ in range(100):
+                if sched._bufpool.leased == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert sched._bufpool.leased == 0
+        for store in (origin.store, agent.store):
+            debris = _strict_debris(store)
+            assert not any(debris.values()), f"debris: {debris}"
+    finally:
+        await http.close()
+        await agent.stop()
+        await cluster.close()
+        await origin.stop()
+        await tracker.stop()
+
+    # Zero orphaned worker processes: every shard was reaped at stop.
+    assert worker_pids, "no worker shards observed"
+    for pid in worker_pids:
+        try:
+            os.kill(pid, 0)
+            raise AssertionError(f"orphaned data-plane worker pid {pid}")
+        except ProcessLookupError:
+            pass
+
+    fd_after = await _settle_fds(fd_baseline)
+    assert fd_after == fd_baseline, (
+        f"fd leak with workers: {fd_baseline} before, {fd_after} after"
+    )
+
+
 # -- tier 2: gated origin soak (KT_SOAK=1, -m slow) ------------------------
 
 @pytest.mark.slow

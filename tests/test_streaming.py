@@ -28,7 +28,13 @@ from kraken_tpu.store import CAStore
 # ~24 s, was ~57 s before stream-time hashing removed the re-read pass).
 BLOB_MB = int(os.environ.get("KT_STREAM_TEST_MB", "96"))
 PIECE = 1 << 20  # 1 MiB pieces keep the in-flight bound tight
-PEAK_BOUND = 32 << 20  # blob is 3x this (default): whole-blob buffering fails
+# The LEGITIMATE in-flight working set is pipeline depth (16) x piece
+# (1 MiB) x live conns (up to 2 here) = 32 MiB, so a bound of exactly
+# 32 MiB sat ON the working set and flapped with allocator noise
+# (measured 33.5-33.7 MB peaks on healthy runs, both at the round-8
+# seed and after). 40 MiB keeps 2.4x margin against the whole-blob
+# buffering failure this test exists to catch (96 MiB would blow it).
+PEAK_BOUND = 40 << 20
 
 
 def _write_blob(path: str, mb: int) -> Digest:
