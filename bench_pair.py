@@ -572,6 +572,61 @@ def run_seed_serve(args, leechers: int = 2, rounds: int = 4) -> None:
     }))
 
 
+def run_trace_overhead(args) -> None:
+    """Round 9 honesty row: what the distributed-tracing plane costs the
+    data path at the SHIPPED sampling rate (base.yaml
+    ``trace.sample_rate``, 0.01). Two legs, each trace-off vs trace-on:
+    the full stack and the pump knockout (the pure pump + dispatch
+    machinery, where per-piece span gating would show first). Legs are
+    run back-to-back on the same rig so the on/off ratio cancels the
+    shared-core drift the absolute numbers ride. The CI version of this
+    row is tests/test_data_plane_band.py::test_trace_on_overhead_band
+    (ratio gated at <= 5% goodput cost)."""
+    from kraken_tpu.configutil import load_config
+    from kraken_tpu.utils.trace import TRACER, TraceConfig
+
+    # The row's claim is "at the SHIPPED rate": read it from the actual
+    # shipped config, not the dataclass default, so a base.yaml rate
+    # change cannot silently turn this into a measurement of something
+    # else (test_config_tree only pins the rate to a sampled-down RANGE).
+    shipped = TraceConfig.from_dict(
+        load_config(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "config", "agent", "base.yaml")
+        ).get("trace")
+    )
+
+    def med(vals):
+        return statistics.median(sorted(vals))
+
+    def leg(enabled: bool, knockout: bool) -> list[float]:
+        TRACER.apply(shipped if enabled else TraceConfig(enabled=False))
+        try:
+            return [
+                r["goodput_mbps"]
+                for r in _run_repeats(args, knockout=knockout)
+            ]
+        finally:
+            TRACER.apply(TraceConfig())
+
+    row: dict = {
+        "metric": "trace_overhead",
+        "unit": "MB/s",
+        "sample_rate": shipped.sample_rate,
+    }
+    for label, knockout in (("full", False), ("pump", True)):
+        if knockout and args.skip_knockout:
+            continue
+        off = leg(False, knockout)
+        on = leg(True, knockout)
+        row[f"{label}_off_mbps"] = med(off)
+        row[f"{label}_on_mbps"] = med(on)
+        row[f"{label}_on_off_ratio"] = (
+            round(med(on) / med(off), 4) if med(off) else None
+        )
+    print(json.dumps(row))
+
+
 def _summarize(metric: str, results: list[dict]) -> None:
     # Median +/- spread of N runs (VERDICT r5 next #3): single best-of
     # runs on this shared core produced BENCH-vs-PERF discrepancies
@@ -606,6 +661,9 @@ def main() -> None:
     ap.add_argument("--skip-workers", action="store_true",
                     help="skip the workers_scaling + seed_cpu_per_byte"
                          " rows (multi-core data plane)")
+    ap.add_argument("--skip-trace", action="store_true",
+                    help="skip the trace_overhead (trace-off vs trace-on"
+                         " at shipped sampling) rows")
     ap.add_argument("--workers", type=int, default=0,
                     help="data_plane_workers for the headline rows (the"
                          " scaling rows always compare 0 vs 2)")
@@ -623,6 +681,8 @@ def main() -> None:
     if not args.skip_workers:
         run_workers_scaling(args)
         run_seed_serve(args)
+    if not args.skip_trace:
+        run_trace_overhead(args)
     if not args.skip_alloc:
         print(json.dumps(run_alloc_sample()))
     if not args.skip_brownout:

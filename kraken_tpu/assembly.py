@@ -14,6 +14,7 @@ star specifies.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import os
 from typing import Optional
@@ -39,6 +40,7 @@ from kraken_tpu.utils.deadline import RPCConfig
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter, instrument_app
 from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
+from kraken_tpu.utils.trace import TRACER, TraceConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -123,6 +125,30 @@ def _resources_config(resources) -> ResourcesConfig:
     return ResourcesConfig.from_dict(resources)
 
 
+def _trace_config(trace_cfg) -> TraceConfig:
+    """Same normalization for the YAML ``trace:`` section."""
+    if isinstance(trace_cfg, TraceConfig):
+        return trace_cfg
+    return TraceConfig.from_dict(trace_cfg)
+
+
+def _apply_trace(component: str, cfg: TraceConfig,
+                 store_root: str = "") -> None:
+    """Apply a node's ``trace:`` section to the process-global tracer
+    (utils/trace.py TRACER -- one per process, like the metric
+    REGISTRY; in-process herd tests share it and the last-started node
+    wins, exactly as with the registry). An empty ``dump_dir`` defaults
+    under the node's store root so flight-recorder postmortems land
+    next to the data they describe; store-less nodes (tracker) skip
+    file dumps unless a dir is configured explicitly."""
+    if not cfg.dump_dir and store_root:
+        cfg = dataclasses.replace(
+            cfg, dump_dir=os.path.join(store_root, "traces")
+        )
+    TRACER.apply(cfg)
+    TRACER.node = component
+
+
 def _start_sentinel(node, component: str) -> ResourceSentinel:
     """Build, register, and start a node's resource sentinel. The
     sustained-breach hook enters lameduck (idempotent, non-blocking):
@@ -162,6 +188,10 @@ async def _drain_node(server, scheduler, timeout: float,
     completing and churning out, streaming HTTP bodies landing. The
     caller runs the normal stop() afterwards; by then the hard teardown
     cancels nothing that mattered."""
+    # SIGTERM/operator drain is a degradation event (the clean stop()
+    # path is not): persist the flight recorder before the conns drain
+    # away -- the spans of whatever prompted the drain are in the ring.
+    TRACER.trigger_dump("lameduck", f"{component}: drain entered")
     if server is not None:
         server.enter_lameduck()
     elif scheduler is not None:
@@ -225,10 +255,14 @@ class TrackerNode:
                  ring_refresh_seconds: float = 5.0,
                  redis_addr: str = "",
                  ssl_context=None,
-                 rpc: dict | RPCConfig | None = None):
+                 rpc: dict | RPCConfig | None = None,
+                 trace: dict | TraceConfig | None = None):
         self.host = host
         self.port = port
         self.rpc = _rpc_config(rpc)
+        # Store-less node: dump_dir stays "" (no file postmortems)
+        # unless the YAML sets one explicitly; /debug/trace still works.
+        self.trace_config = _trace_config(trace)
         # Redis-protocol store: swarm survives tracker restarts and can be
         # shared by several trackers; default in-memory store re-heals via
         # TTL instead.
@@ -252,6 +286,7 @@ class TrackerNode:
         return f"{self.host}:{self.port}"
 
     async def start(self) -> None:
+        _apply_trace("tracker", self.trace_config)
         self._runner, self.port = await _serve(
             self.server.make_app(), self.host, self.port, "tracker",
             ssl_context=self.ssl_context,
@@ -261,9 +296,12 @@ class TrackerNode:
         ))
 
     def reload(self, cfg: dict) -> None:
-        """SIGHUP: apply the ``rpc:`` section to the metainfo-proxy
-        cluster client live (hedge delay, read deadline, brown-out
-        threshold on its breaker)."""
+        """SIGHUP: apply the ``trace:`` and ``rpc:`` sections live (the
+        latter to the metainfo-proxy cluster client -- hedge delay, read
+        deadline, brown-out threshold on its breaker)."""
+        if cfg.get("trace") is not None:
+            self.trace_config = _trace_config(cfg["trace"])
+            _apply_trace("tracker", self.trace_config)
         if cfg.get("rpc") is None:
             return
         self.rpc = _rpc_config(cfg["rpc"])
@@ -319,6 +357,7 @@ class OriginNode:
         task_timeout_seconds: float = 1800.0,
         rpc: dict | RPCConfig | None = None,
         resources: dict | ResourcesConfig | None = None,
+        trace: dict | TraceConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -402,6 +441,10 @@ class OriginNode:
         # bufpool/conn/orphan audit with YAML budgets (`resources:`);
         # a sustained breach can opt into the lameduck drain.
         self.resources_config = _resources_config(resources)
+        # Distributed tracing + flight recorder (utils/trace.py): YAML
+        # `trace:` knobs -- sampling, slow-tail threshold, ring size,
+        # dump throttle; SIGHUP live-reloads. Applied at start().
+        self.trace_config = _trace_config(trace)
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -459,6 +502,9 @@ class OriginNode:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        # Trace config FIRST: the scheduler start below forks seed-serve
+        # workers, which inherit the tracer's applied config wholesale.
+        _apply_trace("origin", self.trace_config, self.store.root)
         # Startup fsck BEFORE any listener binds: the tree must be
         # reconciled (orphans swept, crash-window blobs verified) before
         # the swarm, replication, or writeback can stream from it.
@@ -615,6 +661,9 @@ class OriginNode:
             self.resources_config = _resources_config(cfg["resources"])
             if self.sentinel is not None:
                 self.sentinel.apply(self.resources_config)
+        if cfg.get("trace") is not None:
+            self.trace_config = _trace_config(cfg["trace"])
+            _apply_trace("origin", self.trace_config, self.store.root)
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -924,6 +973,7 @@ class AgentNode:
         fsck: bool = True,
         rpc: dict | RPCConfig | None = None,
         resources: dict | ResourcesConfig | None = None,
+        trace: dict | TraceConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -980,6 +1030,8 @@ class AgentNode:
         self.rpc = _rpc_config(rpc)
         # Resource sentinel budgets (YAML `resources:`; live-reloadable).
         self.resources_config = _resources_config(resources)
+        # Tracing knobs (YAML `trace:`; live-reloadable; utils/trace.py).
+        self.trace_config = _trace_config(trace)
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -1021,6 +1073,9 @@ class AgentNode:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        # Trace config before the scheduler forks any seed-serve worker
+        # (the fork inherits the applied tracer config).
+        _apply_trace("agent", self.trace_config, self.store.root)
         if self.fsck_enabled:
             self.fsck_report = await asyncio.to_thread(
                 run_fsck,
@@ -1107,6 +1162,9 @@ class AgentNode:
             self.resources_config = _resources_config(cfg["resources"])
             if self.sentinel is not None:
                 self.sentinel.apply(self.resources_config)
+        if cfg.get("trace") is not None:
+            self.trace_config = _trace_config(cfg["trace"])
+            _apply_trace("agent", self.trace_config, self.store.root)
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,

@@ -87,6 +87,83 @@ async def _run_until_signal(node, describe: dict,
     await node.stop()
 
 
+def run_trace_tool(paths: list[str], trace_id: str | None = None,
+                   slowest: int = 0) -> int:
+    """`kraken-tpu trace`: reassemble flight-recorder JSONL dumps
+    offline (multi-node -- pass every node's dump to join a cross-node
+    trace) and print indented span trees, critical path marked with
+    ``*``. Returns the process exit code: 0 joined clean, 1 when any
+    span is an ORPHAN (its parent_id names a span absent from the set:
+    a hop dropped the context, or a node's dump is missing -- CI gates
+    on this), 3 usage error. In-process callable for tests."""
+    from kraken_tpu.utils.trace import (
+        assemble_tree,
+        critical_path,
+        format_tree,
+        load_dumps,
+    )
+
+    try:
+        by_trace = load_dumps(paths)
+    except OSError as e:
+        print(json.dumps({"event": "error", "message": str(e)}), flush=True)
+        return 3
+    if trace_id is not None:
+        if trace_id not in by_trace:
+            print(json.dumps({
+                "event": "error",
+                "message": f"trace {trace_id} not found in dumps",
+            }), flush=True)
+            return 1
+        by_trace = {trace_id: by_trace[trace_id]}
+
+    def span_end(s: dict) -> float:
+        return s.get("start_ts", 0.0) + s.get("duration_s", 0.0)
+
+    def trace_duration(spans: list[dict]) -> float:
+        if not spans:
+            return 0.0
+        return max(span_end(s) for s in spans) - min(
+            s.get("start_ts", 0.0) for s in spans
+        )
+
+    ordered = sorted(
+        by_trace.items(), key=lambda kv: trace_duration(kv[1]), reverse=True
+    )
+    if slowest > 0:
+        ordered = ordered[:slowest]
+
+    total_orphans = 0
+    for tid, spans in ordered:
+        roots, orphans = assemble_tree(spans)
+        total_orphans += len(orphans)
+        nodes = sorted({s.get("node", "") for s in spans if s.get("node")})
+        errored = sum(1 for s in spans if s.get("status") == "error")
+        print(
+            f"trace {tid}  spans={len(spans)}"
+            f"  duration={trace_duration(spans) * 1e3:.1f}ms"
+            f"  nodes={','.join(nodes) or '-'}"
+            + (f"  errors={errored}" if errored else "")
+        )
+        for root in roots:
+            for line in format_tree(root, critical_path(root)):
+                print(line)
+        for s in orphans:
+            print(
+                f"! ORPHAN {s.get('name', '?')} span={s.get('span_id')}"
+                f" parent={s.get('parent_id')} -- parent span missing"
+                f" from the dump set (propagation break or absent node"
+                f" dump)"
+            )
+        print()
+    print(json.dumps({
+        "event": "trace_done",
+        "traces": len(ordered),
+        "orphans": total_orphans,
+    }), flush=True)
+    return 1 if total_orphans else 0
+
+
 def _common(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("--host", default=None)
@@ -200,6 +277,25 @@ def main(argv: list[str] | None = None) -> None:
                         help="content verification scope: auto ="
                              " crash-window only (clean-shutdown stamp),"
                              " all = every blob, none = skip")
+
+    p_trace = sub.add_parser(
+        "trace", help="offline flight-recorder reassembly: read one or"
+        " more trace dump JSONL files (multi-node), join spans by"
+        " trace_id, and print indented span trees with durations and"
+        " the critical path marked; exit 1 when any span names a parent"
+        " absent from the set (a propagation break -- CI gates on it),"
+        " 3 on usage errors"
+    )
+    p_trace.add_argument("dumps", nargs="+",
+                         help="flight-recorder JSONL dump files (from"
+                              " /debug/trace dump triggers; combine"
+                              " dumps from several nodes to join a"
+                              " cross-node trace)")
+    p_trace.add_argument("--trace-id", default=None,
+                         help="print only this trace (exit 1 if absent"
+                              " from the dumps)")
+    p_trace.add_argument("--slowest", type=int, default=0,
+                         help="print only the N slowest traces")
 
     p_locate = sub.add_parser(
         "locate", help="print a digest's ring placement offline"
@@ -327,6 +423,14 @@ def main(argv: list[str] | None = None) -> None:
         }), flush=True)
         sys.exit(report.exit_code)
 
+
+    if args.component == "trace":
+        sys_exit = run_trace_tool(
+            args.dumps, trace_id=args.trace_id, slowest=args.slowest
+        )
+        import sys
+
+        sys.exit(sys_exit)
 
     if args.component == "locate":
         # Where does the ring place a digest? The operator's "which
@@ -491,6 +595,7 @@ def main(argv: list[str] | None = None) -> None:
             redis_addr=cfg.get("peerstore_redis", ""),
             ssl_context=ssl_context,
             rpc=rpc_cfg,
+            trace=cfg.get("trace"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "tracker"}, args.config)
@@ -584,6 +689,7 @@ def main(argv: list[str] | None = None) -> None:
             ),
             rpc=rpc_cfg,
             resources=resources_cfg,
+            trace=cfg.get("trace"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -624,6 +730,7 @@ def main(argv: list[str] | None = None) -> None:
             fsck=fsck_enabled,
             rpc=rpc_cfg,
             resources=resources_cfg,
+            trace=cfg.get("trace"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -18,7 +18,7 @@ from kraken_tpu.core.peer import BlobInfo
 from kraken_tpu.placement.hashring import Ring
 from urllib.parse import quote
 
-from kraken_tpu.utils import failpoints
+from kraken_tpu.utils import failpoints, trace
 from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
 from kraken_tpu.utils.metrics import REGISTRY
@@ -253,33 +253,41 @@ class ClusterClient:
             h.release_probe(addr, token)
 
     async def _attempt(self, c: BlobClient, op, deadline, as_hedge: bool,
-                       probe_token=None):
+                       probe_token=None, op_name: str = "rpc"):
         """One replica attempt: latency-timed, outcome fed to the
         breaker. Two outcomes are NOT host evidence: a cancelled attempt
         (losing hedge, teardown) and the caller's own budget running out
         (DeadlineExceeded) -- blaming the host for either would trip or
         re-open breakers on replicas that never misbehaved. Both return
-        the probe token and stay silent."""
+        the probe token and stay silent.
+
+        Each attempt is its own child span (``hedge`` attr marks the
+        racers), so a hedged read shows up in /debug/trace as the primary
+        and the hedge side by side -- which one won, and by how much, is
+        readable off the tree instead of inferred from counters."""
         if as_hedge:
             # Failpoint rpc.hedge.lose: delay the hedge so the primary
             # wins the race -- drives the loser-cancellation chaos path.
             hit = failpoints.fire("rpc.hedge.lose")
             if hit:
                 await asyncio.sleep(hit.delay_s)
-        t0 = time.monotonic()
-        try:
-            out = await op(c, deadline)
-        except asyncio.CancelledError:
-            self._release_probe(c.addr, probe_token)
-            raise
-        except DeadlineExceeded:
-            self._release_probe(c.addr, probe_token)
-            raise
-        except Exception:
-            self._observe(c, False, time.monotonic() - t0)
-            raise
-        self._observe(c, True, time.monotonic() - t0)
-        return out
+        with trace.span(
+            f"rpc.{op_name}", addr=c.addr, hedge=as_hedge,
+        ):
+            t0 = time.monotonic()
+            try:
+                out = await op(c, deadline)
+            except asyncio.CancelledError:
+                self._release_probe(c.addr, probe_token)
+                raise
+            except DeadlineExceeded:
+                self._release_probe(c.addr, probe_token)
+                raise
+            except Exception:
+                self._observe(c, False, time.monotonic() - t0)
+                raise
+            self._observe(c, True, time.monotonic() - t0)
+            return out
 
     async def _try_each(
         self, d: Digest, op, *, default=_RAISE,
@@ -319,6 +327,7 @@ class ClusterClient:
                 return await self._attempt(
                     c, op, deadline, as_hedge=False,
                     probe_token=None if admitted is True else admitted,
+                    op_name=op_name,
                 )
             except DeadlineExceeded:
                 raise  # the budget is gone: walking further is theater
@@ -368,7 +377,7 @@ class ClusterClient:
                 token = None if admitted is True else admitted
                 t = asyncio.create_task(
                     self._attempt(c, op, deadline, as_hedge,
-                                  probe_token=token)
+                                  probe_token=token, op_name=op_name)
                 )
                 if token is not None:
                     # A task cancelled before its first step never runs

@@ -36,7 +36,7 @@ from kraken_tpu.p2p.wire import (
     send_message,
     send_messages,
 )
-from kraken_tpu.utils import failpoints
+from kraken_tpu.utils import failpoints, trace
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.bufpool import BufferPool
 
@@ -56,6 +56,10 @@ class HandshakeResult:
     namespace: str
     bitfield: bytes
     num_pieces: int
+    # The dialer's traceparent (utils/trace.py), "" when it had no
+    # active trace: serve spans on the accept side join this trace, and
+    # it travels with the shardpool handoff descriptor.
+    traceparent: str = ""
 
 
 class Conn:
@@ -339,12 +343,14 @@ async def handshake_outbound(
     num_pieces: int,
     timeout: float = 10.0,
 ) -> HandshakeResult:
-    """Dial-side handshake: send ours, await theirs."""
+    """Dial-side handshake: send ours, await theirs. The active trace
+    context (the dial span) rides the handshake so the remote's serve
+    spans join this download's trace."""
     await send_message(
         writer,
         Message.handshake(
             str(own_peer_id), info_hash.hex, name, namespace, own_bitfield,
-            num_pieces,
+            num_pieces, traceparent=trace.current_traceparent() or "",
         ),
     )
     return await _read_handshake(reader, timeout)
@@ -397,6 +403,7 @@ async def _read_handshake(reader: asyncio.StreamReader, timeout: float) -> Hands
             namespace=h["namespace"],
             bitfield=msg.payload,
             num_pieces=h["num_pieces"],
+            traceparent=str(h.get("tp", "") or ""),
         )
     except (KeyError, ValueError) as e:
         raise WireError(f"malformed handshake: {e}") from e

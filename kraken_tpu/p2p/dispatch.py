@@ -13,6 +13,7 @@ single-thread invariant mirrors the reference's single-goroutine design).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from typing import Callable, Optional
 
 from kraken_tpu.core.peer import PeerID
@@ -21,6 +22,7 @@ from kraken_tpu.p2p.networkevent import NoopProducer, Producer
 from kraken_tpu.p2p.piecerequest import RequestManager
 from kraken_tpu.p2p.storage import PieceError, Torrent
 from kraken_tpu.p2p.wire import Message, MsgType
+from kraken_tpu.utils import trace
 
 
 def _bits_to_set(bits: bytes, num_pieces: int) -> set[int]:
@@ -244,7 +246,8 @@ class Dispatcher:
     # bound, each pending serve holds a piece-sized buffer and a hostile
     # leecher could drive a seeder to OOM.
 
-    def _admit_serve(self, peer: _Peer, idx: int) -> None:
+    def _admit_serve(self, peer: _Peer, idx: int,
+                     tp: str | None = None) -> None:
         """``serving`` must be bumped HERE, synchronously at admission:
         ``conn.recv()`` on already-buffered frames completes without
         yielding to the loop, so a burst of buffered PIECE_REQUESTs would
@@ -253,7 +256,7 @@ class Dispatcher:
         exists to prevent. Decrement in the task's done callback, so
         cancellation-before-first-step can't leak the slot."""
         peer.serving += 1
-        t = self._spawn_io(peer, self._serve_piece(peer, idx))
+        t = self._spawn_io(peer, self._serve_piece(peer, idx, tp))
 
         def release(_task: asyncio.Task) -> None:
             peer.serving -= 1
@@ -307,9 +310,20 @@ class Dispatcher:
 
         t.add_done_callback(release)
 
-    async def _serve_piece(self, peer: _Peer, idx: int) -> None:
-        data = await self.torrent.read_piece_async(idx)
-        await peer.conn.send(Message.piece_payload(idx, data))
+    async def _serve_piece(self, peer: _Peer, idx: int,
+                           tp: str | None = None) -> None:
+        # The serve span joins the REQUESTER's trace (the PIECE_REQUEST
+        # carried its traceparent only when that trace is sampled), so
+        # request -> serve -> payload reads as one tree across nodes.
+        parent = trace.parse_traceparent(tp)
+        cm = (
+            trace.span("p2p.piece.serve", parent, piece=idx,
+                       peer=peer.conn.peer_id.hex[:12])
+            if parent is not None else contextlib.nullcontext()
+        )
+        with cm:
+            data = await self.torrent.read_piece_async(idx)
+            await peer.conn.send(Message.piece_payload(idx, data))
         self._bytes_up += len(data)
         # A completed send is progress: an honest-but-slow link keeps
         # earning its churn exemption one delivered piece at a time.
@@ -327,7 +341,7 @@ class Dispatcher:
                 self.torrent.has_piece(idx)
                 and peer.serving < self._MAX_SERVING_PER_PEER
             ):
-                self._admit_serve(peer, idx)
+                self._admit_serve(peer, idx, msg.header.get("tp"))
         elif msg.type == MsgType.PIECE_PAYLOAD:
             # Cold path: payloads that queued before the fast-path handler
             # was registered (or in unit tests driving _handle directly).
@@ -360,7 +374,17 @@ class Dispatcher:
             self.requests.clear_piece(idx)
             await self._request_more(peer)
             return
-        completed = await self.torrent.write_piece(idx, data)  # raises PieceError
+        # Per-piece receive span (verify + pwrite) -- gated on the
+        # trace's sampled flag so the data-plane hot path pays nothing
+        # on unsampled pulls (the trace-on overhead band pins this).
+        cm = (
+            trace.span("p2p.piece.receive", piece=idx, size=len(data),
+                       peer=peer.conn.peer_id.hex[:12])
+            if trace.current_traceparent(sampled_only=True) is not None
+            else contextlib.nullcontext()
+        )
+        with cm:
+            completed = await self.torrent.write_piece(idx, data)  # raises PieceError
         self.requests.clear_piece(idx)
         # Fan the new piece out to the swarm.
         for other in list(self._peers.values()):
@@ -415,12 +439,26 @@ class Dispatcher:
             self.torrent.missing_pieces(),
             self._availability(),
         )
-        for idx in chosen:
-            self.events.emit(
-                "request_piece", self.torrent.info_hash.hex,
-                peer=peer.conn.peer_id.hex, piece=idx,
-            )
-            await peer.conn.send(Message.piece_request(idx))
+        if not chosen:
+            return
+        # On a sampled trace each request batch is a span and every
+        # PIECE_REQUEST frame carries the traceparent, so the remote's
+        # serve spans (dispatcher or shardpool worker) join this trace.
+        tp = trace.current_traceparent(sampled_only=True)
+        cm = (
+            trace.span("p2p.piece.request", pieces=len(chosen),
+                       peer=peer.conn.peer_id.hex[:12])
+            if tp is not None else contextlib.nullcontext()
+        )
+        with cm as sp:
+            if sp is not None:
+                tp = sp.traceparent  # serve spans nest under this batch
+            for idx in chosen:
+                self.events.emit(
+                    "request_piece", self.torrent.info_hash.hex,
+                    peer=peer.conn.peer_id.hex, piece=idx,
+                )
+                await peer.conn.send(Message.piece_request(idx, tp))
 
     # -- timers (driven by the scheduler) ----------------------------------
 

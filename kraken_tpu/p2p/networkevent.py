@@ -12,6 +12,8 @@ import json
 import time
 from typing import IO, Optional
 
+from kraken_tpu.utils import trace
+
 
 class Name:
     ADD_TORRENT = "add_torrent"
@@ -48,6 +50,12 @@ class Producer:
             "info_hash": info_hash,
             **fields,
         }
+        # Events emitted under an active span carry its trace id, so
+        # offline swarm reconstructions (JSONL) join the distributed
+        # traces -- the one key that connects the two planes.
+        ids = trace.current_ids()
+        if ids is not None:
+            event["trace_id"] = ids[0]
         if self._sink is not None:
             # Tracing must never affect the data plane: a full disk or a
             # closed sink is an observability failure, not peer

@@ -41,6 +41,7 @@ from kraken_tpu.p2p.storage import Torrent
 from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 
+from kraken_tpu.utils import trace
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.bufpool import BufferPool
 from kraken_tpu.utils.dedup import RequestCoalescer
@@ -158,6 +159,11 @@ class _TorrentControl:
         self.namespace = namespace
         self.dispatcher = dispatcher
         self.tasks: set[asyncio.Task] = set()
+        # The download's trace context (utils/trace.py): announce and
+        # dial tasks are spawned from long-lived pump loops, OUTSIDE the
+        # downloader's contextvar scope, so the control carries the
+        # parent explicitly for them to join. None for pure seeders.
+        self.trace_parent: trace.ParentContext | None = None
 
     def spawn(self, coro) -> asyncio.Task:
         """Track a task for cleanup; finished tasks self-prune (a seeding
@@ -233,6 +239,11 @@ class Scheduler:
         # POST /debug/lameduck; the tracker's peer TTL then ages this
         # node out of handouts.
         self.lameduck = False
+        # Terminal: set by stop(). A download racing stop past its
+        # metainfo await must not create a fresh control (whose
+        # _retry_loop nothing would ever cancel -- stop already swept
+        # self._controls).
+        self._stopped = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -277,6 +288,7 @@ class Scheduler:
         self._announce_pump_task = asyncio.create_task(self._announce_pump())
 
     async def stop(self) -> None:
+        self._stopped = True
         if self._announce_pump_task is not None:
             self._announce_pump_task.cancel()
         for t in list(self._announce_tasks):
@@ -331,9 +343,27 @@ class Scheduler:
 
     async def _download(self, namespace: str, d: Digest) -> None:
         start = asyncio.get_running_loop().time()
-        metainfo = await self.metainfo_client.get(namespace, d)
-        ctl = self._get_or_create_control(metainfo, namespace)
-        await asyncio.shield(ctl.dispatcher.done)
+        # The pull's root-most p2p span: a child of the HTTP server span
+        # when the download came through an agent endpoint, a fresh
+        # sampled-or-not root for direct callers. Announce/dial tasks
+        # join via ctl.trace_parent (they run outside this context).
+        with trace.span(
+            "p2p.download", digest=d.hex[:12], namespace=namespace,
+        ) as sp:
+            metainfo = await self.metainfo_client.get(namespace, d)
+            ctl = self._get_or_create_control(metainfo, namespace)
+            if sp is not None and ctl.trace_parent is None:
+                ctl.trace_parent = trace.ParentContext(
+                    sp.trace_id, sp.span_id, sp.sampled
+                )
+            try:
+                await asyncio.shield(ctl.dispatcher.done)
+            finally:
+                # The pull is over (or failed): seed-phase re-announces
+                # must not keep joining -- and inflating -- the
+                # download's trace for the torrent's whole seeding life;
+                # from here they are their own sampled-or-not roots.
+                ctl.trace_parent = None
         # Per-torrent lifecycle summary (the reference's torrentlog):
         # one line per completed download with the operative numbers.
         _log.info(
@@ -397,6 +427,10 @@ class Scheduler:
         ctl = self._controls.get(h)
         if ctl is not None:
             return ctl
+        if self._stopped:
+            # stop() already swept the controls; creating one now would
+            # leak its retry loop (and re-announce a dead node).
+            raise RuntimeError("scheduler is stopped")
         torrent = self.archive.create_torrent(metainfo)
         dispatcher = Dispatcher(
             torrent,
@@ -465,9 +499,16 @@ class Scheduler:
             else self.config.announce_interval
         )
         try:
-            peers, interval_r = await self.announce_client.announce(
-                ctl.torrent.digest, h, ctl.namespace, complete
-            )
+            # Child of the download's root span (the announce pump task
+            # itself carries no context); seeders' re-announces become
+            # their own sampled-or-not roots.
+            with trace.span(
+                "p2p.announce", ctl.trace_parent,
+                info_hash=h.hex[:12], complete=complete,
+            ):
+                peers, interval_r = await self.announce_client.announce(
+                    ctl.torrent.digest, h, ctl.namespace, complete
+                )
             if not complete and interval_r:
                 interval = interval_r
             self.events.emit("announce", h.hex, returned=len(peers))
@@ -502,42 +543,58 @@ class Scheduler:
 
     async def _dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
         h = ctl.torrent.info_hash
-        try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(peer.ip, peer.port, limit=_WIRE_BUF),
-                self.config.dial_timeout,
-            )
-            theirs = await handshake_outbound(
-                reader,
-                writer,
-                self.peer_id,
-                h,
-                ctl.torrent.metainfo.name,
-                ctl.namespace,
-                ctl.torrent.bitfield(),
-                ctl.torrent.num_pieces,
-                timeout=self.config.dial_timeout,
-            )
-        except (PeerBusyError, OSError, asyncio.TimeoutError):
+        # The dial span ADOPTS the conn: _adopt runs inside it, so the
+        # conn's pumps (and every io task they spawn) inherit this
+        # context -- piece requests/receives nest under the dial, and
+        # the outbound handshake carries its traceparent to the remote.
+        with trace.span(
+            "p2p.dial", ctl.trace_parent,
+            peer=f"{peer.ip}:{peer.port}", info_hash=h.hex[:12],
+        ) as sp:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        peer.ip, peer.port, limit=_WIRE_BUF
+                    ),
+                    self.config.dial_timeout,
+                )
+                theirs = await handshake_outbound(
+                    reader,
+                    writer,
+                    self.peer_id,
+                    h,
+                    ctl.torrent.metainfo.name,
+                    ctl.namespace,
+                    ctl.torrent.bitfield(),
+                    ctl.torrent.num_pieces,
+                    timeout=self.config.dial_timeout,
+                )
+            except (PeerBusyError, OSError, asyncio.TimeoutError) as e:
+                if sp is not None:
+                    sp.mark_error(e)
+                self.conn_state.remove_pending(peer.peer_id, h)
+                # Connectivity failure (refused / at-capacity / timeout),
+                # not misbehavior: short soft cool-off so a flash crowd
+                # retries the seeder within seconds once churn frees its
+                # slots.
+                self.conn_state.blacklist.add(peer.peer_id, h, soft=True)
+                return
+            except WireError as e:
+                if sp is not None:
+                    sp.mark_error(e)
+                self.conn_state.remove_pending(peer.peer_id, h)
+                # Garbage handshake = misbehavior: exponential backoff.
+                self.conn_state.blacklist.add(peer.peer_id, h)
+                return
+            # The handshaked identity wins over the (possibly stale)
+            # announced one: release the announced pending slot before
+            # promoting, or a restarted peer with a new id would leak
+            # pending slots forever.
             self.conn_state.remove_pending(peer.peer_id, h)
-            # Connectivity failure (refused / at-capacity / timeout), not
-            # misbehavior: short soft cool-off so a flash crowd retries the
-            # seeder within seconds once churn frees its slots.
-            self.conn_state.blacklist.add(peer.peer_id, h, soft=True)
-            return
-        except WireError:
-            self.conn_state.remove_pending(peer.peer_id, h)
-            # Garbage handshake = misbehavior: exponential backoff.
-            self.conn_state.blacklist.add(peer.peer_id, h)
-            return
-        # The handshaked identity wins over the (possibly stale) announced
-        # one: release the announced pending slot before promoting, or a
-        # restarted peer with a new id would leak pending slots forever.
-        self.conn_state.remove_pending(peer.peer_id, h)
-        if not self.conn_state.promote(theirs.peer_id, h):
-            writer.close()
-            return
-        self._adopt(ctl, reader, writer, theirs)
+            if not self.conn_state.promote(theirs.peer_id, h):
+                writer.close()
+                return
+            self._adopt(ctl, reader, writer, theirs)
 
     # -- inbound conns -----------------------------------------------------
 
@@ -613,6 +670,10 @@ class Scheduler:
             "np": ctl.torrent.num_pieces,
             "path": ctl.torrent.blob_path,
             "residual": residual,
+            # The dialer's trace context rides the handoff: the worker's
+            # serve spans join the leecher's trace even though they run
+            # in a forked process (spans ship home over this channel).
+            "tp": theirs.traceparent,
         }
         try:
             dup = sock.dup()
@@ -674,7 +735,13 @@ class Scheduler:
             metainfo = self._metainfo_resolver(hs.name, hs.namespace)
             if metainfo is None or metainfo.info_hash != hs.info_hash:
                 raise KeyError(hs.info_hash.hex)
-            ctl = self._get_or_create_control(metainfo, hs.namespace)
+            try:
+                ctl = self._get_or_create_control(metainfo, hs.namespace)
+            except RuntimeError:
+                # stop() swept the controls while this handshake was in
+                # flight: reject the conn (the KeyError contract above),
+                # don't crash the acceptor and strand the peer's socket.
+                raise KeyError(hs.info_hash.hex) from None
         return ctl.torrent.bitfield(), ctl.torrent.num_pieces
 
     def _adopt(

@@ -58,7 +58,7 @@ from typing import Callable, Optional
 import msgpack
 
 from kraken_tpu.p2p.wire import MAX_HEADER, MAX_PAYLOAD, MsgType
-from kraken_tpu.utils import failpoints
+from kraken_tpu.utils import failpoints, trace
 
 _log = logging.getLogger("kraken.p2p.shard")
 
@@ -142,7 +142,7 @@ class _WorkerTorrent:
 
 
 class _WorkerConn:
-    __slots__ = ("cid", "sock", "torrent", "buf", "task", "peer", "ih")
+    __slots__ = ("cid", "sock", "torrent", "buf", "task", "peer", "ih", "tp")
 
     def __init__(self, cid: int, sock: socket.socket, torrent: _WorkerTorrent,
                  desc: dict):
@@ -153,6 +153,10 @@ class _WorkerConn:
         self.task: Optional[asyncio.Task] = None
         self.peer = desc["peer"]
         self.ih = desc["ih"]
+        # Conn-level trace context from the leecher's handshake (rode
+        # the handoff descriptor); per-request PIECE_REQUEST "tp"
+        # headers override it for finer nesting.
+        self.tp = desc.get("tp") or ""
 
 
 class _WorkerState:
@@ -172,6 +176,10 @@ class _WorkerState:
         self.lameduck = False
         self._stop_evt = asyncio.Event()
         self._stats_dirty = True
+        # Finished serve spans awaiting shipment to the parent (fed by
+        # the tracer's on_record hook; drained with the stats tick).
+        # Bounded: a backlogged parent must cost spans, not RSS.
+        self._span_buf: list[dict] = []
 
     # -- control channel ---------------------------------------------------
 
@@ -346,10 +354,28 @@ class _WorkerState:
                 await self._wait_writable(conn.sock)
         await asyncio.sleep(0)  # serve fairness between conns of a shard
 
-    async def _serve_piece(self, conn: _WorkerConn, idx: int) -> None:
+    async def _serve_piece(self, conn: _WorkerConn, idx: int,
+                           tp: str = "") -> None:
         """The hot path: prefix+header corked, payload via sendfile from
         the long-lived blob fd -- piece bytes never enter this process's
-        userspace (page cache -> socket in the kernel)."""
+        userspace (page cache -> socket in the kernel).
+
+        ``tp`` is the requester's traceparent (frame-level, falling back
+        to the handshake's): present only on SAMPLED traces, in which
+        case the serve gets a span that ships home to the parent's
+        flight recorder -- the cross-process half of "one trace per
+        pull"."""
+        parent = trace.parse_traceparent(tp or conn.tp)
+        if parent is not None and parent.sampled:
+            with trace.span(
+                "p2p.shard.serve", parent, piece=idx,
+                peer=conn.peer[:12],
+            ):
+                await self._serve_piece_inner(conn, idx)
+        else:
+            await self._serve_piece_inner(conn, idx)
+
+    async def _serve_piece_inner(self, conn: _WorkerConn, idx: int) -> None:
         hit = failpoints.fire("p2p.shard.serve.disconnect")
         if hit:
             if hit.delay_s:
@@ -403,7 +429,7 @@ class _WorkerState:
             t = conn.torrent
             if not isinstance(idx, int) or not 0 <= idx < t.num_pieces:
                 raise _Misbehavior(f"piece index out of range: {idx!r}")
-            await self._serve_piece(conn, idx)
+            await self._serve_piece(conn, idx, str(header.get("tp") or ""))
         elif mtype == MsgType.ERROR:
             raise ConnectionResetError(header.get("detail", "peer error"))
         # ANNOUNCE_PIECE / COMPLETE / CANCEL_PIECE / BITFIELD /
@@ -503,11 +529,39 @@ class _WorkerState:
             "lameduck": self.lameduck,
         })
         self._stats_dirty = False
+        self._ship_spans()
+
+    _SPAN_BUF_MAX = 2048  # drop-oldest bound on the shipping buffer
+    _SPAN_BATCH = 64  # spans per SEQPACKET message (size-bounded frames)
+
+    def _on_span(self, d: dict) -> None:
+        self._span_buf.append(d)
+        if len(self._span_buf) > self._SPAN_BUF_MAX:
+            del self._span_buf[: -self._SPAN_BUF_MAX]
+
+    def _ship_spans(self) -> None:
+        """Drain finished serve spans home; the parent adopts them into
+        its flight recorder (record_foreign) so /debug/trace and the
+        dump triggers see worker serves like any main-loop span."""
+        while self._span_buf:
+            batch = self._span_buf[: self._SPAN_BATCH]
+            del self._span_buf[: self._SPAN_BATCH]
+            self._send({"t": "spans", "spans": batch})
 
     async def run(self) -> None:
         loop = asyncio.get_running_loop()
         self.ctrl.setblocking(False)
         loop.add_reader(self.ctrl.fileno(), self._on_ctrl)
+        # This fork inherited the parent's tracer wholesale: keep the
+        # (pre-fork) config, but drop the parent's recorded spans --
+        # they already live in the parent's ring -- stamp the shard on
+        # the node id, and buffer this process's spans for shipment.
+        trace.TRACER.recorder.clear()
+        trace.TRACER.node = (
+            f"{trace.TRACER.node}/shard{self.shard}"
+            if trace.TRACER.node else f"shard{self.shard}"
+        )
+        trace.TRACER.on_record = self._on_span
         self._send({"t": "ready", "pid": os.getpid()})
         try:
             while not self._stop_evt.is_set():
@@ -844,6 +898,11 @@ class ShardPool:
                 self._safe_conn_closed(
                     desc, msg.get("reason", ""), bool(msg.get("mis"))
                 )
+        elif t == "spans":
+            # Worker serve spans come home: adopt them so the parent's
+            # /debug/trace and flight-recorder dumps hold the WHOLE
+            # data plane, forked halves included.
+            trace.TRACER.record_foreign(msg.get("spans") or [])
         elif t == "ready":
             pass
 

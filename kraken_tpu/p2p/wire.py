@@ -107,30 +107,39 @@ class Message:
     @classmethod
     def handshake(
         cls, peer_id: str, info_hash: str, name: str, namespace: str,
-        bitfield: bytes, num_pieces: int,
+        bitfield: bytes, num_pieces: int, traceparent: str = "",
     ) -> "Message":
         """``name`` is the blob digest hex -- carried alongside the info
         hash so the accepting side can load its stored metainfo directly
-        (no reverse info-hash index needed)."""
-        return cls(
-            MsgType.HANDSHAKE,
-            {
-                "peer_id": peer_id,
-                "info_hash": info_hash,
-                "name": name,
-                "namespace": namespace,
-                "num_pieces": num_pieces,
-            },
-            payload=bitfield,
-        )
+        (no reverse info-hash index needed). ``traceparent`` (dial side
+        only) lets the accepting node's serve spans join the dialer's
+        trace (utils/trace.py); absent for peers without an active
+        trace."""
+        header = {
+            "peer_id": peer_id,
+            "info_hash": info_hash,
+            "name": name,
+            "namespace": namespace,
+            "num_pieces": num_pieces,
+        }
+        if traceparent:
+            header["tp"] = traceparent
+        return cls(MsgType.HANDSHAKE, header, payload=bitfield)
 
     @classmethod
     def bitfield(cls, bits: bytes, num_pieces: int) -> "Message":
         return cls(MsgType.BITFIELD, {"num_pieces": num_pieces}, payload=bits)
 
     @classmethod
-    def piece_request(cls, index: int) -> "Message":
-        return cls(MsgType.PIECE_REQUEST, {"index": index})
+    def piece_request(cls, index: int, traceparent: str | None = None) -> "Message":
+        """``traceparent`` joins the request to the leecher's SAMPLED
+        trace, so the remote's serve span (dispatcher or shardpool
+        worker) lands in the same tree; omitted on unsampled traces --
+        the serve side then creates no span at all."""
+        header: dict = {"index": index}
+        if traceparent:
+            header["tp"] = traceparent
+        return cls(MsgType.PIECE_REQUEST, header)
 
     @classmethod
     def piece_payload(cls, index: int, data: bytes) -> "Message":

@@ -176,13 +176,13 @@ class PassiveFilter:
             # The probe itself failed: straight back to open, with a
             # longer (decorrelated-jitter) cooldown than last time.
             s.probe_inflight = None
-            self._open(s, now)
+            self._open(s, now, host)
         else:
             if s.fails and now - s.last_fail > self.cooldown:
                 s.fails = 0  # stale streak: sporadic faults don't add up
             s.fails += 1
             if s.state == CLOSED and s.fails >= self.fail_threshold:
-                self._open(s, now)
+                self._open(s, now, host)
         s.last_fail = now
         self._publish(host, s)
 
@@ -200,11 +200,19 @@ class PassiveFilter:
             del self._fails[host]
         self._publish(host, s if host in self._fails else None)
 
-    def _open(self, s: _HostState, now: float) -> None:
+    def _open(self, s: _HostState, now: float, host: str = "") -> None:
         s.state = OPEN
         s.backoff_prev = self._jitter.next(s.backoff_prev)
         s.open_until = now + s.backoff_prev
         s.fails = 0
+        # A breaker trip is a degradation event: persist the flight
+        # recorder NOW (throttled, never raises) -- the spans that led
+        # here are the postmortem, and they age out of the ring fast.
+        from kraken_tpu.utils.trace import TRACER
+
+        TRACER.trigger_dump(
+            "breaker_trip", f"{self.name}: {host or 'unknown host'}"
+        )
 
     # -- admission ---------------------------------------------------------
 

@@ -22,6 +22,7 @@ from kraken_tpu.core.metainfo import InfoHash, MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
 from urllib.parse import quote
 
+from kraken_tpu.utils import trace
 from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import REGISTRY
@@ -67,11 +68,23 @@ class TrackerClient:
             else None
         )
         try:
-            body = await self._http.post(
-                f"{base_url(self.addr)}/announce",
-                data=json.dumps({"info_hash": h.hex, "peer": me.to_dict()}),
-                deadline=deadline,
-            )
+            # The announce span is what /debug/trace shows for the hop;
+            # the HTTP client span inside injects the traceparent header
+            # so the tracker's server span joins the same trace.
+            # `d` is optional here (announce by bare info hash): the
+            # span must not be the first thing that dereferences it.
+            with trace.span(
+                "tracker.announce",
+                digest=d.hex[:12] if d is not None else "",
+                complete=complete,
+            ):
+                body = await self._http.post(
+                    f"{base_url(self.addr)}/announce",
+                    data=json.dumps(
+                        {"info_hash": h.hex, "peer": me.to_dict()}
+                    ),
+                    deadline=deadline,
+                )
         except DeadlineExceeded:
             REGISTRY.counter(
                 "announce_timeouts_total",
@@ -82,9 +95,11 @@ class TrackerClient:
         return [PeerInfo.from_dict(p) for p in doc["peers"]], float(doc["interval"])
 
     async def get(self, namespace: str, d: Digest) -> MetaInfo:
-        raw = await self._http.get(
-            f"{base_url(self.addr)}/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
-        )
+        with trace.span("tracker.get_metainfo", digest=d.hex[:12]):
+            raw = await self._http.get(
+                f"{base_url(self.addr)}/namespace/"
+                f"{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
+            )
         return MetaInfo.deserialize(raw)
 
     async def close(self) -> None:

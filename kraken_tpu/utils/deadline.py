@@ -72,11 +72,18 @@ class Deadline:
         raises it -- ``raise deadline.exceeded(...) from last_err`` keeps
         the last attempt's failure in the chain."""
         from kraken_tpu.utils.metrics import REGISTRY
+        from kraken_tpu.utils.trace import TRACER
 
         REGISTRY.counter(
             "rpc_deadline_exceeded_total",
             "RPC give-ups because the caller's total budget ran out",
         ).inc(component=self.component or "unknown")
+        # A spent budget is a degradation event: dump the flight
+        # recorder (throttled per trigger kind, never raises) so the
+        # spans of the slow chain survive as a postmortem artifact.
+        TRACER.trigger_dump(
+            "deadline_exceeded", f"{self.component or 'unknown'}: {what}"
+        )
         return DeadlineExceeded(what, self.component)
 
 

@@ -20,7 +20,7 @@ import aiohttp
 # transfers of one dest path in one process must not share a tmp file).
 _tmp_seq = itertools.count()
 
-from kraken_tpu.utils import failpoints
+from kraken_tpu.utils import failpoints, trace
 from kraken_tpu.utils.backoff import Backoff
 from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded  # noqa: F401 (re-exported)
 from kraken_tpu.utils.metrics import REGISTRY
@@ -72,6 +72,19 @@ async def _failpoint_gate(method: str, url: str) -> "HTTPError | None":
     if failpoints.fire("httputil.request.error"):
         return HTTPError(method, url, 503, b"failpoint httputil.request.error")
     return None
+
+
+def _inject_traceparent(headers: dict | None) -> dict | None:
+    """Propagate the ACTIVE span's context on every outbound request
+    (W3C ``traceparent``), so the server side joins the caller's trace.
+    Called inside the client span, which is what the remote becomes a
+    child of. The caller's dict is never mutated."""
+    tp = trace.current_traceparent()
+    if tp is None:
+        return headers
+    h = dict(headers or {})
+    h.setdefault("traceparent", tp)
+    return h
 
 
 def _maybe_truncate(body: bytes) -> bytes:
@@ -217,41 +230,46 @@ class HTTPClient:
         retry_5xx: bool = True,
         deadline: Deadline | None = None,
     ) -> bytes:
-        last_err: Exception | None = None
-        for attempt in range(self._retries + 1):
-            if deadline is not None and deadline.expired:
-                _give_up(method, url, attempt, last_err)
-                raise deadline.exceeded(f"{method} {url}") from last_err
-            try:
-                injected = await _failpoint_gate(method, url)
-                if injected is not None:
-                    if not retry_5xx:
-                        raise injected
-                    last_err = injected
-                else:
-                    session = await self._get_session()
-                    kw = {}
-                    t = self._attempt_timeout(deadline)
-                    if t is not None:
-                        kw["timeout"] = t
-                    async with session.request(
-                        method, url, data=data, headers=headers, **kw
-                    ) as resp:
-                        body = await resp.read()
-                        if resp.status in ok_statuses:
-                            return _maybe_truncate(body)
-                        err = HTTPError(method, url, resp.status, body)
-                        # 4xx are semantic: no point retrying.
-                        if resp.status < 500 or not retry_5xx:
-                            raise err
-                        last_err = err
-            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
-                last_err = e
-            if attempt < self._retries:
-                await self._retry_pause(method, url, attempt, deadline, last_err)
-        assert last_err is not None
-        _give_up(method, url, self._retries + 1, last_err)
-        raise last_err
+        with trace.span(f"http.client {method}", url=url):
+            headers = _inject_traceparent(headers)
+            last_err: Exception | None = None
+            for attempt in range(self._retries + 1):
+                if deadline is not None and deadline.expired:
+                    _give_up(method, url, attempt, last_err)
+                    raise deadline.exceeded(f"{method} {url}") from last_err
+                try:
+                    injected = await _failpoint_gate(method, url)
+                    if injected is not None:
+                        if not retry_5xx:
+                            raise injected
+                        last_err = injected
+                    else:
+                        session = await self._get_session()
+                        kw = {}
+                        t = self._attempt_timeout(deadline)
+                        if t is not None:
+                            kw["timeout"] = t
+                        async with session.request(
+                            method, url, data=data, headers=headers, **kw
+                        ) as resp:
+                            body = await resp.read()
+                            if resp.status in ok_statuses:
+                                return _maybe_truncate(body)
+                            err = HTTPError(method, url, resp.status, body)
+                            # 4xx are semantic: no point retrying.
+                            if resp.status < 500 or not retry_5xx:
+                                raise err
+                            last_err = err
+                except (aiohttp.ClientConnectionError,
+                        asyncio.TimeoutError) as e:
+                    last_err = e
+                if attempt < self._retries:
+                    await self._retry_pause(
+                        method, url, attempt, deadline, last_err
+                    )
+            assert last_err is not None
+            _give_up(method, url, self._retries + 1, last_err)
+            raise last_err
 
     async def request_full(
         self,
@@ -268,44 +286,49 @@ class HTTPClient:
         """Like :meth:`request` but returns (status, headers, body) --
         needed by backends that read response headers (Content-Length,
         Docker-Content-Digest, redirect Location)."""
-        last_err: Exception | None = None
-        for attempt in range(self._retries + 1):
-            if deadline is not None and deadline.expired:
-                _give_up(method, url, attempt, last_err)
-                raise deadline.exceeded(f"{method} {url}") from last_err
-            try:
-                injected = await _failpoint_gate(method, url)
-                if injected is not None:
-                    if not retry_5xx:
-                        raise injected
-                    last_err = injected
-                else:
-                    session = await self._get_session()
-                    kw = {}
-                    t = self._attempt_timeout(deadline)
-                    if t is not None:
-                        kw["timeout"] = t
-                    async with session.request(
-                        method, url, data=data, headers=headers,
-                        allow_redirects=allow_redirects, **kw
-                    ) as resp:
-                        body = await resp.read()
-                        if resp.status in ok_statuses:
-                            return (
-                                resp.status, dict(resp.headers),
-                                _maybe_truncate(body),
-                            )
-                        err = HTTPError(method, url, resp.status, body)
-                        if resp.status < 500 or not retry_5xx:
-                            raise err
-                        last_err = err
-            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
-                last_err = e
-            if attempt < self._retries:
-                await self._retry_pause(method, url, attempt, deadline, last_err)
-        assert last_err is not None
-        _give_up(method, url, self._retries + 1, last_err)
-        raise last_err
+        with trace.span(f"http.client {method}", url=url):
+            headers = _inject_traceparent(headers)
+            last_err: Exception | None = None
+            for attempt in range(self._retries + 1):
+                if deadline is not None and deadline.expired:
+                    _give_up(method, url, attempt, last_err)
+                    raise deadline.exceeded(f"{method} {url}") from last_err
+                try:
+                    injected = await _failpoint_gate(method, url)
+                    if injected is not None:
+                        if not retry_5xx:
+                            raise injected
+                        last_err = injected
+                    else:
+                        session = await self._get_session()
+                        kw = {}
+                        t = self._attempt_timeout(deadline)
+                        if t is not None:
+                            kw["timeout"] = t
+                        async with session.request(
+                            method, url, data=data, headers=headers,
+                            allow_redirects=allow_redirects, **kw
+                        ) as resp:
+                            body = await resp.read()
+                            if resp.status in ok_statuses:
+                                return (
+                                    resp.status, dict(resp.headers),
+                                    _maybe_truncate(body),
+                                )
+                            err = HTTPError(method, url, resp.status, body)
+                            if resp.status < 500 or not retry_5xx:
+                                raise err
+                            last_err = err
+                except (aiohttp.ClientConnectionError,
+                        asyncio.TimeoutError) as e:
+                    last_err = e
+                if attempt < self._retries:
+                    await self._retry_pause(
+                        method, url, attempt, deadline, last_err
+                    )
+            assert last_err is not None
+            _give_up(method, url, self._retries + 1, last_err)
+            raise last_err
 
     async def get_to_file(
         self,
@@ -320,64 +343,72 @@ class HTTPClient:
         """Stream a GET body to ``dest_path`` (written via a temp file,
         atomically renamed) without buffering it in RAM; returns the byte
         count. Whole-transfer retries, same policy as :meth:`request`."""
-        last_err: Exception | None = None
-        # Unique per call, not just per process: hedged reads run two
-        # transfers of the SAME dest concurrently in one process, and a
-        # shared tmp name would let the loser tear the winner's bytes.
-        tmp = f"{dest_path}.http{os.getpid()}.{next(_tmp_seq)}.tmp"
-        for attempt in range(self._retries + 1):
-            if deadline is not None and deadline.expired:
-                _give_up("GET", url, attempt, last_err)
-                raise deadline.exceeded(f"GET {url}") from last_err
-            try:
-                injected = await _failpoint_gate("GET", url)
-                if injected is not None:
-                    if not retry_5xx:
-                        raise injected
-                    last_err = injected
-                else:
-                    session = await self._get_session()
-                    kw = {}
-                    t = self._attempt_timeout(deadline)
-                    if t is not None:
-                        kw["timeout"] = t
-                    async with session.get(url, headers=headers, **kw) as resp:
-                        if resp.status != 200:
-                            body = await resp.read()
-                            err = HTTPError("GET", url, resp.status, body)
-                            if resp.status < 500 or not retry_5xx:
-                                raise err
-                            last_err = err
-                        else:
-                            size = 0
-                            with open(tmp, "wb") as f:
-                                async for chunk in resp.content.iter_chunked(
-                                    chunk_size
-                                ):
-                                    if failpoints.fire(
-                                        "httputil.request.truncate_body"
+        with trace.span("http.client GET(file)", url=url):
+            headers = _inject_traceparent(headers)
+            last_err: Exception | None = None
+            # Unique per call, not just per process: hedged reads run two
+            # transfers of the SAME dest concurrently in one process, and
+            # a shared tmp name would let the loser tear the winner's
+            # bytes.
+            tmp = f"{dest_path}.http{os.getpid()}.{next(_tmp_seq)}.tmp"
+            for attempt in range(self._retries + 1):
+                if deadline is not None and deadline.expired:
+                    _give_up("GET", url, attempt, last_err)
+                    raise deadline.exceeded(f"GET {url}") from last_err
+                try:
+                    injected = await _failpoint_gate("GET", url)
+                    if injected is not None:
+                        if not retry_5xx:
+                            raise injected
+                        last_err = injected
+                    else:
+                        session = await self._get_session()
+                        kw = {}
+                        t = self._attempt_timeout(deadline)
+                        if t is not None:
+                            kw["timeout"] = t
+                        async with session.get(
+                            url, headers=headers, **kw
+                        ) as resp:
+                            if resp.status != 200:
+                                body = await resp.read()
+                                err = HTTPError("GET", url, resp.status, body)
+                                if resp.status < 500 or not retry_5xx:
+                                    raise err
+                                last_err = err
+                            else:
+                                size = 0
+                                with open(tmp, "wb") as f:
+                                    async for chunk in (
+                                        resp.content.iter_chunked(chunk_size)
                                     ):
-                                        # Torn streaming body: surface as
-                                        # the payload error a dropped LB
-                                        # produces (whole-transfer retry).
-                                        raise aiohttp.ClientPayloadError(
-                                            "failpoint truncate_body"
-                                        )
-                                    await asyncio.to_thread(f.write, chunk)
-                                    size += len(chunk)
-                            os.replace(tmp, dest_path)
-                            return size
-            except (aiohttp.ClientConnectionError, asyncio.TimeoutError,
-                    aiohttp.ClientPayloadError) as e:
-                last_err = e
-            finally:
-                with contextlib.suppress(OSError):
-                    os.unlink(tmp)
-            if attempt < self._retries:
-                await self._retry_pause("GET", url, attempt, deadline, last_err)
-        assert last_err is not None
-        _give_up("GET", url, self._retries + 1, last_err)
-        raise last_err
+                                        if failpoints.fire(
+                                            "httputil.request.truncate_body"
+                                        ):
+                                            # Torn streaming body: surface
+                                            # as the payload error a
+                                            # dropped LB produces (whole-
+                                            # transfer retry).
+                                            raise aiohttp.ClientPayloadError(
+                                                "failpoint truncate_body"
+                                            )
+                                        await asyncio.to_thread(f.write, chunk)
+                                        size += len(chunk)
+                                os.replace(tmp, dest_path)
+                                return size
+                except (aiohttp.ClientConnectionError, asyncio.TimeoutError,
+                        aiohttp.ClientPayloadError) as e:
+                    last_err = e
+                finally:
+                    with contextlib.suppress(OSError):
+                        os.unlink(tmp)
+                if attempt < self._retries:
+                    await self._retry_pause(
+                        "GET", url, attempt, deadline, last_err
+                    )
+            assert last_err is not None
+            _give_up("GET", url, self._retries + 1, last_err)
+            raise last_err
 
     async def get(self, url: str, **kw) -> bytes:
         return await self.request("GET", url, **kw)

@@ -66,6 +66,17 @@ class LameduckMixin:
         """Operator drain entry (runbook: docs/OPERATIONS.md). The node
         keeps running -- the deploy system observes /health flip to 503,
         waits its grace period, then SIGTERMs for the full drain+stop."""
+        if not self.lameduck:
+            # A drain entry is a degradation event: persist the flight
+            # recorder as a postmortem (docs/OPERATIONS.md "Tracing").
+            # The clean stop() path also enters lameduck (refusal-
+            # before-teardown) but that is a shutdown, not a
+            # degradation -- only the operator/SIGTERM entries dump.
+            from kraken_tpu.utils.trace import TRACER
+
+            TRACER.trigger_dump(
+                "lameduck", f"{self.lameduck_component}: operator entry"
+            )
         self.enter_lameduck()
         return web.json_response(self._lameduck_doc())
 

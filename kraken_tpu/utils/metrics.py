@@ -25,6 +25,17 @@ _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Exemplar provider (utils/trace.py registers its own at import): called
+# on every histogram observation, returns the active SAMPLED trace id or
+# None. Kept as a module hook so metrics never imports trace (trace
+# imports metrics for its counters).
+_exemplar_provider = None
+
+
+def set_exemplar_provider(fn) -> None:
+    global _exemplar_provider
+    _exemplar_provider = fn
+
 
 def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
     if not labels:
@@ -58,7 +69,7 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
-    def render(self) -> Iterable[str]:
+    def render(self, exemplars: bool = False) -> Iterable[str]:
         with self._lock:  # snapshot: writers mutate from worker threads
             items = sorted(self._values.items())
         for key, v in items:
@@ -78,7 +89,7 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
-    def render(self) -> Iterable[str]:
+    def render(self, exemplars: bool = False) -> Iterable[str]:
         with self._lock:
             items = sorted(self._values.items())
         for key, v in items:
@@ -86,7 +97,11 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics) with
+    OpenMetrics exemplars: when an observation happens under a SAMPLED
+    trace span (utils/trace.py), the trace id is attached to the
+    observation's bucket, so the p99 on a dashboard links to the one
+    concrete trace in /debug/trace that produced it."""
 
     def __init__(self, name: str, help_: str,
                  buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
@@ -94,34 +109,65 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
         # key -> [bucket counts..., +Inf count, sum]
         self._values: dict[tuple, list[float]] = {}
+        # key -> {bucket index (len(buckets) = +Inf): (value, trace_id, ts)}
+        self._exemplars: dict[tuple, dict[int, tuple]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
         key = self._key(labels)
+        provider = _exemplar_provider
+        trace_id = provider() if provider is not None else None
         with self._lock:
             row = self._values.get(key)
             if row is None:
                 row = [0.0] * (len(self.buckets) + 2)
                 self._values[key] = row
+            bucket = len(self.buckets)  # +Inf
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     row[i] += 1
+                    bucket = min(bucket, i)
             row[-2] += 1  # +Inf
             row[-1] += value  # sum
+            if trace_id is not None:
+                # Last exemplar per bucket: the freshest concrete trace
+                # for each latency regime (O(buckets) memory, no ring).
+                self._exemplars.setdefault(key, {})[bucket] = (
+                    value, trace_id, time.time()
+                )
 
     def count(self, **labels: str) -> float:
         with self._lock:
             row = self._values.get(self._key(labels))
             return row[-2] if row else 0.0
 
-    def render(self) -> Iterable[str]:
+    def exemplar(self, **labels: str) -> dict[int, tuple]:
+        with self._lock:
+            return dict(self._exemplars.get(self._key(labels), {}))
+
+    @staticmethod
+    def _fmt_exemplar(ex: tuple | None) -> str:
+        if ex is None:
+            return ""
+        value, trace_id, ts = ex
+        return f' # {{trace_id="{trace_id}"}} {value} {round(ts, 3)}'
+
+    def render(self, exemplars: bool = False) -> Iterable[str]:
         with self._lock:
             items = [(k, list(row)) for k, row in sorted(self._values.items())]
+            exs = {k: dict(v) for k, v in self._exemplars.items()}
         for key, row in items:
+            ex = exs.get(key, {}) if exemplars else {}
             for i, b in enumerate(self.buckets):
                 lab = key + (("le", repr(b)),)
-                yield f"{self.name}_bucket{_fmt_labels(lab)} {row[i]}"
+                yield (
+                    f"{self.name}_bucket{_fmt_labels(lab)} {row[i]}"
+                    f"{self._fmt_exemplar(ex.get(i))}"
+                )
             lab = key + (("le", "+Inf"),)
-            yield f"{self.name}_bucket{_fmt_labels(lab)} {row[-2]}"
+            yield (
+                f"{self.name}_bucket{_fmt_labels(lab)} {row[-2]}"
+                f"{self._fmt_exemplar(ex.get(len(self.buckets)))}"
+            )
             yield f"{self.name}_count{_fmt_labels(key)} {row[-2]}"
             yield f"{self.name}_sum{_fmt_labels(key)} {row[-1]}"
 
@@ -153,16 +199,34 @@ class Registry:
                   buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
         return self._get(Histogram, name, help_, buckets=buckets)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus exposition text. ``exemplars=True`` renders the
+        OpenMetrics dialect: the exemplar suffix (`# {trace_id="..."}
+        value ts`) on histogram buckets that have one, and counter
+        FAMILY names without the ``_total`` suffix (OpenMetrics declares
+        `# TYPE foo counter` with samples `foo_total`; repeating the
+        suffix in the metadata is a parse error that fails the whole
+        scrape). Only emitted when the scraper negotiated OpenMetrics
+        (classic text parsers reject in-line exemplars; see the Accept
+        handling in instrument_app)."""
         with self._lock:  # registration happens from worker threads too
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: list[str] = []
         for m in metrics:
+            family = m.name
+            if exemplars and m.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.render())
+                lines.append(f"# HELP {family} {m.help}")
+            lines.append(f"# TYPE {family} {m.kind}")
+            lines.extend(m.render(exemplars=exemplars))
         return "\n".join(lines) + "\n"
+
+    def names(self) -> list[str]:
+        """Every registered metric name -- the catalog lint test walks
+        this against docs/OPERATIONS.md so the catalog cannot drift."""
+        with self._lock:
+            return sorted(self._metrics)
 
 
 REGISTRY = Registry()
@@ -285,37 +349,100 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
 
     @web.middleware
     async def middleware(request, handler):
+        from kraken_tpu.utils import trace
+
         resource = request.match_info.route.resource
         endpoint = resource.canonical if resource is not None else "unmatched"
         start = time.perf_counter()
         inflight.set(inflight.value(component=component) + 1,
                      component=component)
         status = 499  # client closed request: CancelledError skips all excepts
-        try:
-            resp = await handler(request)
-            status = resp.status
-            return resp
-        except web.HTTPException as e:
-            status = e.status
-            raise
-        except Exception:
-            status = 500
-            raise
-        finally:
-            inflight.set(inflight.value(component=component) - 1,
-                         component=component)
-            requests.inc(component=component, method=request.method,
-                         endpoint=endpoint, status=str(status))
-            latency.observe(time.perf_counter() - start,
-                            component=component, method=request.method,
-                            endpoint=endpoint)
+        # Server span: adopt the caller's traceparent (one trace across
+        # agent -> tracker -> origin) or start a fresh sampled-or-not
+        # root. The latency histogram below observes INSIDE the span, so
+        # its exemplar carries this request's trace id.
+        parent = trace.parse_traceparent(request.headers.get("traceparent"))
+        with trace.span(
+            f"http.server {request.method} {endpoint}",
+            parent, component=component,
+        ) as sp:
+            try:
+                resp = await handler(request)
+                status = resp.status
+                return resp
+            except web.HTTPException as e:
+                status = e.status
+                if e.status >= 500 and sp is not None:
+                    sp.mark_error(e)
+                raise
+            except Exception as e:
+                status = 500
+                if sp is not None:
+                    sp.mark_error(e)
+                raise
+            finally:
+                if sp is not None:
+                    sp.set(status=status)
+                inflight.set(inflight.value(component=component) - 1,
+                             component=component)
+                requests.inc(component=component, method=request.method,
+                             endpoint=endpoint, status=str(status))
+                latency.observe(time.perf_counter() - start,
+                                component=component, method=request.method,
+                                endpoint=endpoint)
 
     async def metrics_endpoint(request):
+        # Exemplars ride only the OpenMetrics negotiation: classic
+        # Prometheus text parsers reject the in-line `# {...}` suffix,
+        # so a plain scrape gets the classic format unchanged.
+        accept = request.headers.get("Accept", "")
+        if "application/openmetrics-text" in accept:
+            return web.Response(
+                body=(registry.render(exemplars=True) + "# EOF\n").encode(),
+                content_type="application/openmetrics-text",
+            )
         return web.Response(
             text=registry.render(),
             content_type="text/plain",
             charset="utf-8",
         )
+
+    async def trace_endpoint(request):
+        # The flight recorder (utils/trace.py): recent / slowest /
+        # errored finished spans, or one trace whole. The postmortem
+        # counterpart is the dump-to-JSONL trigger plane; this surface
+        # answers "what just happened on THIS node" live.
+        from kraken_tpu.utils.trace import TRACER
+
+        view = request.query.get("view", "recent")
+        try:
+            limit = max(1, min(1000, int(request.query.get("limit", 100))))
+        except ValueError:
+            return web.Response(status=400, text="malformed limit")
+        rec = TRACER.recorder
+        if view == "recent":
+            spans = rec.recent(limit)
+        elif view in ("errors", "errored"):
+            spans = rec.errored(limit)
+        elif view == "slowest":
+            spans = rec.slowest(min(limit, 50))
+        elif view == "trace":
+            tid = request.query.get("trace_id", "")
+            if not tid:
+                return web.Response(
+                    status=400, text="view=trace requires trace_id"
+                )
+            spans = rec.trace(tid)
+        else:
+            return web.Response(
+                status=400,
+                text="view must be recent|slowest|errors|trace",
+            )
+        return web.json_response({
+            "view": view,
+            "sample_rate": TRACER.config.sample_rate,
+            "spans": spans,
+        })
 
     async def stacks_endpoint(request):
         # The pprof-goroutine-dump equivalent (the reference exposes Go
@@ -485,6 +612,7 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
 
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug/trace", trace_endpoint)
     app.router.add_get("/debug/healthcheck", healthcheck_endpoint)
     app.router.add_get("/debug/resources", resources_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)

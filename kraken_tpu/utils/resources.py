@@ -488,6 +488,12 @@ class ResourceSentinel:
                 )
             else:
                 self._streaks.pop(kind, None)
+        if breached:
+            # Budget breach = degradation event: leave a flight-recorder
+            # postmortem (throttled per trigger kind, never raises).
+            from kraken_tpu.utils.trace import TRACER
+
+            TRACER.trigger_dump("resource_breach", ",".join(breached))
         sustained = [
             k for k in breached
             if self._streaks.get(k, 0) >= cfg.breach_streak

@@ -16,6 +16,16 @@ _RESERVED = frozenset(logging.LogRecord(
     "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
 
 
+def _trace_ids():
+    """Lazy bridge to utils.trace (imported on first log line, not at
+    module import -- structlog must stay importable before the tracer)."""
+    try:
+        from kraken_tpu.utils.trace import current_ids
+    except Exception:  # pragma: no cover - partial interpreter teardown
+        return None
+    return current_ids()
+
+
 class JSONFormatter(logging.Formatter):
     def __init__(self, component: str = ""):
         super().__init__()
@@ -32,6 +42,13 @@ class JSONFormatter(logging.Formatter):
         }
         if self.component:
             doc["component"] = self.component
+        # Lines logged under an active span carry its ids, so `grep
+        # trace_id` joins logs to /debug/trace and flight-recorder
+        # dumps. Formatting happens on the emitting context (stdlib
+        # handlers format synchronously), so the contextvar is right.
+        ids = _trace_ids()
+        if ids is not None:
+            doc["trace_id"], doc["span_id"] = ids
         for k, v in record.__dict__.items():
             if k not in _RESERVED and not k.startswith("_"):
                 doc[k] = v

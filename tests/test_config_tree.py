@@ -26,7 +26,7 @@ CLI_KEYS = {
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
-    "task_timeout_seconds", "rpc", "resources",
+    "task_timeout_seconds", "rpc", "resources", "trace",
 }
 
 
@@ -160,6 +160,35 @@ def test_resources_sections_construct_resources_config():
         assert cfg.drain_on_breach is False, path
         seen += 1
     assert seen >= 2  # agent + origin ship the sentinel knobs
+
+
+def test_trace_sections_construct_trace_config():
+    """Every shipped `trace:` section must map onto TraceConfig through
+    the same from_dict the CLI/assembly use -- a typo'd tracing knob
+    must fail here, not at production boot. The shipped defaults must
+    stay SAMPLED-DOWN: a config refresh that ships sample_rate 1.0
+    would tax every pull's data plane fleet-wide (the overhead band in
+    test_data_plane_band.py is measured at the shipped rate)."""
+    from kraken_tpu.utils.trace import TraceConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        tc = load_config(path).get("trace")
+        if not tc:
+            continue
+        cfg = TraceConfig.from_dict(tc)  # raises on unknown keys
+        assert cfg.enabled is True, path
+        assert 0.0 < cfg.sample_rate <= 0.05, (
+            f"{path}: shipped sample_rate must stay sampled-down"
+        )
+        assert cfg.slow_threshold_seconds > 0, path
+        assert cfg.keep_spans >= 256, path
+        assert cfg.dump_min_interval_seconds > 0, path
+        # dump_dir ships unset: assembly defaults it under the node's
+        # store root, and store-less trackers stay file-dump-free.
+        assert cfg.dump_dir == "", path
+        seen += 1
+    assert seen >= 3  # agent + origin + tracker ship the trace knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -1,0 +1,98 @@
+"""Metric-catalog lint: the docs/OPERATIONS.md catalog cannot drift.
+
+Every metric a live agent+origin pair actually registers must appear
+(backtick-quoted) in docs/OPERATIONS.md -- the catalog is the operator's
+only index into the registry, and until now it was maintained by hand.
+
+Runs the pair in a SUBPROCESS: the test session's process-global
+REGISTRY accumulates names from every suite that ran before this one,
+so an in-process walk would lint whatever the test ordering happened to
+register. A fresh interpreter registers exactly what a production boot
++ one upload + one pull register.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PAIR_SCRIPT = r"""
+import asyncio, json, os, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.metrics import REGISTRY
+
+async def main():
+    tmp = tempfile.mkdtemp()
+    tracker = TrackerNode(announce_interval_seconds=0.1)
+    await tracker.start()
+    origin = OriginNode(
+        store_root=os.path.join(tmp, "o"), tracker_addr=tracker.addr
+    )
+    await origin.start()
+    ring = Ring(HostList(static=[origin.addr]), max_replica=2)
+    cluster = ClusterClient(ring)
+    tracker.server.origin_cluster = cluster
+    origin.ring = ring
+    if origin.server:
+        origin.server.ring = ring
+    agent = AgentNode(
+        store_root=os.path.join(tmp, "a"), tracker_addr=tracker.addr
+    )
+    await agent.start()
+    http = HTTPClient()
+    blob = os.urandom(500_000)
+    d = Digest.from_bytes(blob)
+    oc = BlobClient(origin.addr)
+    await oc.upload("library/lint", d, blob, chunk_size=100_000)
+    await oc.close()
+    got = await http.get(
+        f"http://{agent.addr}/namespace/library%2Flint/blobs/{d.hex}"
+    )
+    assert got == blob
+    await http.close()
+    await agent.stop()
+    await origin.stop()
+    await cluster.close()
+    await tracker.stop()
+    print("NAMES=" + json.dumps(REGISTRY.names()))
+
+asyncio.run(main())
+"""
+
+
+def test_every_live_metric_is_in_the_operations_catalog():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PAIR_SCRIPT],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"pair boot failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    names_line = [
+        line for line in proc.stdout.splitlines() if line.startswith("NAMES=")
+    ]
+    assert names_line, f"no NAMES line in output:\n{proc.stdout}"
+    names = json.loads(names_line[-1][len("NAMES="):])
+    assert len(names) >= 20, f"suspiciously few live metrics: {names}"
+
+    with open(os.path.join(REPO, "docs", "OPERATIONS.md")) as f:
+        docs = f.read()
+    # A metric is "cataloged" when its exact name appears backtick-quoted
+    # anywhere in OPERATIONS.md (the catalog tables quote every name;
+    # prose mentions count too -- the operator can grep either way).
+    missing = [n for n in names if f"`{n}" not in docs]
+    assert not missing, (
+        "live metrics missing from the docs/OPERATIONS.md catalog "
+        f"(add a row per name): {missing}"
+    )

@@ -29,12 +29,17 @@ from kraken_tpu.store import CAStore
 BLOB_MB = int(os.environ.get("KT_STREAM_TEST_MB", "96"))
 PIECE = 1 << 20  # 1 MiB pieces keep the in-flight bound tight
 # The LEGITIMATE in-flight working set is pipeline depth (16) x piece
-# (1 MiB) x live conns (up to 2 here) = 32 MiB, so a bound of exactly
-# 32 MiB sat ON the working set and flapped with allocator noise
-# (measured 33.5-33.7 MB peaks on healthy runs, both at the round-8
-# seed and after). 40 MiB keeps 2.4x margin against the whole-blob
-# buffering failure this test exists to catch (96 MiB would blow it).
-PEAK_BOUND = 40 << 20
+# (1 MiB) x live conns (up to 2 here) = 32 MiB -- and because this herd
+# is single-process, the SEED side's concurrent pread serves and asyncio
+# send buffers land in the same tracemalloc peak. Healthy runs measure
+# ~28-42 MB depending on how deep the serve/recv pipelines stack under
+# CPU contention (a 40 MiB bound flapped under full-suite load; 32 MiB
+# flapped even solo). The round-8 tracing plane is NOT a contributor:
+# measured 3x each on 2026-08-03, trace-off 30.2-32.1 MB vs shipped
+# sampling 27.6-32.1 MB vs sample_rate=1.0 27.6-32.5 MB. 48 MiB keeps
+# 2x margin against the whole-blob buffering failure this test exists
+# to catch (96 MiB would blow it).
+PEAK_BOUND = 48 << 20
 
 
 def _write_blob(path: str, mb: int) -> Digest:

@@ -1,0 +1,642 @@
+"""End-to-end distributed tracing + flight recorder (utils/trace.py).
+
+What must hold, per docs/OPERATIONS.md "Tracing":
+
+- a W3C-traceparent-style context propagates across await boundaries,
+  asyncio tasks, HTTP hops (header), the P2P wire (handshake +
+  PIECE_REQUEST frames), and the shardpool fork (handoff descriptor +
+  span shipping) -- ONE trace_id per pull, joinable offline;
+- head sampling at the root is inherited by children, and the
+  error/slow tails are kept even when the head sampler said no;
+- every degradation plane (breaker trip, DeadlineExceeded, resource
+  breach, lameduck) leaves a flight-recorder JSONL postmortem, throttled
+  per trigger kind;
+- histograms attach the active SAMPLED trace id as an OpenMetrics
+  exemplar, emitted only on OpenMetrics-negotiated scrapes;
+- `kraken-tpu trace` reassembles multi-node dumps into span trees with
+  the critical path marked, and exits non-zero on orphan spans.
+
+NOTE: the herd tests run in ONE process, so every in-process component
+shares the process-global TRACER ring (each /debug/trace returns the
+union) -- but the shardpool workers are REAL forked processes, so the
+worker-serve half of the propagation test crosses a genuine process
+boundary (descriptor in, span shipping out).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import logging
+import os
+import time
+
+import pytest
+
+from kraken_tpu.utils import trace
+from kraken_tpu.utils.metrics import REGISTRY, Registry
+from kraken_tpu.utils.trace import (
+    TRACER,
+    TraceConfig,
+    assemble_tree,
+    critical_path,
+    parse_traceparent,
+)
+
+NS = "library/trace-test"
+
+
+@pytest.fixture(autouse=True)
+def _tracer_isolation():
+    """The TRACER is process-global (like the metric REGISTRY): snapshot
+    its config/hooks and clear the ring around every test so sampling
+    choices here never leak into other suites."""
+    cfg0, node0, hook0 = TRACER.config, TRACER.node, TRACER.on_record
+    TRACER.recorder.clear()
+    TRACER._last_dump.clear()
+    yield
+    TRACER.config, TRACER.node, TRACER.on_record = cfg0, node0, hook0
+    TRACER.recorder.clear()
+    TRACER._last_dump.clear()
+
+
+def _apply(**kw):
+    TRACER.apply(TraceConfig(**kw))
+
+
+# -- context + sampling unit tests ------------------------------------------
+
+
+def test_traceparent_parse_and_roundtrip():
+    with trace.span("root") as sp:
+        assert sp is not None
+        parsed = parse_traceparent(sp.traceparent)
+        assert parsed is not None
+        assert parsed.trace_id == sp.trace_id
+        assert parsed.span_id == sp.span_id
+        assert parsed.sampled == sp.sampled
+    # Malformed values never raise -- a skewed peer's header must not
+    # fail the request it rides on.
+    for bad in (None, "", "garbage", "00-short-span-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace
+                "00-" + "z" * 32 + "-" + "1" * 16 + "-01"):
+        assert parse_traceparent(bad) is None
+    ok = parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert ok is not None and ok.sampled
+    assert not parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-00").sampled
+
+
+def test_contextvar_propagation_and_inheritance():
+    """Children join the contextvar's current span -- including across
+    asyncio.create_task, which is the mechanism every pump loop and io
+    task relies on."""
+    _apply(sample_rate=1.0)
+
+    async def main():
+        async def child_task():
+            with trace.span("child") as c:
+                return c.trace_id, c.parent_id
+
+        with trace.span("root") as root:
+            tid, pid = await asyncio.create_task(child_task())
+            assert tid == root.trace_id
+            assert pid == root.span_id
+        # Outside the with, the context is restored.
+        assert trace.current() is None
+
+    asyncio.run(main())
+
+
+def test_head_sampling_inherited_and_tails_always_kept():
+    # rate=0: fast-ok spans vanish; error and slow spans are KEPT.
+    _apply(sample_rate=0.0, slow_threshold_seconds=0.05)
+    with trace.span("fast-ok"):
+        pass
+    assert TRACER.recorder.snapshot() == []
+
+    with pytest.raises(RuntimeError):
+        with trace.span("errored"):
+            raise RuntimeError("boom")
+    snap = TRACER.recorder.snapshot()
+    assert [s["name"] for s in snap] == ["errored"]
+    assert snap[0]["status"] == "error" and "boom" in snap[0]["error"]
+
+    with trace.span("slow"):
+        time.sleep(0.06)
+    assert "slow" in [s["name"] for s in TRACER.recorder.snapshot()]
+
+    # rate=1: everything lands, children inherit the root's verdict.
+    _apply(sample_rate=1.0)
+    with trace.span("r") as r:
+        with trace.span("c") as c:
+            assert c.sampled and c.trace_id == r.trace_id
+    names = [s["name"] for s in TRACER.recorder.snapshot()]
+    assert "r" in names and "c" in names
+
+    # An unsampled parent's children stay unsampled (no partial traces).
+    _apply(sample_rate=0.0, slow_threshold_seconds=0.0)
+    with trace.span("r2"):
+        with trace.span("c2") as c2:
+            assert not c2.sampled
+
+
+def test_disabled_creates_no_spans():
+    _apply(enabled=False)
+    with trace.span("x") as sp:
+        assert sp is None
+        assert trace.current() is None
+        assert trace.current_traceparent() is None
+    assert TRACER.recorder.snapshot() == []
+
+
+def test_flight_recorder_views_and_live_reload():
+    _apply(sample_rate=1.0, keep_spans=512)
+    with trace.span("a"):
+        pass
+    with pytest.raises(ValueError):
+        with trace.span("b"):
+            raise ValueError("x")
+    with trace.span("slowest-root"):
+        time.sleep(0.03)
+    rec = TRACER.recorder
+    assert [s["name"] for s in rec.recent(2)] == ["slowest-root", "b"]
+    assert [s["name"] for s in rec.errored()] == ["b"]
+    slow = rec.slowest(1)
+    assert slow[0]["spans"][0]["name"] == "slowest-root"
+    tid = rec.recent(1)[0]["trace_id"]
+    assert [s["trace_id"] for s in rec.trace(tid)] == [tid]
+
+    # SIGHUP live reload: ring resizes IN PLACE (spans survive a grow),
+    # sampling applies to the next root.
+    TRACER.apply({"sample_rate": 0.0, "keep_spans": 1024,
+                  "slow_threshold_seconds": 0.0})
+    assert len(rec.snapshot()) == 3  # survived the resize
+    with trace.span("after-reload"):
+        pass
+    assert "after-reload" not in [s["name"] for s in rec.snapshot()]
+    with pytest.raises(ValueError):
+        TRACER.apply({"sample_rate": 2.0})
+    with pytest.raises(ValueError):
+        TRACER.apply({"not_a_knob": 1})
+
+
+# -- dump triggers (the postmortem plane) -----------------------------------
+
+
+def _dumps(dump_dir: str, trigger: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(dump_dir, f"trace-{trigger}-*.jsonl")))
+
+
+def test_trigger_dump_writes_throttled_jsonl(tmp_path):
+    dump_dir = str(tmp_path / "traces")
+    _apply(sample_rate=1.0, dump_dir=dump_dir,
+           dump_min_interval_seconds=3600.0)
+    with trace.span("the-evidence", digest="abc123"):
+        pass
+    path = TRACER.trigger_dump("breaker_trip", "origin1:7610")
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert lines[0]["dump"] == "breaker_trip"
+    assert lines[0]["detail"] == "origin1:7610"
+    assert any(d.get("name") == "the-evidence" for d in lines[1:])
+    # Same trigger kind inside the floor: throttled (no second file)...
+    assert TRACER.trigger_dump("breaker_trip", "again") is None
+    assert len(_dumps(dump_dir, "breaker_trip")) == 1
+    # ...but a DIFFERENT trigger kind still dumps.
+    assert TRACER.trigger_dump("lameduck", "x") is not None
+    # Every ask counts, throttled or not.
+    c = REGISTRY.counter("trace_dump_triggers_total")
+    assert c.value(trigger="breaker_trip") >= 2
+    assert REGISTRY.counter("trace_dumps_total").value(
+        trigger="breaker_trip") >= 1
+
+
+def test_trigger_dump_never_raises_and_skips_empty(tmp_path):
+    # Empty ring: nothing to postmortem, no file.
+    _apply(sample_rate=1.0, dump_dir=str(tmp_path / "t"))
+    assert TRACER.trigger_dump("lameduck") is None
+    # No dump dir configured (tracker shape): counted, no file, no error.
+    _apply(sample_rate=1.0)
+    with trace.span("s"):
+        pass
+    assert TRACER.trigger_dump("lameduck") is None
+    # An unwritable dir must not raise into the degradation plane that
+    # is already firing.
+    _apply(sample_rate=1.0, dump_dir="/proc/nonexistent/nope")
+    assert TRACER.trigger_dump("resource_breach") is None
+
+
+def test_breaker_trip_leaves_flight_recorder_dump(tmp_path):
+    """The PR-5 circuit breaker is a dump trigger: the spans that led to
+    the trip are the postmortem, persisted the moment the host opens."""
+    from kraken_tpu.placement.healthcheck import PassiveFilter
+
+    dump_dir = str(tmp_path / "traces")
+    _apply(sample_rate=1.0, dump_dir=dump_dir)
+    with trace.span("rpc.download", addr="origin1:7610"):
+        pass
+    pf = PassiveFilter(fail_threshold=1, name="trace-test")
+    pf.failed("origin1:7610")
+    files = _dumps(dump_dir, "breaker_trip")
+    assert len(files) == 1, "breaker trip left no flight-recorder dump"
+    with open(files[0]) as f:
+        header = json.loads(f.readline())
+    assert header["dump"] == "breaker_trip"
+    assert "origin1:7610" in header["detail"]
+
+
+def test_deadline_exceeded_leaves_flight_recorder_dump(tmp_path):
+    from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
+
+    dump_dir = str(tmp_path / "traces")
+    _apply(sample_rate=1.0, dump_dir=dump_dir)
+    with trace.span("http.client GET", url="http://x/slow"):
+        pass
+    err = Deadline(0.0, component="cluster").exceeded("GET http://x/slow")
+    assert isinstance(err, DeadlineExceeded)
+    files = _dumps(dump_dir, "deadline_exceeded")
+    assert len(files) == 1, "DeadlineExceeded left no flight-recorder dump"
+    with open(files[0]) as f:
+        header = json.loads(f.readline())
+    assert header["dump"] == "deadline_exceeded"
+    assert "cluster" in header["detail"]
+
+
+# -- exemplars ---------------------------------------------------------------
+
+
+def test_histogram_exemplars_attach_sampled_trace_id():
+    _apply(sample_rate=1.0)
+    reg = Registry()
+    h = reg.histogram("req_seconds", "latency", buckets=(0.1, 1.0))
+    with trace.span("the-request") as sp:
+        h.observe(0.05, endpoint="/blobs")
+        tid = sp.trace_id
+    # Un-traced and UNSAMPLED observations leave no exemplar.
+    h.observe(0.5, endpoint="/blobs")
+    _apply(sample_rate=0.0, slow_threshold_seconds=0.0)
+    with trace.span("unsampled"):
+        h.observe(0.7, endpoint="/blobs")
+
+    text = reg.render(exemplars=True)
+    assert f'# {{trace_id="{tid}"}} 0.05' in text
+    assert text.count("# {trace_id=") == 1  # only the sampled bucket
+    # The classic exposition stays exemplar-free (classic parsers
+    # reject the in-line suffix).
+    assert "# {trace_id=" not in reg.render()
+    # The exemplar rides the FIRST bucket the value fits (0.1 here).
+    ex = h.exemplar(endpoint="/blobs")
+    assert list(ex) == [0]
+    assert ex[0][1] == tid
+
+
+def test_metrics_endpoint_negotiates_openmetrics_exemplars(tmp_path):
+    """The scrape surface: a plain GET /metrics is classic text (no
+    exemplars); an OpenMetrics Accept gets them + the # EOF trailer."""
+    from kraken_tpu.assembly import TrackerNode
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    async def main():
+        node = TrackerNode(trace={"sample_rate": 1.0})
+        await node.start()
+        http = HTTPClient(retries=0)
+        try:
+            base = f"http://{node.addr}"
+            await http.get(f"{base}/health")  # an observation under a span
+            classic = (await http.get(f"{base}/metrics")).decode()
+            assert "# {trace_id=" not in classic
+            om = (await http.get(
+                f"{base}/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )).decode()
+            assert "# {trace_id=" in om
+            assert om.endswith("# EOF\n")
+            # The negotiated body must be VALID OpenMetrics end to end:
+            # a counter family declared `# TYPE foo_total counter` (the
+            # suffix repeated in the metadata) is a parse error that
+            # fails the whole scrape for exactly the exemplar-scraping
+            # Prometheus this negotiation targets. Validated against
+            # the reference parser when available.
+            try:
+                from prometheus_client.openmetrics import parser
+            except ImportError:
+                parser = None
+            if parser is not None:
+                families = {
+                    f.name
+                    for f in parser.text_string_to_metric_families(om)
+                }
+                assert "http_requests" in families  # suffix stripped
+                assert "http_request_duration_seconds" in families
+            # /debug/trace serves the same spans live.
+            doc = json.loads(await http.get(f"{base}/debug/trace"))
+            assert doc["sample_rate"] == 1.0
+            assert any(
+                s["name"].startswith("http.server") for s in doc["spans"]
+            )
+            assert json.loads(await http.get(
+                f"{base}/debug/trace?view=errors"))["spans"] == []
+            status, _, _ = await http.request_full(
+                "GET", f"{base}/debug/trace?view=bogus",
+                ok_statuses=(400,), retry_5xx=False,
+            )
+            assert status == 400
+        finally:
+            await http.close()
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_hedge_attempt_spans_carry_op_and_hedge_flag():
+    """Each cluster-read attempt is its own child span with the hedge
+    attribute, so a hedged read shows up in /debug/trace as primary and
+    hedge side by side -- which one won is readable off the tree."""
+    from kraken_tpu.origin.client import ClusterClient
+    from kraken_tpu.placement import HostList, Ring
+
+    _apply(sample_rate=1.0)
+
+    async def main():
+        cluster = ClusterClient(
+            Ring(HostList(static=["h1:1", "h2:2"]), max_replica=2)
+        )
+
+        class _C:
+            addr = "h1:1"
+
+        async def op(c, deadline):
+            return b"ok"
+
+        with trace.span("caller") as root:
+            out = await cluster._attempt(
+                _C(), op, None, as_hedge=True, op_name="download"
+            )
+        assert out == b"ok"
+        await cluster.close()
+        spans = {s["name"]: s for s in TRACER.recorder.snapshot()}
+        sp = spans["rpc.download"]
+        assert sp["attrs"]["hedge"] is True
+        assert sp["attrs"]["addr"] == "h1:1"
+        assert sp["parent_id"] == root.span_id
+
+    asyncio.run(main())
+
+
+# -- satellite stamps --------------------------------------------------------
+
+
+def test_networkevent_and_structlog_stamp_trace_ids():
+    from kraken_tpu.p2p.networkevent import Producer
+    from kraken_tpu.utils.structlog import JSONFormatter
+
+    _apply(sample_rate=1.0)
+    producer = Producer("peer-1")
+    fmt = JSONFormatter(component="agent")
+    rec = logging.LogRecord(
+        "kraken.p2p", logging.INFO, __file__, 1, "piece done", (), None
+    )
+    with trace.span("p2p.download") as sp:
+        producer.emit("receive_piece", "ih", piece=3)
+        line = json.loads(fmt.format(rec))
+    assert producer.events[-1]["trace_id"] == sp.trace_id
+    assert line["trace_id"] == sp.trace_id
+    assert line["span_id"] == sp.span_id
+    # Outside a span: no stamp (absent key, not null noise).
+    producer.emit("announce", "ih")
+    assert "trace_id" not in producer.events[-1]
+    assert "trace_id" not in json.loads(fmt.format(rec))
+
+
+# -- offline reassembly (`kraken-tpu trace`) --------------------------------
+
+
+def _span(name, tid, sid, parent="", start=0.0, dur=1.0, node="", **extra):
+    d = {"trace_id": tid, "span_id": sid, "parent_id": parent, "name": name,
+         "start_ts": start, "duration_s": dur, "status": "ok", **extra}
+    if node:
+        d["node"] = node
+    return d
+
+
+def test_assemble_tree_and_critical_path():
+    tid = "t" * 32
+    root = _span("pull", tid, "a", start=0.0, dur=10.0)
+    fast = _span("dial1", tid, "b", parent="a", start=0.1, dur=1.0)
+    slow = _span("dial2", tid, "c", parent="a", start=0.2, dur=9.0)
+    leaf = _span("serve", tid, "d", parent="c", start=1.0, dur=8.0)
+    roots, orphans = assemble_tree([root, fast, slow, leaf])
+    assert [r["span_id"] for r in roots] == ["a"] and not orphans
+    # Critical path descends into the latest-ENDING child each level.
+    assert critical_path(roots[0]) == {"a", "c", "d"}
+
+    orphan = _span("lost", tid, "e", parent="zz")
+    _, orphans = assemble_tree([root, orphan])
+    assert [o["span_id"] for o in orphans] == ["e"]
+
+
+def test_assemble_tree_flags_parent_cycles_as_orphans():
+    """A corrupt/crafted dump line with a parent cycle (span_id ==
+    parent_id, or a -> b -> a) must surface as orphans and exit-1 the
+    CLI -- not vanish from the printed tree or hang critical_path."""
+    tid = "t" * 32
+    root = _span("pull", tid, "a", start=0.0, dur=1.0)
+    selfloop = _span("bad", tid, "x", parent="x")
+    roots, orphans = assemble_tree([root, selfloop])
+    assert [r["span_id"] for r in roots] == ["a"]
+    assert [o["span_id"] for o in orphans] == ["x"]
+    assert critical_path(roots[0]) == {"a"}  # terminates
+
+    cyc1 = _span("cyc1", tid, "p", parent="q")
+    cyc2 = _span("cyc2", tid, "q", parent="p")
+    hanger = _span("child-of-cycle", tid, "r", parent="p")
+    roots, orphans = assemble_tree([root, cyc1, cyc2, hanger])
+    assert [r["span_id"] for r in roots] == ["a"]
+    assert {o["span_id"] for o in orphans} == {"p", "q", "r"}
+
+
+def test_cancelled_spans_do_not_ride_the_error_tail():
+    """Losing hedge attempts and teardown cancel spans by design
+    (origin/client.py: cancellation is NOT host evidence); at shipped
+    sampling they must not be force-kept as errors and flood the ring /
+    ?view=errors. A real exception still is."""
+    _apply(sample_rate=0.0, slow_threshold_seconds=0.0)
+    with pytest.raises(asyncio.CancelledError):
+        with trace.span("rpc.download", addr="o1:7610"):
+            raise asyncio.CancelledError()
+    assert TRACER.recorder.snapshot() == []
+
+    with pytest.raises(ValueError):
+        with trace.span("rpc.download", addr="o1:7610"):
+            raise ValueError("boom")
+    kept = TRACER.recorder.snapshot()
+    assert [s["status"] for s in kept] == ["error"]
+
+    # On a SAMPLED trace the cancelled span is still recorded (the
+    # hedge-loser timing is real signal), just not as an error.
+    _apply(sample_rate=1.0)
+    with pytest.raises(asyncio.CancelledError):
+        with trace.span("rpc.download", hedge=True):
+            raise asyncio.CancelledError()
+    cancelled = [s for s in TRACER.recorder.snapshot()
+                 if s["status"] == "cancelled"]
+    assert len(cancelled) == 1 and "error" not in cancelled[0]
+
+
+def test_trace_cli_joins_multi_node_dumps_and_flags_orphans(tmp_path, capsys):
+    from kraken_tpu.cli import run_trace_tool
+
+    tid = "f" * 32
+    node1 = [
+        _span("http.server GET /blobs", tid, "a" * 16, dur=5.0, node="agent"),
+        _span("p2p.dial", tid, "b" * 16, parent="a" * 16, start=0.5,
+              dur=4.0, node="agent"),
+    ]
+    node2 = [
+        _span("p2p.shard.serve", tid, "c" * 16, parent="b" * 16, start=1.0,
+              dur=2.0, node="origin/shard0"),
+    ]
+    f1, f2 = str(tmp_path / "agent.jsonl"), str(tmp_path / "origin.jsonl")
+    for path, spans in ((f1, node1), (f2, node2)):
+        with open(path, "w") as f:
+            f.write(json.dumps({"dump": "test", "ts": 0}) + "\n")  # header
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+
+    # Both dumps together: one joined tree, exit 0, critical path marked.
+    assert run_trace_tool([f1, f2]) == 0
+    out = capsys.readouterr().out
+    assert f"trace {tid}" in out and "nodes=agent,origin/shard0" in out
+    assert "p2p.shard.serve" in out
+    assert "* " in out  # critical-path gutter
+    assert json.loads(out.strip().splitlines()[-1])["orphans"] == 0
+
+    # The origin dump ALONE: the serve span's parent lives in the agent
+    # dump -- an orphan, non-zero exit for CI.
+    assert run_trace_tool([f2]) == 1
+    out = capsys.readouterr().out
+    assert "ORPHAN" in out
+
+    # Unknown trace id / unreadable file: distinct failure exits.
+    assert run_trace_tool([f1], trace_id="0" * 32) == 1
+    capsys.readouterr()
+    assert run_trace_tool([str(tmp_path / "missing.jsonl")]) == 3
+    capsys.readouterr()
+
+
+# -- the acceptance test: one trace across the pair + forked workers --------
+
+
+def test_pair_pull_is_one_trace_across_nodes_and_workers(tmp_path):
+    """A single blob pull on a tracker+origin+agent herd with
+    data_plane_workers=2 yields ONE trace_id whose spans cover
+    announce -> dial -> piece request -> worker sendfile serve -> verify,
+    visible on /debug/trace of both nodes and joinable offline by
+    `kraken-tpu trace` with zero orphans."""
+    from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+    from kraken_tpu.cli import run_trace_tool
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.origin.client import BlobClient, ClusterClient
+    from kraken_tpu.placement import HostList, Ring
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    tcfg = {"sample_rate": 1.0, "keep_spans": 8192}
+
+    async def main():
+        tracker = TrackerNode(
+            announce_interval_seconds=0.1, peer_ttl_seconds=5.0, trace=tcfg
+        )
+        await tracker.start()
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"),
+            tracker_addr=tracker.addr,
+            scheduler_config_doc={"data_plane_workers": 2},
+            trace=tcfg,
+        )
+        await origin.start()
+        ring = Ring(HostList(static=[origin.addr]), max_replica=2)
+        cluster = ClusterClient(ring)
+        tracker.server.origin_cluster = cluster
+        origin.ring = ring
+        if origin.server:
+            origin.server.ring = ring
+        agent = AgentNode(
+            store_root=str(tmp_path / "agent"), tracker_addr=tracker.addr,
+            trace=tcfg,
+        )
+        await agent.start()
+        http = HTTPClient()
+        try:
+            blob = os.urandom(2_000_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origin.addr)
+            await oc.upload(NS, d, blob, chunk_size=500_000)
+            await oc.close()
+
+            got = await http.get(
+                f"http://{agent.addr}/namespace/"
+                f"{NS.replace('/', '%2F')}/blobs/{d.hex}"
+            )
+            assert got == blob
+
+            # The pull's trace: rooted at the agent's HTTP server span.
+            def pull_spans():
+                snap = TRACER.recorder.snapshot()
+                tids = {s["trace_id"] for s in snap
+                        if s["name"] == "p2p.download"}
+                assert len(tids) == 1, f"expected one pull trace, got {tids}"
+                tid = tids.pop()
+                return tid, [s for s in snap if s["trace_id"] == tid]
+
+            # Worker serve spans ship home on the 0.25 s stats tick --
+            # poll until the forked half of the trace has landed.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                tid, spans = pull_spans()
+                if any(s["name"] == "p2p.shard.serve" for s in spans):
+                    break
+                await asyncio.sleep(0.1)
+            names = {s["name"] for s in spans}
+            for expected in ("p2p.download", "p2p.announce", "p2p.dial",
+                             "p2p.piece.request", "p2p.shard.serve",
+                             "p2p.piece.receive", "tracker.announce"):
+                assert expected in names, f"{expected} missing from {names}"
+            # The worker half really crossed the fork: its node stamp
+            # carries the shard suffix.
+            shard_nodes = {s.get("node") for s in spans
+                           if s["name"] == "p2p.shard.serve"}
+            assert all(n and "/shard" in n for n in shard_nodes)
+
+            # Both nodes' /debug/trace surfaces hold the trace (one
+            # process here, so each returns the shared ring -- the
+            # assertion is that the SURFACE works on both).
+            for addr in (agent.addr, origin.addr):
+                doc = json.loads(await http.get(
+                    f"http://{addr}/debug/trace?view=trace&trace_id={tid}"
+                ))
+                assert {s["name"] for s in doc["spans"]} >= {
+                    "p2p.download", "p2p.shard.serve"
+                }
+
+            # Offline join: split the ring into per-node dumps the way
+            # two real nodes would write them, then reassemble. Zero
+            # orphans = no hop dropped the context.
+            agent_dump = str(tmp_path / "agent-dump.jsonl")
+            origin_dump = str(tmp_path / "origin-dump.jsonl")
+            with open(agent_dump, "w") as fa, open(origin_dump, "w") as fo:
+                for s in spans:
+                    node = s.get("node", "")
+                    f = fo if node.startswith("origin") else fa
+                    f.write(json.dumps(s) + "\n")
+            assert run_trace_tool(
+                [agent_dump, origin_dump], trace_id=tid) == 0
+        finally:
+            await http.close()
+            await agent.stop()
+            await origin.stop()
+            await cluster.close()
+            await tracker.stop()
+
+    asyncio.run(main())
