@@ -17,6 +17,19 @@ few. ``identity_dedup_ratio`` is what whole-blob dedup (the reference's
 only mechanism: content-addressed identical blobs) achieves on the same
 corpus -- the delta is the capability this plane adds.
 
+Round 9 adds the cash-in row: ``delta_bytes_moved_ratio`` -- bytes a
+REAL agent pull actually fetches (swarm piece ingress + origin range
+GETs, registry-counted) divided by blob size, on consecutive
+build-over-build pulls through a live tracker+origin+agent herd with
+the chunk-level delta-transfer plane ON, against the delta-off control
+(median +/- IQR over ``DEDUP_DELTA_LAYERS-1`` pulls). The sub-corpus is
+the same generator at image-shaped file sizes (``DEDUP_DELTA_FILE_KB``,
+default 1 MiB -- see the DELTA_* knob comments for why the headline
+corpus's 192 KB files are below the production CDC's resolution). The
+detected dedup ratio is the *ceiling*; this row is what the wire now
+*moves*. tests/test_delta.py::test_delta_pull_band pins the same
+measurement as a tier-1 CI band (delta-on <= 0.6x of control).
+
 Run on TPU (default platform) or CPU (JAX_PLATFORMS=cpu). The chunking
 rate reported is the end-to-end two-phase chunker (device gear-hash pass +
 host cut selection).
@@ -36,22 +49,44 @@ FILE_KB = int(os.environ.get("DEDUP_FILE_KB", 192))
 N_LAYERS = int(os.environ.get("DEDUP_LAYERS", 24))
 FILES_PER_LAYER = int(os.environ.get("DEDUP_FILES_PER_LAYER", 24))
 REUSE = float(os.environ.get("DEDUP_REUSE", 0.8))  # share of reused files
+# Delta e2e sub-corpus (same generator, image-shaped file sizes): the
+# planner's win tracks chunks-per-file, and the headline corpus's 192 KB
+# files sit at the production 64 KiB-avg CDC resolution floor (~3
+# chunks/file -> ~0.2 duplicate fraction vs the previous build even
+# though file REUSE is 0.8). Real build-over-build layers carry multi-MB
+# files (shared libs, venvs); 1 MiB files give ~16 chunks/file and a
+# 0.6-0.8 vs-prev duplicate fraction -- the regime delta transfer is for.
+DELTA_LAYERS = int(os.environ.get("DEDUP_DELTA_LAYERS", 8))  # e2e pulls
+DELTA_FILE_KB = int(os.environ.get("DEDUP_DELTA_FILE_KB", 1024))
+DELTA_FILES_PER_LAYER = int(os.environ.get("DEDUP_DELTA_FILES_PER_LAYER", 8))
 
 
-def make_corpus(rng: np.random.Generator) -> list[bytes]:
+def make_corpus(
+    rng: np.random.Generator,
+    n_files: int | None = None,
+    file_kb: int | None = None,
+    n_layers: int | None = None,
+    files_per_layer: int | None = None,
+) -> list[bytes]:
+    n_files = N_FILES if n_files is None else n_files
+    file_kb = FILE_KB if file_kb is None else file_kb
+    n_layers = N_LAYERS if n_layers is None else n_layers
+    files_per_layer = (
+        FILES_PER_LAYER if files_per_layer is None else files_per_layer
+    )
     files = [
-        rng.integers(0, 256, size=FILE_KB * 1024, dtype=np.uint8).tobytes()
-        for _ in range(N_FILES)
+        rng.integers(0, 256, size=file_kb * 1024, dtype=np.uint8).tobytes()
+        for _ in range(n_files)
     ]
     layers = []
     prev: list[int] = []
-    for li in range(N_LAYERS):
-        n_reuse = int(FILES_PER_LAYER * REUSE) if prev else 0
+    for li in range(n_layers):
+        n_reuse = int(files_per_layer * REUSE) if prev else 0
         reused = list(rng.choice(prev, size=min(n_reuse, len(prev)),
                                  replace=False)) if prev else []
         fresh = list(rng.choice(
-            [i for i in range(N_FILES) if i not in reused],
-            size=FILES_PER_LAYER - len(reused), replace=False))
+            [i for i in range(n_files) if i not in reused],
+            size=files_per_layer - len(reused), replace=False))
         members = reused + fresh
         rng.shuffle(members)
         parts = []
@@ -62,6 +97,103 @@ def make_corpus(rng: np.random.Generator) -> list[bytes]:
         layers.append(b"".join(parts))
         prev = members
     return layers
+
+
+async def _delta_herd(layers: list[bytes], root: str, on: bool) -> list[float]:
+    """Pull ``layers`` in build order through a live tracker+origin+agent
+    herd and return bytes-moved/blob-size for every build-over-build pull
+    (the first pull -- cold cache, necessarily ~1.0 -- is excluded).
+    "Moved" is what the agent actually fetched: swarm piece ingress
+    (``p2p_piece_bytes_down_total``) plus delta range GETs
+    (``delta_bytes_fetched_total``), read as registry deltas around each
+    pull. With ``on`` False both sides run the shipped default (delta
+    off): the control the ratio row is quoted against."""
+    from urllib.parse import quote
+
+    from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.origin.client import BlobClient, ClusterClient
+    from kraken_tpu.origin.metainfogen import PieceLengthConfig
+    from kraken_tpu.placement import HostList, Ring
+    from kraken_tpu.utils.httputil import HTTPClient
+    from kraken_tpu.utils.metrics import REGISTRY
+
+    ns = "library/bench-delta"
+    tracker = TrackerNode(announce_interval_seconds=0.1)
+    await tracker.start()
+    origin = OriginNode(
+        store_root=os.path.join(root, "origin"),
+        tracker_addr=tracker.addr,
+        # 256 KiB pieces: a ~5 MB layer carries ~19 pieces, so planning
+        # exercises both fully-covered pieces and range-filled holes.
+        piece_lengths=PieceLengthConfig(table=((0, 262144),)),
+        delta={"enabled": True} if on else None,
+    )
+    await origin.start()
+    ring = Ring(HostList(static=[origin.addr]), max_replica=2)
+    cluster = ClusterClient(ring)
+    tracker.server.origin_cluster = cluster
+    agent = AgentNode(
+        store_root=os.path.join(root, "agent"),
+        tracker_addr=tracker.addr,
+        delta={"enabled": True, "min_blob_bytes": 1} if on else None,
+    )
+    await agent.start()
+    http = HTTPClient()
+    oc = BlobClient(origin.addr)
+    down = REGISTRY.counter("p2p_piece_bytes_down_total")
+    fetched = REGISTRY.counter("delta_bytes_fetched_total")
+    ratios: list[float] = []
+    try:
+        for i, blob in enumerate(layers):
+            d = Digest.from_bytes(blob)
+            await oc.upload(ns, d, blob)
+            d0, f0 = down.value(), fetched.value()
+            got = await http.get(
+                f"http://{agent.addr}/namespace/"
+                f"{quote(ns, safe='')}/blobs/{d.hex}"
+            )
+            assert got == blob, "pulled blob must be bit-identical"
+            moved = (down.value() - d0) + (fetched.value() - f0)
+            if i > 0:
+                ratios.append(moved / len(blob))
+    finally:
+        await http.close()
+        await oc.close()
+        await agent.stop()
+        await origin.stop()
+        await cluster.close()
+        await tracker.stop()
+    return ratios
+
+
+def delta_moved_rows(rng: np.random.Generator) -> dict:
+    """The delta-transfer cash-in rows: median +/- IQR of the per-pull
+    bytes-moved ratio, delta-on vs the delta-off control, over
+    ``DELTA_LAYERS - 1`` build-over-build pulls of an image-shaped
+    sub-corpus (``DELTA_FILE_KB`` files; see the module docstring)."""
+    import asyncio
+    import tempfile
+
+    sub = make_corpus(
+        rng, n_files=4 * DELTA_FILES_PER_LAYER, file_kb=DELTA_FILE_KB,
+        n_layers=DELTA_LAYERS, files_per_layer=DELTA_FILES_PER_LAYER,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        on = asyncio.run(_delta_herd(sub, os.path.join(tmp, "on"), True))
+        off = asyncio.run(_delta_herd(sub, os.path.join(tmp, "off"), False))
+
+    def q(vals, p):
+        return round(float(np.percentile(vals, p)), 4)
+
+    return {
+        "delta_bytes_moved_ratio": q(on, 50),
+        "delta_bytes_moved_ratio_iqr": [q(on, 25), q(on, 75)],
+        "delta_off_bytes_moved_ratio": q(off, 50),
+        "delta_off_bytes_moved_ratio_iqr": [q(off, 25), q(off, 75)],
+        "delta_vs_off": round(q(on, 50) / max(q(off, 50), 1e-9), 4),
+        "delta_pulls": len(on),
+    }
 
 
 def main() -> None:
@@ -97,6 +229,9 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     ratio = dup_bytes / total
+
+    # Delta-transfer cash-in: what a real pull MOVES, on vs off.
+    delta_rows = delta_moved_rows(rng)
 
     # Device gear-pass rate, relay excluded (marginal method, as bench.py):
     # the end-to-end chunk wall clock above is dominated by this rig's
@@ -144,6 +279,7 @@ def main() -> None:
                 "gear_pass_gbps": round(gear_gbps, 2),
                 "chunk_wallclock_gbps_relay_bound": round(total / dt / 1e9, 3),
                 "identity_dedup_ratio": round(identity_dup / total, 4),
+                **delta_rows,
                 "corpus_bytes": total,
                 "layers": N_LAYERS,
             }

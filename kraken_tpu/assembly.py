@@ -41,6 +41,7 @@ from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter, instrument_app
 from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
 from kraken_tpu.utils.trace import TRACER, TraceConfig
+from kraken_tpu.p2p.delta import DeltaConfig, DeltaPlanner
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -130,6 +131,13 @@ def _trace_config(trace_cfg) -> TraceConfig:
     if isinstance(trace_cfg, TraceConfig):
         return trace_cfg
     return TraceConfig.from_dict(trace_cfg)
+
+
+def _delta_config(delta) -> DeltaConfig:
+    """Same normalization for the YAML ``delta:`` section."""
+    if isinstance(delta, DeltaConfig):
+        return delta
+    return DeltaConfig.from_dict(delta)
 
 
 def _apply_trace(component: str, cfg: TraceConfig,
@@ -358,6 +366,7 @@ class OriginNode:
         rpc: dict | RPCConfig | None = None,
         resources: dict | ResourcesConfig | None = None,
         trace: dict | TraceConfig | None = None,
+        delta: dict | DeltaConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -445,6 +454,10 @@ class OriginNode:
         # `trace:` knobs -- sampling, slow-tail threshold, ring size,
         # dump throttle; SIGHUP live-reloads. Applied at start().
         self.trace_config = _trace_config(trace)
+        # Delta-transfer plane (p2p/delta.py): origin side serves chunk
+        # recipes on GET .../recipe when enabled (shipped OFF). YAML
+        # `delta:`; SIGHUP live-reloads.
+        self.delta_config = _delta_config(delta)
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -561,6 +574,7 @@ class OriginNode:
             # piece-hash while the bytes stream in -- no re-read.
             stream_piece_hash=self.hasher_name == "cpu",
             rpc=self.rpc,
+            delta=self.delta_config,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -664,6 +678,12 @@ class OriginNode:
         if cfg.get("trace") is not None:
             self.trace_config = _trace_config(cfg["trace"])
             _apply_trace("origin", self.trace_config, self.store.root)
+        if cfg.get("delta") is not None:
+            # Live enable/disable of the recipe endpoint: rollout step 1
+            # (origins first) is a SIGHUP, not a restart.
+            self.delta_config = _delta_config(cfg["delta"])
+            if self.server is not None:
+                self.server.delta_config = self.delta_config
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -974,6 +994,7 @@ class AgentNode:
         rpc: dict | RPCConfig | None = None,
         resources: dict | ResourcesConfig | None = None,
         trace: dict | TraceConfig | None = None,
+        delta: dict | DeltaConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -1032,6 +1053,13 @@ class AgentNode:
         self.resources_config = _resources_config(resources)
         # Tracing knobs (YAML `trace:`; live-reloadable; utils/trace.py).
         self.trace_config = _trace_config(trace)
+        # Delta-transfer plane (p2p/delta.py): on a pull, copy the chunks
+        # a locally-held near-duplicate blob already has and fetch only
+        # the rest (origin byte ranges + swarm pieces). Shipped OFF;
+        # YAML `delta:`; SIGHUP live-reloads (the planner is always
+        # constructed so a reload can enable it without a restart).
+        self.delta_config = _delta_config(delta)
+        self.delta: Optional[DeltaPlanner] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -1095,15 +1123,23 @@ class AgentNode:
             self.tracker_addr, peer_id, self.host, 0,
             announce_timeout_seconds=self.rpc.announce_timeout_seconds,
         )
+        archive = AgentTorrentArchive(self.store, self.verifier)
+        # Always constructed (cheap: one idle HTTP client); the config's
+        # enabled flag gates every prefill, so a SIGHUP can turn delta on
+        # without a restart.
+        self.delta = DeltaPlanner(
+            self.store, archive, self._tracker_client, self.delta_config
+        )
         self.scheduler = Scheduler(
             peer_id=peer_id,
             ip=self.host,
             port=self.p2p_port,
-            archive=AgentTorrentArchive(self.store, self.verifier),
+            archive=archive,
             metainfo_client=self._tracker_client,
             announce_client=self._tracker_client,
             config=self.scheduler_config,
             bandwidth=self.p2p_bandwidth,
+            delta=self.delta,
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port
@@ -1165,6 +1201,12 @@ class AgentNode:
         if cfg.get("trace") is not None:
             self.trace_config = _trace_config(cfg["trace"])
             _apply_trace("agent", self.trace_config, self.store.root)
+        if cfg.get("delta") is not None:
+            # Live enable/disable + knob swap: the planner re-reads its
+            # config object on every prefill.
+            self.delta_config = _delta_config(cfg["delta"])
+            if self.delta is not None:
+                self.delta.config = self.delta_config
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,
@@ -1198,5 +1240,7 @@ class AgentNode:
             await self._tracker_client.close()
         if self._tag_client:
             await self._tag_client.close()
+        if self.delta:
+            await self.delta.close()
         # LAST: bound the next boot's fsck crash-window verify.
         await asyncio.to_thread(write_clean_shutdown, self.store)

@@ -690,6 +690,10 @@ def main(argv: list[str] | None = None) -> None:
             rpc=rpc_cfg,
             resources=resources_cfg,
             trace=cfg.get("trace"),
+            # YAML: delta: {enabled, ...} -- the chunk-level delta-
+            # transfer plane (docs/OPERATIONS.md "Delta transfer").
+            # Origin side gates GET .../recipe; shipped off.
+            delta=cfg.get("delta"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -731,6 +735,10 @@ def main(argv: list[str] | None = None) -> None:
             rpc=rpc_cfg,
             resources=resources_cfg,
             trace=cfg.get("trace"),
+            # YAML: delta: {enabled, min_blob_bytes, max_bases,
+            # min_jaccard, min_piece_cover, range_fetch} -- the agent
+            # side of the delta-transfer plane; shipped off.
+            delta=cfg.get("delta"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

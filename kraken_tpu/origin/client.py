@@ -87,6 +87,40 @@ class BlobClient:
         )
         return MetaInfo.deserialize(raw)
 
+    async def get_recipe(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> tuple[bytes, str]:
+        """The blob's serialized chunk recipe (delta-transfer plane) plus
+        the addr that served it -- the tracker proxy stamps that addr on
+        its response so agents know where byte-range fetches can go. 404s
+        (delta disabled on the origin, blob gone) raise HTTPError."""
+        raw = await self._http.get(
+            self._url(
+                f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/recipe"
+            ),
+            retry_5xx=False,
+            deadline=deadline,
+        )
+        return raw, self.addr
+
+    async def similar(
+        self, namespace: str, d: Digest, k: int = 10,
+        deadline: Deadline | None = None,
+    ) -> list[dict]:
+        """Near-duplicate blobs of ``d`` from the origin's dedup index:
+        [{"digest": hex, "score": estimated-Jaccard}], best first."""
+        import json
+
+        body = await self._http.get(
+            self._url(
+                f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"
+                f"/similar?k={k}"
+            ),
+            retry_5xx=False,
+            deadline=deadline,
+        )
+        return json.loads(body)["similar"]
+
     async def adopt(self, namespace: str, d: Digest, source: str) -> None:
         """Cross-repo mount support: associate an existing blob with
         ``namespace`` (reads through from ``source`` if evicted)."""
@@ -507,6 +541,25 @@ class ClusterClient:
         return await self._try_each(
             d, lambda c, dl: c.get_metainfo(namespace, d, deadline=dl),
             deadline=deadline, op_name="get_metainfo", hedge=True,
+        )
+
+    async def get_recipe(
+        self, namespace: str, d: Digest, deadline: Deadline | None = None
+    ) -> tuple[bytes, str]:
+        """(serialized recipe, serving origin addr) from the replica set
+        -- hedged like every idempotent read."""
+        return await self._try_each(
+            d, lambda c, dl: c.get_recipe(namespace, d, deadline=dl),
+            deadline=deadline, op_name="get_recipe", hedge=True,
+        )
+
+    async def similar(
+        self, namespace: str, d: Digest, k: int = 10,
+        deadline: Deadline | None = None,
+    ) -> list[dict]:
+        return await self._try_each(
+            d, lambda c, dl: c.similar(namespace, d, k=k, deadline=dl),
+            deadline=deadline, op_name="similar", hedge=True,
         )
 
     async def download_to_file(

@@ -10,9 +10,13 @@ Endpoints:
     PATCH  /namespace/{ns}/blobs/{d}/uploads/{uid}          (X-Upload-Offset)
     PUT    /namespace/{ns}/blobs/{d}/uploads/{uid}/commit
     GET    /namespace/{ns}/blobs/{d}                        -> blob bytes
+                                                               (Range-capable:
+                                                               delta need-span
+                                                               fetches ride it)
     GET    /namespace/{ns}/blobs/{d}/stat                   -> {"size": n}
     GET    /namespace/{ns}/blobs/{d}/metainfo               -> metainfo doc
     GET    /namespace/{ns}/blobs/{d}/similar                -> near-dup list
+    GET    /namespace/{ns}/blobs/{d}/recipe                 -> chunk recipe
     GET    /dedup/stats                                     -> corpus stats
     DELETE /namespace/{ns}/blobs/{d}
     GET    /health
@@ -249,6 +253,7 @@ class OriginServer(LameduckMixin):
         cleanup=None,  # store.cleanup.CleanupManager (optional)
         stream_piece_hash: bool = True,  # False on TPU-hasher origins
         rpc=None,  # utils.deadline.RPCConfig (optional)
+        delta=None,  # p2p.delta.DeltaConfig (optional; gates /recipe)
     ):
         self.store = store
         self.generator = generator
@@ -263,6 +268,15 @@ class OriginServer(LameduckMixin):
         # rpc: utils.deadline.RPCConfig (hedge/deadline knobs for the
         # heal-plane cluster client; None = defaults).
         self.rpc = rpc
+        # Delta-transfer plane (p2p/delta.py DeltaConfig): when enabled,
+        # GET .../recipe serves the blob's ordered CDC chunk table so
+        # agents can plan delta pulls. Shipped OFF; SIGHUP live-swaps
+        # (assembly.OriginNode.reload replaces this object).
+        if delta is None:
+            from kraken_tpu.p2p.delta import DeltaConfig
+
+            delta = DeltaConfig()
+        self.delta_config = delta
         # Lameduck drain (utils/lameduck.py): /health fails, NEW upload
         # sessions are refused with 503+Retry-After; in-flight
         # PATCH/commit of existing sessions (and established p2p conns)
@@ -319,6 +333,7 @@ class OriginServer(LameduckMixin):
         r.add_get("/namespace/{ns}/blobs/{d}/stat", self._stat)
         r.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
         r.add_get("/namespace/{ns}/blobs/{d}/similar", self._similar)
+        r.add_get("/namespace/{ns}/blobs/{d}/recipe", self._recipe)
         r.add_get("/dedup/stats", self._dedup_stats)
         r.add_get("/namespace/{ns}/blobs/{d}", self._download)
         r.add_delete("/namespace/{ns}/blobs/{d}", self._delete)
@@ -964,6 +979,42 @@ class OriginServer(LameduckMixin):
         if self.dedup is None:
             raise web.HTTPNotFound(text="dedup index disabled")
         return web.json_response(self.dedup.stats())
+
+    async def _recipe(self, req: web.Request) -> web.Response:
+        """The blob's ordered CDC chunk table (core/metainfo.ChunkRecipe),
+        derived from the dedup plane's sketch sidecar -- recomputed via
+        the ChunkRouter on a sidecar miss. The delta planner's control
+        document; gated on ``delta.enabled`` (shipped off) so rollout is
+        an explicit origin-side decision."""
+        await self._brownout_gate()
+        ns = urllib.parse.unquote(req.match_info["ns"])
+        d = self._digest(req)
+        if self.dedup is None or not self.delta_config.enabled:
+            raise web.HTTPNotFound(text="delta recipes disabled")
+        served = REGISTRY.counter(
+            "origin_recipe_requests_total",
+            "Chunk-recipe requests by result (hit = served from the "
+            "sketch sidecar, recompute = re-chunked on miss)",
+        )
+        if failpoints.fire("origin.recipe.miss"):
+            # Chaos: a recipe plane that went dark (sidecar store fault)
+            # -- agents must degrade to the full pull, never fail it.
+            served.inc(result="miss")
+            raise web.HTTPNotFound(text="failpoint origin.recipe.miss")
+        await self._ensure_local(ns, d)
+        self._touch(d)  # a recipe fetch precedes an imminent delta pull
+        try:
+            recipe, had_sidecar = await asyncio.to_thread(
+                self.dedup.recipe_sync, d
+            )
+        except KeyError:
+            # Includes DedupEvictionRace: the blob raced away mid-derive.
+            served.inc(result="miss")
+            raise web.HTTPNotFound(text="blob not found")
+        served.inc(result="hit" if had_sidecar else "recompute")
+        return web.Response(
+            body=recipe.serialize(), content_type="application/json"
+        )
 
     async def _delete(self, req: web.Request) -> web.Response:
         d = self._digest(req)

@@ -1,0 +1,551 @@
+"""Chunk-level delta transfer: pull only the bytes the cluster lacks.
+
+The dedup plane measures 0.39-0.78 duplicate bytes across layers
+(PERF.md "Dedup plane") and then the wire moves whole blobs anyway. This
+module cashes the measurement in on the agent's pull path:
+
+1. **Plan**: fetch the target blob's :class:`~kraken_tpu.core.metainfo.
+   ChunkRecipe` (tracker-proxied from the origin's dedup sidecars), ask
+   ``/similar`` for near-duplicate blobs, keep the candidates already in
+   the local cache, and diff recipes into ``have`` spans (bytes a local
+   base blob already holds) and ``need`` spans.
+2. **Copy**: for every piece the base covers, copy the have-chunks out of
+   the local base -- each chunk re-hashed against its recipe fingerprint
+   first, so a corrupt or stale base degrades to a fetch, never into the
+   assembled blob.
+3. **Fetch**: pieces the base covers only partially get their need spans
+   as origin byte-range GETs (the ``X-Kraken-Origin`` addr the tracker
+   stamps on the recipe response); pieces with little or no coverage stay
+   missing and ride the normal swarm piece pulls.
+
+Every assembled piece goes through the UNCHANGED
+:meth:`~kraken_tpu.p2p.storage.Torrent.write_piece` verify (full
+per-piece SHA-256 against the metainfo), so delta is an optimization,
+never a trust change: the worst a wrong recipe/base can do is waste the
+copy and fall back. Prefilled progress persists through the normal piece
+bitfield, so the swarm download that follows sees exactly a resumable
+partial.
+
+Default OFF (YAML ``delta:`` on agent + origin; SIGHUP live-reloads).
+Knob table and rollout runbook: docs/OPERATIONS.md "Delta transfer".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import logging
+import os
+from typing import NamedTuple, Protocol
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import ChunkRecipe, MetaInfo, chunk_fp
+from kraken_tpu.p2p.storage import PieceError
+from kraken_tpu.utils import failpoints, trace
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
+from kraken_tpu.utils.metrics import REGISTRY
+from urllib.parse import quote
+
+_log = logging.getLogger("kraken.p2p.delta")
+
+
+@dataclasses.dataclass
+class DeltaConfig:
+    """The YAML ``delta:`` section (agent + origin; live-reloads via
+    SIGHUP). Knob table in docs/OPERATIONS.md "Delta transfer"."""
+
+    # Master switch. Shipped OFF: enabling delta is a rollout decision
+    # (origins must serve recipes first -- see the runbook), never a
+    # config-refresh surprise. On the origin this gates GET .../recipe;
+    # on the agent it gates the pull-time planner.
+    enabled: bool = False
+    # Blobs below this skip planning outright: the recipe/similar round
+    # trips cost more than they can save on small blobs. Matches the
+    # shipped base.yaml value (the OPERATIONS.md knob table documents
+    # both as 4 MiB).
+    min_blob_bytes: int = 4 << 20
+    # How many locally-held /similar candidates to diff before picking
+    # the base with the most covered bytes.
+    max_bases: int = 3
+    # /similar candidates below this estimated Jaccard are ignored.
+    min_jaccard: float = 0.1
+    # A partially-covered piece is delta-assembled (local copies + range
+    # GETs for the holes) only when the base covers at least this
+    # fraction of it; below, the whole piece rides the swarm -- range
+    # requests for slivers cost more than they save.
+    min_piece_cover: float = 0.25
+    # Fetch need spans of partially-covered pieces as origin byte-range
+    # GETs. Off = only fully-covered pieces are delta-assembled and
+    # everything else rides the swarm.
+    range_fetch: bool = True
+
+    @classmethod
+    def from_dict(cls, doc: dict | None) -> "DeltaConfig":
+        doc = dict(doc or {})
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"unknown delta config keys: {sorted(unknown)}")
+        return cls(**doc)
+
+
+class DeltaClient(Protocol):
+    """What the planner needs from the control plane (TrackerClient)."""
+
+    async def get_recipe(
+        self, namespace: str, d: Digest
+    ) -> tuple[ChunkRecipe, str]: ...
+
+    async def similar(self, namespace: str, d: Digest) -> list[dict]: ...
+
+
+class HaveSpan(NamedTuple):
+    """One target chunk the base also holds: copy ``size`` bytes from
+    ``base_off`` in the base blob to ``target_off`` in the target, valid
+    only if the copied bytes still hash to ``fp``."""
+
+    target_off: int
+    size: int
+    base_off: int
+    fp: int
+
+
+def diff_recipes(
+    target: ChunkRecipe, base: ChunkRecipe
+) -> tuple[list[HaveSpan], list[tuple[int, int]]]:
+    """Partition the target blob against a base: per-chunk ``have`` spans
+    (fp-verifiable copies) and merged ``(offset, size)`` ``need`` spans.
+
+    Invariant (property-tested): the have spans plus the need spans tile
+    ``[0, target.length)`` exactly -- no overlap, no gap. Matching is by
+    ``(fp, size)``; a fingerprint collision between different-sized
+    chunks therefore cannot mispair, and a same-size collision is caught
+    by the copy-time re-hash.
+    """
+    base_map: dict[tuple[int, int], int] = {}
+    for fp, off, size in base.chunks():
+        base_map.setdefault((fp, size), off)
+    haves: list[HaveSpan] = []
+    needs: list[tuple[int, int]] = []
+    for fp, off, size in target.chunks():
+        b = base_map.get((fp, size))
+        if b is not None:
+            haves.append(HaveSpan(off, size, b, fp))
+        elif needs and needs[-1][0] + needs[-1][1] == off:
+            needs[-1] = (needs[-1][0], needs[-1][1] + size)
+        else:
+            needs.append((off, size))
+    return haves, needs
+
+
+class _RangeUnsupported(Exception):
+    """The origin answered 200 to a Range request: no byte-range support
+    behind this URL -- disable ranged assembly for the rest of the pull."""
+
+
+class DeltaPlanner:
+    """Agent-side delta pull: plan -> copy -> fetch, before the swarm.
+
+    One per node, shared by every download; ``prefill`` runs inside the
+    scheduler's per-digest download coalescer, so at most one prefill per
+    blob is in flight. Failures at ANY stage degrade to the normal full
+    swarm pull -- the planner never fails a download.
+    """
+
+    def __init__(
+        self,
+        store,  # store.CAStore
+        archive,  # p2p.storage.AgentTorrentArchive
+        client: DeltaClient,
+        config: DeltaConfig | None = None,
+        http: HTTPClient | None = None,
+    ):
+        self.store = store
+        self.archive = archive
+        self.client = client
+        self.config = config or DeltaConfig()
+        # Ranged reads fail FAST to the swarm (retries=0): the swarm path
+        # is the retry, and a struggling origin should shed this load.
+        self._http = http or HTTPClient(retries=0)
+        self._pulls = REGISTRY.counter(
+            "delta_pulls_total",
+            "Delta-planned pulls by outcome (delta = >=1 piece prefilled)",
+        )
+        self._copied = REGISTRY.counter(
+            "delta_bytes_copied_local_total",
+            "Bytes copied out of a local delta base instead of fetched",
+        )
+        self._fetched = REGISTRY.counter(
+            "delta_bytes_fetched_total",
+            "Bytes fetched as origin byte ranges for delta-assembled pieces",
+        )
+        self._recipe_misses = REGISTRY.counter(
+            "delta_recipe_misses_total",
+            "Chunk-recipe fetches that missed (disabled origin, evicted "
+            "sidecar, or error), by which side of the diff",
+        )
+        self._chunk_rejects = REGISTRY.counter(
+            "delta_chunk_verify_failures_total",
+            "Base chunks whose bytes no longer hash to the recipe fp "
+            "(corrupt/stale local base); the piece fell back to the swarm",
+        )
+        self._piece_rejects = REGISTRY.counter(
+            "delta_piece_verify_failures_total",
+            "Delta-assembled pieces that failed the piece-hash verify "
+            "and fell back to the swarm",
+        )
+
+    async def close(self) -> None:
+        await self._http.close()
+
+    # -- plan ---------------------------------------------------------------
+
+    async def prefill(self, metainfo: MetaInfo, namespace: str) -> dict | None:
+        """Try to assemble pieces of ``metainfo`` from a local delta base
+        before the swarm pull. Returns a summary dict (or None when delta
+        did not apply). Never raises for plan/copy/fetch failures -- the
+        caller's swarm download is the fallback for everything."""
+        cfg = self.config
+        d = metainfo.digest
+        if (
+            not cfg.enabled
+            or metainfo.length < cfg.min_blob_bytes
+            or self.store.in_cache(d)
+        ):
+            return None
+        with trace.span(
+            "delta.plan", digest=d.hex[:12], namespace=namespace
+        ) as sp:
+            try:
+                target, origin_addr = await self.client.get_recipe(namespace, d)
+            except Exception as e:
+                self._recipe_misses.inc(side="target")
+                self._pulls.inc(outcome="recipe_miss")
+                _log.debug(
+                    "delta: no recipe for target; full pull",
+                    extra={"digest": d.hex, "error": repr(e)},
+                )
+                return None
+            if target.length != metainfo.length:
+                # A recipe that disagrees with the metainfo cannot be
+                # planned against (stale sidecar vs a digest collision is
+                # not worth distinguishing here -- both mean "don't").
+                self._recipe_misses.inc(side="target")
+                self._pulls.inc(outcome="recipe_miss")
+                return None
+            picked = await self._pick_base(namespace, d, target)
+            if picked is None:
+                self._pulls.inc(outcome="no_base")
+                return None
+            base_d, haves = picked
+            if sp is not None:
+                sp.set(
+                    base=base_d.hex[:12],
+                    have_bytes=sum(h.size for h in haves),
+                )
+        if failpoints.fire("p2p.delta.base.evict"):
+            # Model cache eviction racing the plan->copy window: the base
+            # bytes vanish under the planner, which must fall back to the
+            # full swarm pull cleanly (tests/test_delta.py chaos tier).
+            self.store.delete_cache_file(base_d)
+        result = {
+            "base": base_d.hex,
+            "pieces": 0,
+            "copied": 0,
+            "fetched": 0,
+        }
+        torrent = self.archive.create_torrent(metainfo)
+        try:
+            if not torrent.complete():
+                await self._assemble(
+                    torrent, metainfo, namespace, base_d, haves,
+                    origin_addr, result,
+                )
+                # Hand progress over NOW: the scheduler builds a fresh
+                # Torrent from the persisted bitfield immediately after,
+                # and the debounced flusher's window would lose pieces.
+                await torrent.flush_bits()
+        finally:
+            torrent.close()
+        self._pulls.inc(outcome="delta" if result["pieces"] else "no_cover")
+        self._copied.inc(result["copied"])
+        self._fetched.inc(result["fetched"])
+        _log.info(
+            "delta prefill",
+            extra={
+                "digest": d.hex,
+                "base": base_d.hex,
+                "pieces": result["pieces"],
+                "copied_bytes": result["copied"],
+                "fetched_bytes": result["fetched"],
+            },
+        )
+        return result
+
+    async def _pick_base(
+        self, namespace: str, d: Digest, target: ChunkRecipe
+    ) -> tuple[Digest, list[HaveSpan]] | None:
+        """Best locally-held /similar candidate by covered bytes."""
+        try:
+            sims = await self.client.similar(namespace, d)
+        except Exception as e:
+            _log.debug(
+                "delta: /similar unavailable; full pull",
+                extra={"digest": d.hex, "error": repr(e)},
+            )
+            return None
+        best: tuple[Digest, list[HaveSpan]] | None = None
+        best_cover = 0
+        tried = 0
+        for s in sims:
+            try:
+                score = float(s.get("score", 0.0))
+                base_d = Digest.from_hex(s["digest"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if score < self.config.min_jaccard:
+                continue
+            if not self.store.in_cache(base_d):
+                continue
+            tried += 1
+            if tried > self.config.max_bases:
+                break
+            try:
+                base_recipe, _addr = await self.client.get_recipe(
+                    namespace, base_d
+                )
+            except Exception:
+                self._recipe_misses.inc(side="base")
+                continue
+            haves, _needs = diff_recipes(target, base_recipe)
+            cover = sum(h.size for h in haves)
+            if cover > best_cover:
+                best, best_cover = (base_d, haves), cover
+        return best if best_cover > 0 else None
+
+    # -- copy + fetch -------------------------------------------------------
+
+    async def _assemble(
+        self,
+        torrent,
+        metainfo: MetaInfo,
+        namespace: str,
+        base_d: Digest,
+        haves: list[HaveSpan],
+        origin_addr: str,
+        result: dict,
+    ) -> None:
+        plen = metainfo.piece_length
+        cover: dict[int, list[HaveSpan]] = {}
+        for h in haves:
+            first = h.target_off // plen
+            last = (h.target_off + h.size - 1) // plen
+            for i in range(first, last + 1):
+                cover.setdefault(i, []).append(h)
+        ranged_ok = bool(origin_addr) and self.config.range_fetch
+        url = (
+            f"{base_url(origin_addr)}/namespace/"
+            f"{quote(namespace, safe='')}/blobs/{metainfo.digest.hex}"
+            if origin_addr
+            else ""
+        )
+        try:
+            base_fd = self.store.open_cache_fd(base_d)
+        except KeyError:
+            # Base evicted between plan and copy: nothing to copy -- the
+            # swarm takes the whole pull. (An eviction AFTER this open is
+            # harmless: the fd pins the immutable bytes past the unlink.)
+            _log.debug(
+                "delta: base evicted before copy; full pull",
+                extra={"base": base_d.hex},
+            )
+            return
+        # Per-chunk verify verdicts, shared across pieces: a chunk that
+        # straddles a piece boundary is read+hashed once, not once per
+        # piece, and a corrupt one is counted once. _copy_piece calls
+        # run one at a time (awaited below), so no locking.
+        verified: dict[HaveSpan, bool] = {}
+        try:
+            with trace.span(
+                "delta.copy", digest=metainfo.digest.hex[:12],
+                base=base_d.hex[:12],
+            ):
+                for i in torrent.missing_pieces():
+                    spans = cover.get(i)
+                    if not spans:
+                        continue
+                    p0 = i * plen
+                    pl = metainfo.piece_length_of(i)
+                    out = await asyncio.to_thread(
+                        self._copy_piece, base_fd, p0, pl, spans, verified
+                    )
+                    if out is None:
+                        continue  # fp reject: this piece rides the swarm
+                    buf, holes, copied = out
+                    if holes:
+                        if (
+                            not ranged_ok
+                            or copied < self.config.min_piece_cover * pl
+                        ):
+                            continue
+                        try:
+                            with trace.span(
+                                "delta.fetch", piece=i, spans=len(holes),
+                            ):
+                                fetched = await self._fetch_holes(
+                                    url, p0, holes, buf
+                                )
+                        except _RangeUnsupported:
+                            ranged_ok = False
+                            continue
+                        except Exception as e:
+                            # ONE failure budget for the whole pull: a
+                            # dead/partitioned origin must not be
+                            # re-dialed (and re-timed-out) per piece --
+                            # serial 60 s stalls inside prefill would
+                            # make delta slower than the swarm it is
+                            # supposed to beat. Fully-covered pieces
+                            # still assemble; the rest ride the swarm.
+                            ranged_ok = False
+                            _log.debug(
+                                "delta: range fetch failed; ranged "
+                                "assembly off for this pull",
+                                extra={"piece": i, "error": repr(e)},
+                            )
+                            continue
+                        result["fetched"] += fetched
+                    try:
+                        await torrent.write_piece(i, bytes(buf))
+                    except PieceError:
+                        # The assembled piece does not hash to the
+                        # metainfo (stale recipe, fp collision): the
+                        # unchanged verify caught it; swarm re-fetches.
+                        self._piece_rejects.inc()
+                        continue
+                    result["copied"] += copied
+                    result["pieces"] += 1
+        finally:
+            os.close(base_fd)
+
+    def _copy_piece(
+        self,
+        base_fd: int,
+        p0: int,
+        pl: int,
+        spans: list[HaveSpan],
+        verified: dict[HaveSpan, bool],
+    ) -> tuple[bytearray, list[tuple[int, int]], int] | None:
+        """Build piece ``[p0, p0+pl)`` from base chunks (worker thread).
+
+        Returns ``(buf, holes, copied_bytes)`` where ``holes`` are the
+        piece-relative ``(off, size)`` intervals no verified chunk
+        covered, or None when a chunk failed its fp re-verify (corrupt
+        base: the piece must not be assembled from it). ``verified``
+        carries per-chunk verdicts across this pull's pieces: a chunk
+        straddling a piece boundary is fully read + hashed by the first
+        piece that sees it, and later pieces read only their overlap."""
+        buf = bytearray(pl)
+        filled: list[tuple[int, int]] = []
+        copied = 0
+        for h in spans:
+            lo = max(h.target_off, p0)
+            hi = min(h.target_off + h.size, p0 + pl)
+            if lo >= hi:
+                continue
+            ok = verified.get(h)
+            if ok is False:
+                return None
+            if ok is None:
+                chunk = os.pread(base_fd, h.size, h.base_off)
+                if len(chunk) != h.size or chunk_fp(chunk) != h.fp:
+                    # The base no longer holds what the recipe says
+                    # (at-rest corruption, or a recipe/blob mismatch):
+                    # nothing copied from it can be trusted.
+                    self._chunk_rejects.inc()
+                    verified[h] = False
+                    return None
+                verified[h] = True
+                part = chunk[lo - h.target_off : hi - h.target_off]
+            else:
+                # Verified by an earlier piece: read just the overlap.
+                part = os.pread(
+                    base_fd, hi - lo, h.base_off + (lo - h.target_off)
+                )
+                if len(part) != hi - lo:
+                    # Immutable-CAS fds can't short-read inside the file;
+                    # treat anything else as a reject, not silent holes.
+                    self._chunk_rejects.inc()
+                    verified[h] = False
+                    return None
+            rel = lo - p0
+            buf[rel : rel + (hi - lo)] = part
+            filled.append((rel, hi - lo))
+            copied += hi - lo
+        filled.sort()
+        holes: list[tuple[int, int]] = []
+        pos = 0
+        for off, size in filled:
+            if off > pos:
+                holes.append((pos, off - pos))
+            pos = max(pos, off + size)
+        if pos < pl:
+            holes.append((pos, pl - pos))
+        return buf, holes, copied
+
+    # Concurrent Range GETs per piece: build-over-build coverage
+    # alternates have/need, so a piece often carries several holes --
+    # fetching them serially costs sum(holes) x RTT on a WAN origin.
+    _FETCH_CONCURRENCY = 4
+
+    async def _fetch_holes(
+        self,
+        url: str,
+        p0: int,
+        holes: list[tuple[int, int]],
+        buf: bytearray,
+    ) -> int:
+        """Fill ``holes`` (piece-relative) of ``buf`` via origin Range
+        GETs (up to ``_FETCH_CONCURRENCY`` in flight); returns bytes
+        fetched. Raises :class:`_RangeUnsupported` when the origin
+        answers 200 (whole blob) to a range request; that error wins
+        over transient ones so the caller turns ranging off rather than
+        retrying an origin that will never serve spans."""
+        sem = asyncio.Semaphore(self._FETCH_CONCURRENCY)
+
+        async def fetch_one(rel: int, size: int) -> int:
+            a = p0 + rel
+            async with sem:
+                try:
+                    body = await self._http.get(
+                        url,
+                        headers={"Range": f"bytes={a}-{a + size - 1}"},
+                        ok_statuses=(206,),
+                        # 200 = no range support behind this URL. Abort
+                        # (no body read) instead of buffering the WHOLE
+                        # blob just to learn it can't serve spans.
+                        abort_statuses=(200,),
+                        retry_5xx=False,
+                    )
+                except HTTPError as e:
+                    if e.status == 200:
+                        raise _RangeUnsupported(url) from None
+                    raise
+            if len(body) != size:
+                raise PieceError(
+                    f"range GET returned {len(body)} bytes, wanted {size}"
+                )
+            buf[rel : rel + size] = body
+            return size
+
+        results = await asyncio.gather(
+            *(fetch_one(rel, size) for rel, size in holes),
+            return_exceptions=True,
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        for e in errs:
+            if isinstance(e, _RangeUnsupported):
+                raise e
+        if errs:
+            raise errs[0]
+        return sum(results)

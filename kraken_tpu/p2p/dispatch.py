@@ -23,6 +23,7 @@ from kraken_tpu.p2p.piecerequest import RequestManager
 from kraken_tpu.p2p.storage import PieceError, Torrent
 from kraken_tpu.p2p.wire import Message, MsgType
 from kraken_tpu.utils import trace
+from kraken_tpu.utils.metrics import REGISTRY
 
 
 def _bits_to_set(bits: bytes, num_pieces: int) -> set[int]:
@@ -90,6 +91,20 @@ class Dispatcher:
         self._created = asyncio.get_running_loop().time()
         self._bytes_down = 0
         self._bytes_up = 0
+        # Fleet-wide swarm byte counters (cached refs: no registry lookup
+        # on the per-piece path). What the delta-transfer plane's "bytes
+        # actually moved" accounting reads: swarm ingress here plus the
+        # planner's delta_bytes_fetched_total is every fetched byte of a
+        # pull. Shard-served egress is counted separately by the worker
+        # plane (data_plane_worker_bytes_sent_total).
+        self._ctr_down = REGISTRY.counter(
+            "p2p_piece_bytes_down_total",
+            "Piece payload bytes received over the swarm wire",
+        )
+        self._ctr_up = REGISTRY.counter(
+            "p2p_piece_bytes_up_total",
+            "Piece payload bytes served over the swarm wire (main loop)",
+        )
         self._peers_seen: set[PeerID] = set()
         self._blacklist_events = 0
         if torrent.complete():
@@ -325,6 +340,7 @@ class Dispatcher:
             data = await self.torrent.read_piece_async(idx)
             await peer.conn.send(Message.piece_payload(idx, data))
         self._bytes_up += len(data)
+        self._ctr_up.inc(len(data))
         # A completed send is progress: an honest-but-slow link keeps
         # earning its churn exemption one delivered piece at a time.
         peer.last_useful = asyncio.get_running_loop().time()
@@ -370,6 +386,7 @@ class Dispatcher:
             peer=peer.conn.peer_id.hex, piece=idx, size=len(data),
         )
         self._bytes_down += len(data)
+        self._ctr_down.inc(len(data))
         if self.torrent.has_piece(idx):
             self.requests.clear_piece(idx)
             await self._request_more(peer)

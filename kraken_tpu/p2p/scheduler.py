@@ -195,6 +195,7 @@ class Scheduler:
         events: Producer | None = None,
         is_origin: bool = False,
         metainfo_resolver=None,
+        delta=None,  # p2p.delta.DeltaPlanner (agents; optional)
     ):
         self.peer_id = peer_id
         self.ip = ip
@@ -209,6 +210,13 @@ class Scheduler:
         # Origin side: resolve a blob digest hex -> MetaInfo for inbound
         # handshakes on blobs we seed but have no live control for.
         self._metainfo_resolver = metainfo_resolver
+        # Delta-transfer plane (p2p/delta.py): when set, downloads run a
+        # prefill pass first -- pieces assembled from a local near-
+        # duplicate base (plus origin byte-range fetches) land in the
+        # piece bitfield before the swarm pull, which then fetches only
+        # what delta could not cover. Gated inside the planner on its
+        # live-reloadable config; a prefill failure never fails the pull.
+        self._delta = delta
         self.conn_state = ConnState(self.config.conn_state)
         # Which Conn instance owns each conn-state active slot: a stale
         # conn's close must never release a slot a newer conn has taken.
@@ -351,6 +359,21 @@ class Scheduler:
             "p2p.download", digest=d.hex[:12], namespace=namespace,
         ) as sp:
             metainfo = await self.metainfo_client.get(namespace, d)
+            if (
+                self._delta is not None
+                and metainfo.info_hash not in self._controls
+            ):
+                # Prefill BEFORE the control exists: the control's
+                # Torrent (and its dispatcher's done future) must be
+                # built from the post-prefill bitfield -- a fully
+                # prefilled blob then completes without a single conn.
+                try:
+                    await self._delta.prefill(metainfo, namespace)
+                except Exception:
+                    _log.warning(
+                        "delta prefill failed; full swarm pull",
+                        extra={"digest": d.hex}, exc_info=True,
+                    )
             ctl = self._get_or_create_control(metainfo, namespace)
             if sp is not None and ctl.trace_parent is None:
                 ctl.trace_parent = trace.ParentContext(

@@ -428,6 +428,20 @@ class Torrent:
         """Off-loop :meth:`read_piece` for pump-context reads."""
         return await asyncio.to_thread(self.read_piece, i)
 
+    async def flush_bits(self) -> None:
+        """Persist the piece bitfield NOW (off-loop), ahead of the
+        debounced flusher. The delta prefill hands its progress to a
+        fresh Torrent immediately after closing this one -- waiting out
+        the 200 ms debounce window (or racing close()'s fire-and-forget
+        executor flush) would let the successor re-download pieces this
+        torrent already verified and wrote."""
+        async with self._lock:
+            if self._status is not None and self._bits_dirty:
+                await asyncio.to_thread(
+                    self.store.set_metadata, self.metainfo.digest, self._status
+                )
+                self._bits_dirty = False
+
 
 class AgentTorrentArchive:
     """Download-side archive: creates resumable torrents from metainfo.

@@ -271,6 +271,17 @@ class CAStore:
         except FileNotFoundError:
             raise KeyError(str(d)) from None
 
+    def open_cache_fd(self, d: Digest) -> int:
+        """Raw ``O_RDONLY`` fd on a cached blob (KeyError if absent).
+        Callers own the fd (``os.close``); positional reads (``os.pread``)
+        from worker threads then need no shared file offset -- the delta
+        planner's base-chunk copies use this. CAS immutability means the
+        fd stays valid content even if the blob is evicted after open."""
+        try:
+            return os.open(self.cache_path(d), os.O_RDONLY)
+        except FileNotFoundError:
+            raise KeyError(str(d)) from None
+
     def read_cache_file(self, d: Digest) -> bytes:
         with self.open_cache_file(d) as f:
             return f.read()

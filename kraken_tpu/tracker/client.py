@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 
 from kraken_tpu.core.digest import Digest
-from kraken_tpu.core.metainfo import InfoHash, MetaInfo
+from kraken_tpu.core.metainfo import ChunkRecipe, InfoHash, MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
 from urllib.parse import quote
 
@@ -101,6 +101,37 @@ class TrackerClient:
                 f"{quote(namespace, safe='')}/blobs/{d.hex}/metainfo"
             )
         return MetaInfo.deserialize(raw)
+
+    async def get_recipe(
+        self, namespace: str, d: Digest
+    ) -> tuple[ChunkRecipe, str]:
+        """The blob's chunk recipe (delta-transfer plane), proxied from
+        the origin cluster, plus the serving origin's addr (the
+        ``X-Kraken-Origin`` response header; '' when absent) -- where the
+        planner aims its byte-range fetches. Raises HTTPError on 404
+        (delta disabled or blob unknown): misses are an expected state
+        the planner degrades through, so no retries."""
+        with trace.span("tracker.get_recipe", digest=d.hex[:12]):
+            _status, headers, body = await self._http.request_full(
+                "GET",
+                f"{base_url(self.addr)}/namespace/"
+                f"{quote(namespace, safe='')}/blobs/{d.hex}/recipe",
+                retry_5xx=False,
+            )
+        return ChunkRecipe.deserialize(body), headers.get(
+            "X-Kraken-Origin", ""
+        )
+
+    async def similar(self, namespace: str, d: Digest) -> list[dict]:
+        """Near-duplicate candidates for ``d`` (delta base selection):
+        [{"digest": hex, "score": estimated-Jaccard}], best first."""
+        with trace.span("tracker.get_similar", digest=d.hex[:12]):
+            raw = await self._http.get(
+                f"{base_url(self.addr)}/namespace/"
+                f"{quote(namespace, safe='')}/blobs/{d.hex}/similar",
+                retry_5xx=False,
+            )
+        return json.loads(raw)["similar"]
 
     async def close(self) -> None:
         await self._http.close()

@@ -9,7 +9,17 @@ Endpoints:
 
     POST /announce                 body: announce record   -> {peers, interval}
     GET  /namespace/{ns}/blobs/{d}/metainfo               -> metainfo doc
+    GET  /namespace/{ns}/blobs/{d}/recipe                 -> chunk recipe
+                                                             (X-Kraken-Origin:
+                                                             serving origin)
+    GET  /namespace/{ns}/blobs/{d}/similar                -> near-dup list
     GET  /health
+
+Agents know only the tracker, so the delta-transfer control plane
+(recipes + /similar) proxies through it exactly like metainfo; the
+``X-Kraken-Origin`` header names the origin that served the recipe so
+agents can aim byte-range fetches at a replica that actually holds the
+blob.
 """
 
 from __future__ import annotations
@@ -26,6 +36,7 @@ from kraken_tpu.tracker.peerhandout import default_priority
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore, PeerStore
 from kraken_tpu.utils import failpoints
 from kraken_tpu.utils.dedup import TTLCache
+from kraken_tpu.utils.httputil import is_not_found
 from kraken_tpu.utils.metrics import FailureMeter
 
 _log = logging.getLogger("kraken.tracker")
@@ -47,6 +58,10 @@ class TrackerServer:
         self.policy = handout_policy
         self.handout_limit = handout_limit
         self._metainfo_cache: TTLCache = TTLCache(metainfo_cache_ttl)
+        # Recipes are as immutable as metainfo (CAS: derived from the
+        # blob's bytes), so the same TTL cache applies; /similar is NOT
+        # cached -- its answer improves as blobs land.
+        self._recipe_cache: TTLCache = TTLCache(metainfo_cache_ttl)
         # A handler failure swallowed as a bare 404 made a dying origin
         # cluster indistinguishable from a missing blob; meter + one
         # throttled WARN with request context instead.
@@ -60,6 +75,8 @@ class TrackerServer:
         app = web.Application()
         app.router.add_post("/announce", self._announce)
         app.router.add_get("/namespace/{ns}/blobs/{d}/metainfo", self._metainfo)
+        app.router.add_get("/namespace/{ns}/blobs/{d}/recipe", self._recipe)
+        app.router.add_get("/namespace/{ns}/blobs/{d}/similar", self._similar)
         app.router.add_get("/health", self._health)
         return app
 
@@ -138,11 +155,7 @@ class TrackerServer:
         )
 
     async def _metainfo(self, req: web.Request) -> web.Response:
-        ns = urllib.parse.unquote(req.match_info["ns"])
-        try:
-            d = Digest.from_str(req.match_info["d"])
-        except DigestError:
-            raise web.HTTPBadRequest(text="malformed digest")
+        ns, d = self._parse_digest(req)
         cached = self._metainfo_cache.get(d.hex)
         if cached is None:
             if self.origin_cluster is None:
@@ -162,6 +175,71 @@ class TrackerServer:
             cached = metainfo.serialize()
             self._metainfo_cache.put(d.hex, cached)
         return web.Response(body=cached)
+
+    def _parse_digest(self, req: web.Request) -> tuple[str, Digest]:
+        ns = urllib.parse.unquote(req.match_info["ns"])
+        try:
+            return ns, Digest.from_str(req.match_info["d"])
+        except DigestError:
+            raise web.HTTPBadRequest(text="malformed digest")
+
+    async def _recipe(self, req: web.Request) -> web.Response:
+        """Delta-plane proxy: the blob's chunk recipe from the origin
+        cluster, with the serving origin's addr stamped on the response
+        (``X-Kraken-Origin``) so agents can aim range fetches at it. A
+        clean origin 404 (delta disabled, blob gone) is the expected
+        steady state while delta is rolled out -- it is NOT a handler
+        error."""
+        ns, d = self._parse_digest(req)
+        cached = self._recipe_cache.get(d.hex)
+        if cached is None:
+            if self.origin_cluster is None:
+                raise web.HTTPNotFound(text="no origin cluster configured")
+            try:
+                raw, addr = await self.origin_cluster.get_recipe(ns, d)
+            except Exception as e:
+                if not is_not_found(e):
+                    self._handler_errors.record(
+                        f"recipe fetch {d.hex[:12]} ns={ns} "
+                        f"peer={req.remote}", e,
+                    )
+                raise web.HTTPNotFound(text="recipe unavailable")
+            cached = (raw, addr)
+            self._recipe_cache.put(d.hex, cached)
+        raw, addr = cached
+        return web.Response(
+            body=raw,
+            content_type="application/json",
+            headers={"X-Kraken-Origin": addr},
+        )
+
+    async def _similar(self, req: web.Request) -> web.Response:
+        """Delta-plane proxy: near-duplicate candidates from the origin
+        cluster's dedup index (uncached: the answer improves as blobs
+        land)."""
+        ns, d = self._parse_digest(req)
+        if self.origin_cluster is None:
+            raise web.HTTPNotFound(text="no origin cluster configured")
+        try:
+            k = int(req.query.get("k", "10"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="malformed k")
+        if k <= 0:
+            # Reject here: forwarded, the origin's 400 would both read
+            # as 404 to the caller and pollute _handler_errors -- the
+            # meter that distinguishes a dying origin cluster from a
+            # missing blob.
+            raise web.HTTPBadRequest(text="k must be > 0")
+        try:
+            hits = await self.origin_cluster.similar(ns, d, k=k)
+        except Exception as e:
+            if not is_not_found(e):
+                self._handler_errors.record(
+                    f"similar fetch {d.hex[:12]} ns={ns} "
+                    f"peer={req.remote}", e,
+                )
+            raise web.HTTPNotFound(text="similar unavailable")
+        return web.json_response({"similar": hits})
 
     async def _health(self, req: web.Request) -> web.Response:
         return web.Response(text="ok")

@@ -227,6 +227,7 @@ class HTTPClient:
         data: Any = None,
         headers: dict | None = None,
         ok_statuses: tuple[int, ...] = (200, 201, 204),
+        abort_statuses: tuple[int, ...] = (),
         retry_5xx: bool = True,
         deadline: Deadline | None = None,
     ) -> bytes:
@@ -252,6 +253,14 @@ class HTTPClient:
                         async with session.request(
                             method, url, data=data, headers=headers, **kw
                         ) as resp:
+                            if resp.status in abort_statuses:
+                                # Statuses the caller only needs to SEE,
+                                # never read: raise before resp.read()
+                                # buffers the body (e.g. a 200 -- whole
+                                # blob -- answering a delta Range GET).
+                                raise HTTPError(
+                                    method, url, resp.status, b""
+                                )
                             body = await resp.read()
                             if resp.status in ok_statuses:
                                 return _maybe_truncate(body)

@@ -26,7 +26,7 @@ CLI_KEYS = {
     "dedup_budget_bytes", "extends", "immutable_tags", "p2p_bandwidth",
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
-    "task_timeout_seconds", "rpc", "resources", "trace",
+    "task_timeout_seconds", "rpc", "resources", "trace", "delta",
 }
 
 
@@ -189,6 +189,32 @@ def test_trace_sections_construct_trace_config():
         assert cfg.dump_dir == "", path
         seen += 1
     assert seen >= 3  # agent + origin + tracker ship the trace knobs
+
+
+def test_delta_sections_construct_delta_config():
+    """Every shipped `delta:` section must map onto DeltaConfig through
+    the same from_dict the CLI/assembly use -- a typo'd knob must fail
+    here, not at production boot. The shipped default must stay OFF on
+    BOTH sides: delta is a rollout decision (origins serve recipes
+    first, agents canary after -- OPERATIONS.md runbook), never a
+    config-refresh surprise."""
+    from kraken_tpu.p2p.delta import DeltaConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        dc = load_config(path).get("delta")
+        if dc is None:
+            continue
+        cfg = DeltaConfig.from_dict(dc)  # raises on unknown keys
+        assert cfg.enabled is False, (
+            f"{path}: shipped delta.enabled must stay false"
+        )
+        assert cfg.min_blob_bytes >= 0, path
+        assert cfg.max_bases >= 1, path
+        assert 0.0 <= cfg.min_jaccard <= 1.0, path
+        assert 0.0 <= cfg.min_piece_cover <= 1.0, path
+        seen += 1
+    assert seen >= 2  # agent + origin register the delta knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -1,0 +1,541 @@
+"""Chunk-level delta transfer: recipes, planning, and the pull path.
+
+Tiers here:
+
+- property tests: ChunkRecipe serialize/deserialize roundtrip and
+  recipe-diff correctness (have/need spans exactly tile the blob -- no
+  overlap, no gap) under a randomized corpus;
+- surface tests: the origin /recipe endpoint (gated, hit-vs-recompute
+  accounting) and the tracker proxy (X-Kraken-Origin stamp);
+- the tier-1 byte-moved BAND: a build-over-build pull with delta on must
+  move <= ``BAND_MAX`` of the blob's bytes while the delta-off control
+  moves ~all of them -- a planner regression that silently re-fetches
+  everything fails here, not in production dashboards;
+- chaos tier: corrupt local base -> fp re-verify rejects the span ->
+  clean fallback, bit-identical; recipe-miss and evicted-base paths via
+  failpoints.
+
+Every e2e herd uses 16 KiB pieces and 256/1024/4096 CDC params so a
+~400 KB blob exercises multi-piece, multi-chunk planning in milliseconds.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import ChunkRecipe, MetaInfoError, chunk_fp
+from kraken_tpu.ops.cdc import CDCParams
+from kraken_tpu.p2p.delta import DeltaConfig, HaveSpan, diff_recipes
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.metrics import REGISTRY
+
+PARAMS = CDCParams(min_size=256, avg_size=1024, max_size=4096)
+NS = "library/delta"
+BAND_MAX = 0.6  # acceptance bar: delta-on moves <= 0.6x of delta-off
+
+_D = Digest.from_bytes(b"recipe-test")
+
+
+@pytest.fixture(autouse=True)
+def chaos_plane():
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow()
+    yield failpoints.FAILPOINTS
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow(False)
+
+
+# -- properties: recipe format + diff ------------------------------------
+
+
+def _random_recipe(rng, digest=_D, n=None) -> ChunkRecipe:
+    n = int(rng.integers(0, 64)) if n is None else n
+    fps = rng.integers(0, 1 << 63, size=n, dtype=np.uint64).tolist()
+    sizes = rng.integers(1, 1 << 20, size=n, dtype=np.uint32).tolist()
+    return ChunkRecipe(digest, fps, sizes)
+
+
+def test_chunk_recipe_roundtrip_property():
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        r = _random_recipe(rng)
+        back = ChunkRecipe.deserialize(r.serialize())
+        assert back == r
+        assert back.length == r.length
+        assert list(back.chunks()) == list(r.chunks())
+    # Offsets are cumulative and tile [0, length).
+    r = _random_recipe(rng, n=17)
+    pos = 0
+    for _fp, off, size in r.chunks():
+        assert off == pos
+        pos += size
+    assert pos == r.length
+
+
+def test_chunk_recipe_malformed():
+    good = _random_recipe(np.random.default_rng(1), n=3).serialize()
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe.deserialize(b"not json")
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe.deserialize(b'{"version":2}')
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe.deserialize(b'[1,2,3]')
+    import json
+
+    doc = json.loads(good)
+    doc["length"] += 1  # sizes no longer sum to the declared length
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe.deserialize(json.dumps(doc).encode())
+    doc = json.loads(good)
+    doc["fps"] = doc["fps"][:-2]  # misaligned table
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe.deserialize(json.dumps(doc).encode())
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe(_D, [1, 2], [10])  # length mismatch
+    with pytest.raises(MetaInfoError):
+        ChunkRecipe(_D, [1], [0])  # zero-size chunk
+
+
+def test_diff_recipes_tiling_property():
+    """have + need spans must tile the target exactly, for any pair of
+    recipes drawn from a shared chunk pool (the randomized corpus)."""
+    rng = np.random.default_rng(5)
+    pool_fps = rng.integers(0, 1 << 63, size=40, dtype=np.uint64)
+    pool_sizes = rng.integers(1, 8192, size=40, dtype=np.uint32)
+    for _trial in range(30):
+        def draw(k):
+            idx = rng.integers(0, 40, size=k)
+            return (
+                [int(pool_fps[i]) for i in idx],
+                [int(pool_sizes[i]) for i in idx],
+            )
+        t_fps, t_sizes = draw(int(rng.integers(1, 30)))
+        b_fps, b_sizes = draw(int(rng.integers(0, 30)))
+        target = ChunkRecipe(_D, t_fps, t_sizes)
+        base = ChunkRecipe(_D, b_fps, b_sizes)
+        haves, needs = diff_recipes(target, base)
+        spans = sorted(
+            [(h.target_off, h.size) for h in haves] + list(needs)
+        )
+        pos = 0
+        for off, size in spans:
+            assert off == pos, "overlap or gap in the partition"
+            pos += size
+        assert pos == target.length
+        base_keys = {
+            (fp, size) for fp, _off, size in base.chunks()
+        }
+        for h in haves:
+            assert (h.fp, h.size) in base_keys
+            # The base offset really points at a chunk of that (fp, size).
+            assert 0 <= h.base_off <= base.length - h.size
+
+
+def test_diff_recipes_merges_adjacent_needs():
+    target = ChunkRecipe(_D, [1, 2, 3, 4], [10, 20, 30, 40])
+    base = ChunkRecipe(_D, [1, 4], [10, 40])
+    haves, needs = diff_recipes(target, base)
+    assert [(h.target_off, h.size, h.base_off) for h in haves] == [
+        (0, 10, 0), (60, 40, 10),
+    ]
+    assert needs == [(10, 50)]  # the two middle chunks merged
+
+
+def test_delta_config_from_dict():
+    cfg = DeltaConfig.from_dict({"enabled": True, "max_bases": 5})
+    assert cfg.enabled and cfg.max_bases == 5
+    assert DeltaConfig.from_dict(None).enabled is False  # shipped default
+    with pytest.raises(ValueError):
+        DeltaConfig.from_dict({"enabld": True})
+
+
+# -- e2e herd harness -----------------------------------------------------
+
+
+def _make_build_pair(rng, n_files=24, file_kb=16, reuse=0.8):
+    """Two consecutive 'image builds': tar-like streams of (64 B unique
+    header + file body) where build 2 reuses ``reuse`` of build 1's files
+    in shuffled order -- shared content at SHIFTED offsets, the case that
+    defeats identity dedup and that CDC recipes are for."""
+    files = [
+        rng.integers(0, 256, size=file_kb * 1024, dtype=np.uint8).tobytes()
+        for _ in range(2 * n_files)
+    ]
+
+    def layer(members):
+        parts = []
+        for fi in members:
+            parts.append(rng.integers(0, 256, size=64, dtype=np.uint8).tobytes())
+            parts.append(files[fi])
+        return b"".join(parts)
+
+    m1 = list(range(n_files))
+    n_keep = int(n_files * reuse)
+    m2 = m1[:n_keep] + list(range(n_files, 2 * n_files - n_keep))
+    rng.shuffle(m2)
+    return layer(m1), layer(m2)
+
+
+class _Herd:
+    """tracker + origin (+ cluster wiring) + agent, delta-capable."""
+
+    def __init__(self, tmp_path, agent_delta=None, origin_delta=None):
+        self.tmp = tmp_path
+        self.agent_delta = agent_delta
+        self.origin_delta = origin_delta
+
+    async def __aenter__(self):
+        from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+        from kraken_tpu.origin.client import ClusterClient
+        from kraken_tpu.origin.dedup import DedupIndex
+        from kraken_tpu.origin.metainfogen import PieceLengthConfig
+        from kraken_tpu.placement import HostList, Ring
+
+        self.tracker = TrackerNode(announce_interval_seconds=0.1)
+        await self.tracker.start()
+        self.origin = OriginNode(
+            store_root=str(self.tmp / "origin"),
+            tracker_addr=self.tracker.addr,
+            piece_lengths=PieceLengthConfig(table=((0, 16384),)),
+            delta=self.origin_delta,
+        )
+        # Small CDC params so ~400 KB blobs carry hundreds of chunks.
+        self.origin.dedup = DedupIndex(self.origin.store, params=PARAMS)
+        await self.origin.start()
+        ring = Ring(HostList(static=[self.origin.addr]), max_replica=2)
+        self.cluster = ClusterClient(ring)
+        self.tracker.server.origin_cluster = self.cluster
+        self.agent = AgentNode(
+            store_root=str(self.tmp / "agent"),
+            tracker_addr=self.tracker.addr,
+            delta=self.agent_delta,
+        )
+        await self.agent.start()
+        from kraken_tpu.utils.httputil import HTTPClient
+        from kraken_tpu.origin.client import BlobClient
+
+        self.http = HTTPClient()
+        self.oc = BlobClient(self.origin.addr)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.http.close()
+        await self.oc.close()
+        await self.agent.stop()
+        await self.origin.stop()
+        await self.cluster.close()
+        await self.tracker.stop()
+
+    async def upload(self, blob: bytes) -> Digest:
+        d = Digest.from_bytes(blob)
+        await self.oc.upload(NS, d, blob)
+        return d
+
+    async def pull(self, d: Digest) -> tuple[bytes, int]:
+        """Pull through the agent; returns (bytes, bytes_moved) where
+        moved = swarm piece ingress + delta range fetches during the
+        pull (REGISTRY deltas -- the registry is process-global)."""
+        down = REGISTRY.counter("p2p_piece_bytes_down_total")
+        fetched = REGISTRY.counter("delta_bytes_fetched_total")
+        d0, f0 = down.value(), fetched.value()
+        from urllib.parse import quote
+
+        body = await self.http.get(
+            f"http://{self.agent.addr}/namespace/"
+            f"{quote(NS, safe='')}/blobs/{d.hex}"
+        )
+        moved = (down.value() - d0) + (fetched.value() - f0)
+        return body, int(moved)
+
+
+DELTA_ON = {"enabled": True, "min_blob_bytes": 1}
+
+
+def test_delta_pull_band(tmp_path):
+    """THE acceptance band (tier-1): on the build-over-build corpus a
+    delta-on pull moves <= 0.6x the bytes of the delta-off control, the
+    result is bit-identical, and local copies actually happened. A
+    planner regression that silently re-fetches everything fails here."""
+    asyncio.run(_delta_pull_band(tmp_path))
+
+
+async def _delta_pull_band(tmp_path):
+    rng = np.random.default_rng(7)
+    v1, v2 = _make_build_pair(rng)
+    copied = REGISTRY.counter("delta_bytes_copied_local_total")
+    async with _Herd(
+        tmp_path / "on", agent_delta=DELTA_ON, origin_delta={"enabled": True}
+    ) as herd:
+        d1 = await herd.upload(v1)
+        got1, moved1 = await herd.pull(d1)
+        assert got1 == v1
+        # First pull: nothing cached locally -> full fetch.
+        assert moved1 >= len(v1)
+        d2 = await herd.upload(v2)
+        c0 = copied.value()
+        got2, moved2 = await herd.pull(d2)
+        assert got2 == v2, "delta-assembled blob must be bit-identical"
+        on_ratio = moved2 / len(v2)
+        assert copied.value() > c0, "no local copies happened"
+    async with _Herd(tmp_path / "off") as herd:  # shipped defaults: off
+        d1 = await herd.upload(v1)
+        await herd.pull(d1)
+        d2 = await herd.upload(v2)
+        got2, moved_off = await herd.pull(d2)
+        assert got2 == v2
+        off_ratio = moved_off / len(v2)
+    assert off_ratio >= 0.95, f"control pull should move ~all bytes: {off_ratio}"
+    assert on_ratio <= BAND_MAX * off_ratio, (
+        f"delta-on moved {on_ratio:.3f}x vs control {off_ratio:.3f}x -- "
+        f"planner regression (band: <= {BAND_MAX}x of control)"
+    )
+
+
+def test_delta_live_reload_enables(tmp_path):
+    """Shipped-off nodes enable delta via reload() (the SIGHUP path) --
+    rollout is a config refresh, not a restart: origin first (recipe
+    endpoint goes 404 -> 200), then the agent planner."""
+    asyncio.run(_delta_live_reload(tmp_path))
+
+
+async def _delta_live_reload(tmp_path):
+    rng = np.random.default_rng(8)
+    v1, v2 = _make_build_pair(rng)
+    async with _Herd(tmp_path) as herd:  # both sides shipped-off
+        d1 = await herd.upload(v1)
+        # Recipe endpoint is dark while disabled.
+        from kraken_tpu.utils.httputil import HTTPError
+        from urllib.parse import quote
+
+        url = (
+            f"http://{herd.origin.addr}/namespace/"
+            f"{quote(NS, safe='')}/blobs/{d1.hex}/recipe"
+        )
+        with pytest.raises(HTTPError) as ei:
+            await herd.http.get(url, retry_5xx=False)
+        assert ei.value.status == 404
+        herd.origin.reload({"delta": {"enabled": True}})
+        raw = await herd.http.get(url, retry_5xx=False)
+        recipe = ChunkRecipe.deserialize(raw)
+        assert recipe.length == len(v1)
+        assert recipe.digest.hex == d1.hex
+        # Agent side: planner live-enables too.
+        herd.agent.reload({"delta": DELTA_ON})
+        assert herd.agent.delta.config.enabled
+        await herd.pull(d1)
+        d2 = await herd.upload(v2)
+        got2, moved2 = await herd.pull(d2)
+        assert got2 == v2
+        assert moved2 < len(v2), "post-reload pull should have delta'd"
+
+
+def test_origin_recipe_endpoint_accounting(tmp_path):
+    """Recipe requests are counted hit vs recompute; the recipe's chunks
+    tile the blob and fingerprint-match its bytes; the tracker proxy
+    stamps the serving origin."""
+    asyncio.run(_origin_recipe_endpoint(tmp_path))
+
+
+async def _origin_recipe_endpoint(tmp_path):
+    rng = np.random.default_rng(9)
+    v1, _ = _make_build_pair(rng, n_files=6)
+    served = REGISTRY.counter("origin_recipe_requests_total")
+    async with _Herd(
+        tmp_path, origin_delta={"enabled": True}
+    ) as herd:
+        d = await herd.upload(v1)
+        # Commit-time dedup indexing is async; the sidecar may not exist
+        # yet -- the first recipe request derives it (recompute), the
+        # second hits the sidecar.
+        from urllib.parse import quote
+
+        url = (
+            f"http://{herd.origin.addr}/namespace/"
+            f"{quote(NS, safe='')}/blobs/{d.hex}/recipe"
+        )
+        h0, r0 = served.value(result="hit"), served.value(result="recompute")
+        raw = await herd.http.get(url, retry_5xx=False)
+        recipe = ChunkRecipe.deserialize(raw)
+        assert recipe.length == len(v1)
+        # Every chunk's fp matches the actual bytes (the agent-side
+        # re-verify contract).
+        for fp, off, size in recipe.chunks():
+            assert chunk_fp(v1[off : off + size]) == fp
+        raw2 = await herd.http.get(url, retry_5xx=False)
+        assert raw2 == raw
+        assert served.value(result="hit") + served.value(
+            result="recompute"
+        ) == h0 + r0 + 2
+        assert served.value(result="hit") >= h0 + 1  # second hit the sidecar
+        # Tracker proxy: same body, origin addr stamped.
+        _status, headers, body = await herd.http.request_full(
+            "GET",
+            f"http://{herd.tracker.addr}/namespace/"
+            f"{quote(NS, safe='')}/blobs/{d.hex}/recipe",
+            retry_5xx=False,
+        )
+        assert body == raw
+        assert headers.get("X-Kraken-Origin") == herd.origin.addr
+        # Tracker /similar proxy answers too (self never listed).
+        import json
+
+        sim = json.loads(await herd.http.get(
+            f"http://{herd.tracker.addr}/namespace/"
+            f"{quote(NS, safe='')}/blobs/{d.hex}/similar",
+            retry_5xx=False,
+        ))
+        assert "similar" in sim
+
+
+# -- chaos tier -----------------------------------------------------------
+
+
+def test_delta_corrupt_base_falls_back_bit_identical(tmp_path):
+    """A corrupt local delta base: the fp re-verify rejects the damaged
+    chunk's span, those pieces ride the swarm, and the result is STILL
+    bit-identical -- delta is an optimization, never a trust change."""
+    asyncio.run(_delta_corrupt_base(tmp_path))
+
+
+async def _delta_corrupt_base(tmp_path):
+    rng = np.random.default_rng(10)
+    v1, v2 = _make_build_pair(rng)
+    rejects = REGISTRY.counter("delta_chunk_verify_failures_total")
+    async with _Herd(
+        tmp_path, agent_delta=DELTA_ON, origin_delta={"enabled": True}
+    ) as herd:
+        d1 = await herd.upload(v1)
+        got1, _ = await herd.pull(d1)
+        assert got1 == v1
+        # Flip bytes INSIDE the agent's cached copy of the base -- at-rest
+        # corruption the recipe knows nothing about. Scattered every
+        # 24 KiB so shared (have) chunks are guaranteed to be hit, not
+        # just the per-build unique headers.
+        path = herd.agent.store.cache_path(d1)
+        with open(path, "r+b") as f:
+            for off in range(8192, len(v1), 24576):
+                f.seek(off)
+                f.write(b"\xde\xad\xbe\xef")
+        r0 = rejects.value()
+        d2 = await herd.upload(v2)
+        got2, _moved = await herd.pull(d2)
+        assert got2 == v2, "corrupt base must never reach the blob"
+        assert rejects.value() > r0, "fp re-verify never fired"
+
+
+def test_delta_recipe_miss_full_pull(tmp_path):
+    """Failpoint origin.recipe.miss: the recipe plane goes dark -- the
+    pull degrades to a full fetch, counted on delta_recipe_misses_total,
+    and still completes bit-identically."""
+    asyncio.run(_delta_recipe_miss(tmp_path))
+
+
+async def _delta_recipe_miss(tmp_path):
+    rng = np.random.default_rng(12)
+    v1, v2 = _make_build_pair(rng, n_files=8)
+    misses = REGISTRY.counter("delta_recipe_misses_total")
+    pulls = REGISTRY.counter("delta_pulls_total")
+    async with _Herd(
+        tmp_path, agent_delta=DELTA_ON, origin_delta={"enabled": True}
+    ) as herd:
+        d1 = await herd.upload(v1)
+        await herd.pull(d1)
+        failpoints.FAILPOINTS.arm("origin.recipe.miss", "always")
+        m0 = misses.value(side="target")
+        p0 = pulls.value(outcome="recipe_miss")
+        d2 = await herd.upload(v2)
+        got2, moved2 = await herd.pull(d2)
+        assert got2 == v2
+        assert moved2 >= len(v2)  # nothing was delta'd
+        assert misses.value(side="target") == m0 + 1
+        assert pulls.value(outcome="recipe_miss") == p0 + 1
+
+
+def test_delta_base_evicted_mid_plan_falls_back(tmp_path):
+    """Failpoint p2p.delta.base.evict: /similar handed a base the cache
+    evicted between plan and copy -- the planner degrades to the full
+    swarm pull cleanly (no crash, no partial trust), bit-identical."""
+    asyncio.run(_delta_base_evicted(tmp_path))
+
+
+async def _delta_base_evicted(tmp_path):
+    rng = np.random.default_rng(13)
+    v1, v2 = _make_build_pair(rng, n_files=8)
+    pulls = REGISTRY.counter("delta_pulls_total")
+    copied = REGISTRY.counter("delta_bytes_copied_local_total")
+    async with _Herd(
+        tmp_path, agent_delta=DELTA_ON, origin_delta={"enabled": True}
+    ) as herd:
+        d1 = await herd.upload(v1)
+        await herd.pull(d1)
+        failpoints.FAILPOINTS.arm("p2p.delta.base.evict", "once")
+        n0 = pulls.value(outcome="no_cover")
+        c0 = copied.value()
+        d2 = await herd.upload(v2)
+        got2, moved2 = await herd.pull(d2)
+        assert got2 == v2
+        assert moved2 >= len(v2)  # the whole blob came over the wire
+        assert copied.value() == c0, "copied from an evicted base?"
+        assert pulls.value(outcome="no_cover") == n0 + 1
+        assert not herd.agent.store.in_cache(d1)  # base really evicted
+
+
+def test_copy_piece_holes_and_fp_reject(tmp_path):
+    """Unit: _copy_piece fills exactly the covered intervals, reports the
+    complement as holes, and rejects a chunk whose bytes don't hash to
+    the recipe fp."""
+    from kraken_tpu.p2p.delta import DeltaPlanner
+
+    base = bytes(np.random.default_rng(3).integers(0, 256, 8192, np.uint8))
+    path = tmp_path / "base"
+    path.write_bytes(base)
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        planner = DeltaPlanner.__new__(DeltaPlanner)  # only _copy_piece
+        planner._chunk_rejects = REGISTRY.counter(
+            "delta_chunk_verify_failures_total"
+        )
+        # Piece [0, 4096); two verified chunks cover [100,1100)+[2000,2500).
+        spans = [
+            HaveSpan(100, 1000, 0, chunk_fp(base[0:1000])),
+            HaveSpan(2000, 500, 4000, chunk_fp(base[4000:4500])),
+        ]
+        out = planner._copy_piece(fd, 0, 4096, spans, {})
+        assert out is not None
+        buf, holes, copied_n = out
+        assert copied_n == 1500
+        assert bytes(buf[100:1100]) == base[0:1000]
+        assert bytes(buf[2000:2500]) == base[4000:4500]
+        assert holes == [(0, 100), (1100, 900), (2500, 1596)]
+        # A chunk that straddles the piece end: only the overlap copies,
+        # but the WHOLE chunk is fp-verified -- and the verdict is
+        # cached, so the NEXT piece reads just its overlap and the
+        # copied bytes still match.
+        straddle = HaveSpan(3900, 1000, 500, chunk_fp(base[500:1500]))
+        verified = {}
+        buf, holes, copied_n = planner._copy_piece(
+            fd, 0, 4096, [straddle], verified
+        )
+        assert copied_n == 196
+        assert bytes(buf[3900:4096]) == base[500:696]
+        assert verified == {straddle: True}
+        buf2, _holes2, copied2 = planner._copy_piece(
+            fd, 4096, 4096, [straddle], verified
+        )
+        assert copied2 == 1000 - 196
+        assert bytes(buf2[0 : 1000 - 196]) == base[696:1500]
+        # Wrong fp -> None (reject), nothing trusted -- and counted ONCE
+        # across every piece the corrupt chunk covers.
+        rejects = planner._chunk_rejects
+        r0 = rejects.value()
+        bad = HaveSpan(3900, 1000, 0, 12345)
+        verified = {}
+        assert planner._copy_piece(fd, 0, 4096, [bad], verified) is None
+        assert planner._copy_piece(fd, 4096, 4096, [bad], verified) is None
+        assert verified == {bad: False}
+        assert rejects.value() == r0 + 1
+    finally:
+        os.close(fd)
