@@ -52,7 +52,7 @@ from kraken_tpu.core.metainfo import MetaInfo
 
 
 async def run_pair(blob_mb: int, piece_kb: int, root: str,
-                   workers: int = 0) -> dict:
+                   workers: int = 0, reset_profiler: bool = False) -> dict:
     rng = np.random.default_rng(0)
     blob = rng.integers(0, 256, size=blob_mb << 20, dtype=np.uint8).tobytes()
     d = Digest.from_bytes(blob)
@@ -69,6 +69,13 @@ async def run_pair(blob_mb: int, piece_kb: int, root: str,
     origin.seed(metainfo, NS)
     await agent.start()
 
+    if reset_profiler:
+        # Attribution runs scope the sampler to the DOWNLOAD: blob
+        # generation, metainfo hashing, and store fill above are bench
+        # setup, not pull cost.
+        from kraken_tpu.utils.profiler import PROFILER
+
+        PROFILER.reset()
     # CPU accounting window: download through the stops below, so worker
     # children are reaped (os.times only credits children after waitpid)
     # and the seed-serve CPU rows can split main-loop vs shard cost.
@@ -627,6 +634,110 @@ def run_trace_overhead(args) -> None:
     print(json.dumps(row))
 
 
+def run_profiler_overhead(args) -> None:
+    """Round 11 honesty row: what the always-on sampling profiler costs
+    the data path at the SHIPPED rate (base.yaml ``profiling.hz``).
+    Same protocol as the trace_overhead row: full-stack and pump-
+    knockout legs, each profiler-off vs profiler-on back to back so the
+    ratio cancels shared-core drift. The CI version is
+    tests/test_data_plane_band.py::test_profiler_on_overhead_band."""
+    from kraken_tpu.configutil import load_config
+    from kraken_tpu.utils.profiler import PROFILER, ProfilerConfig
+
+    shipped = ProfilerConfig.from_dict(
+        load_config(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "config", "agent", "base.yaml")
+        ).get("profiling")
+    )
+
+    def med(vals):
+        return statistics.median(sorted(vals))
+
+    def leg(enabled: bool, knockout: bool) -> list[float]:
+        PROFILER.apply(
+            shipped if enabled else ProfilerConfig(enabled=False)
+        )
+        try:
+            return [
+                r["goodput_mbps"]
+                for r in _run_repeats(args, knockout=knockout)
+            ]
+        finally:
+            PROFILER.apply(ProfilerConfig(enabled=False))
+            PROFILER.reset()
+
+    row: dict = {
+        "metric": "profiler_overhead",
+        "unit": "MB/s",
+        "hz": shipped.hz,
+    }
+    for label, knockout in (("full", False), ("pump", True)):
+        if knockout and args.skip_knockout:
+            continue
+        off = leg(False, knockout)
+        on = leg(True, knockout)
+        row[f"{label}_off_mbps"] = med(off)
+        row[f"{label}_on_mbps"] = med(on)
+        row[f"{label}_on_off_ratio"] = (
+            round(med(on) / med(off), 4) if med(off) else None
+        )
+    print(json.dumps(row))
+
+
+def run_leech_attribution(args, hz: float = 97.0,
+                          flame_dir: str | None = None) -> dict:
+    """THE headline artifact of the profiling plane: the measured
+    leech-side attribution -- where a real pull's busy samples actually
+    go (pump recv framing vs verify hashing vs pwrite vs dispatch) --
+    from a pair run with ``data_plane_workers=2`` so the origin's serve
+    cost sits in forked shards, sampled and shipped home like
+    production. This is the number that decides ROADMAP item 3's next
+    move (leech-side sharding vs a C framing helper). Sampled at a
+    HIGHER hz than shipped (resolution, not cost, is the point of a
+    one-off run); also writes a profile dump + `kraken-tpu flame`
+    collapse covering main loop plus shards when ``flame_dir`` is
+    given."""
+    from kraken_tpu.utils.profiler import (
+        PROFILER,
+        ProfilerConfig,
+        plane_pct_busy,
+    )
+
+    PROFILER.apply(ProfilerConfig(
+        hz=hz, window_seconds=600.0, keep_windows=2,
+        dump_dir=flame_dir or "",
+    ))
+    PROFILER.node = "pair"
+    PROFILER.reset()
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            r = asyncio.run(run_pair(args.blob_mb, args.piece_kb, root,
+                                     workers=2, reset_profiler=True))
+        planes = PROFILER.plane_totals()
+        dump_path = None
+        if flame_dir:
+            dump_path = PROFILER.dump("bench", "leech attribution run")
+    finally:
+        PROFILER.apply(ProfilerConfig(enabled=False))
+    busy = sum(c for p, c in planes.items() if p != "idle")
+    row = {
+        "metric": "leech_attribution",
+        "hz": hz,
+        "workers": 2,
+        "blob_mb": args.blob_mb,
+        "wall_s": r["wall_s"],
+        "goodput_mbps": r["goodput_mbps"],
+        "samples_busy": busy,
+        "samples_idle": planes.get("idle", 0),
+        "plane_samples": {k: v for k, v in sorted(planes.items())},
+        "plane_pct_busy": plane_pct_busy(planes),
+        "flame_dump": dump_path,
+    }
+    print(json.dumps(row))
+    return row
+
+
 def _summarize(metric: str, results: list[dict]) -> None:
     # Median +/- spread of N runs (VERDICT r5 next #3): single best-of
     # runs on this shared core produced BENCH-vs-PERF discrepancies
@@ -664,6 +775,12 @@ def main() -> None:
     ap.add_argument("--skip-trace", action="store_true",
                     help="skip the trace_overhead (trace-off vs trace-on"
                          " at shipped sampling) rows")
+    ap.add_argument("--skip-profiler", action="store_true",
+                    help="skip the profiler_overhead (off vs on at"
+                         " shipped hz) + leech_attribution rows")
+    ap.add_argument("--flame-dir", default=None,
+                    help="write the attribution run's profile dump here"
+                         " (fold it with `kraken-tpu flame`)")
     ap.add_argument("--workers", type=int, default=0,
                     help="data_plane_workers for the headline rows (the"
                          " scaling rows always compare 0 vs 2)")
@@ -683,6 +800,9 @@ def main() -> None:
         run_seed_serve(args)
     if not args.skip_trace:
         run_trace_overhead(args)
+    if not args.skip_profiler:
+        run_profiler_overhead(args)
+        run_leech_attribution(args, flame_dir=args.flame_dir)
     if not args.skip_alloc:
         print(json.dumps(run_alloc_sample()))
     if not args.skip_brownout:

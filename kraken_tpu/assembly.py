@@ -39,6 +39,11 @@ from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.deadline import RPCConfig
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter, instrument_app
+from kraken_tpu.utils.profiler import (
+    PROFILER,
+    LoopLagMonitor,
+    ProfilerConfig,
+)
 from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
 from kraken_tpu.utils.trace import TRACER, TraceConfig
 from kraken_tpu.p2p.delta import DeltaConfig, DeltaPlanner
@@ -140,6 +145,35 @@ def _delta_config(delta) -> DeltaConfig:
     return DeltaConfig.from_dict(delta)
 
 
+def _profiling_config(profiling) -> ProfilerConfig:
+    """Same normalization for the YAML ``profiling:`` section."""
+    if isinstance(profiling, ProfilerConfig):
+        return profiling
+    return ProfilerConfig.from_dict(profiling)
+
+
+def _apply_profiling(component: str, cfg: ProfilerConfig,
+                     store_root: str = "") -> ProfilerConfig:
+    """Apply a node's ``profiling:`` section to the process-global
+    sampler (utils/profiler.py PROFILER -- one per process, like the
+    TRACER; in-process herds share it and the last-started node wins).
+    An empty ``dump_dir`` defaults beside the trace dumps under the
+    node's store root, so a degradation postmortem's spans and stacks
+    land in one directory; store-less nodes (tracker) skip file
+    captures unless a dir is configured explicitly. Also registers the
+    tracer's dump-trigger hook: every flight-recorder trigger (breaker
+    trip, DeadlineExceeded, resource breach, lameduck) now captures a
+    profile window too."""
+    if not cfg.dump_dir and store_root:
+        cfg = dataclasses.replace(
+            cfg, dump_dir=os.path.join(store_root, "traces")
+        )
+    PROFILER.node = component
+    PROFILER.apply(cfg)
+    TRACER.on_trigger = PROFILER.trigger_capture
+    return cfg
+
+
 def _apply_trace(component: str, cfg: TraceConfig,
                  store_root: str = "") -> None:
     """Apply a node's ``trace:`` section to the process-global tracer
@@ -155,6 +189,33 @@ def _apply_trace(component: str, cfg: TraceConfig,
         )
     TRACER.apply(cfg)
     TRACER.node = component
+
+
+def _sync_loop_monitor(node, component: str) -> None:
+    """Bring a node's LoopLagMonitor in line with its profiling config
+    -- used at start AND on SIGHUP reload, so enabling profiling live
+    really starts the heartbeat and disabling really stops it (knob
+    changes apply in place). Keeps the sentinel's loop_lag probe
+    pointed at the live monitor (or None), so the ``loop_lag`` budget
+    follows the toggle too."""
+    cfg = node.profiling_config
+    sentinel = getattr(node, "sentinel", None)
+    if cfg.enabled and node.loop_monitor is None:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (offline reload): nothing to heartbeat yet
+        node.loop_monitor = LoopLagMonitor(component, cfg)
+        node.loop_monitor.start()
+    elif not cfg.enabled and node.loop_monitor is not None:
+        node.loop_monitor.stop()
+        node.loop_monitor = None
+    elif node.loop_monitor is not None:
+        node.loop_monitor.apply(cfg)
+    if sentinel is not None:
+        sentinel.loop_lag_probe = (
+            node.loop_monitor.p99 if node.loop_monitor is not None else None
+        )
 
 
 def _start_sentinel(node, component: str) -> ResourceSentinel:
@@ -174,6 +235,7 @@ def _start_sentinel(node, component: str) -> ResourceSentinel:
         elif node.scheduler is not None:
             node.scheduler.enter_lameduck()
 
+    monitor = getattr(node, "loop_monitor", None)
     sentinel = ResourceSentinel(
         component,
         node.resources_config,
@@ -184,6 +246,10 @@ def _start_sentinel(node, component: str) -> ResourceSentinel:
             if node.cleanup is not None else 6 * 3600
         ),
         on_sustained_breach=shed,
+        # The loop-lag monitor's recent p99 feeds the sentinel's
+        # `loop_lag` budget kind (resources: loop_lag_p99_seconds) --
+        # a wedged event loop drains like any other resource breach.
+        loop_lag_probe=monitor.p99 if monitor is not None else None,
     )
     sentinel.start()
     return sentinel
@@ -264,13 +330,18 @@ class TrackerNode:
                  redis_addr: str = "",
                  ssl_context=None,
                  rpc: dict | RPCConfig | None = None,
-                 trace: dict | TraceConfig | None = None):
+                 trace: dict | TraceConfig | None = None,
+                 profiling: dict | ProfilerConfig | None = None):
         self.host = host
         self.port = port
         self.rpc = _rpc_config(rpc)
         # Store-less node: dump_dir stays "" (no file postmortems)
         # unless the YAML sets one explicitly; /debug/trace still works.
         self.trace_config = _trace_config(trace)
+        # Same for profile captures: the sampler + loop-lag monitor run
+        # regardless (the /debug/pprof surfaces answer live).
+        self.profiling_config = _profiling_config(profiling)
+        self.loop_monitor: Optional[LoopLagMonitor] = None
         # Redis-protocol store: swarm survives tracker restarts and can be
         # shared by several trackers; default in-memory store re-heals via
         # TTL instead.
@@ -295,6 +366,10 @@ class TrackerNode:
 
     async def start(self) -> None:
         _apply_trace("tracker", self.trace_config)
+        self.profiling_config = _apply_profiling(
+            "tracker", self.profiling_config
+        )
+        _sync_loop_monitor(self, "tracker")
         self._runner, self.port = await _serve(
             self.server.make_app(), self.host, self.port, "tracker",
             ssl_context=self.ssl_context,
@@ -310,6 +385,11 @@ class TrackerNode:
         if cfg.get("trace") is not None:
             self.trace_config = _trace_config(cfg["trace"])
             _apply_trace("tracker", self.trace_config)
+        if cfg.get("profiling") is not None:
+            self.profiling_config = _apply_profiling(
+                "tracker", _profiling_config(cfg["profiling"])
+            )
+            _sync_loop_monitor(self, "tracker")
         if cfg.get("rpc") is None:
             return
         self.rpc = _rpc_config(cfg["rpc"])
@@ -326,6 +406,8 @@ class TrackerNode:
     async def stop(self) -> None:
         if self._refresh_task:
             self._refresh_task.cancel()
+        if self.loop_monitor:
+            self.loop_monitor.stop()
         if self._runner:
             await self._runner.cleanup()
         await self.server.peers.close()
@@ -367,6 +449,7 @@ class OriginNode:
         resources: dict | ResourcesConfig | None = None,
         trace: dict | TraceConfig | None = None,
         delta: dict | DeltaConfig | None = None,
+        profiling: dict | ProfilerConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -458,6 +541,12 @@ class OriginNode:
         # recipes on GET .../recipe when enabled (shipped OFF). YAML
         # `delta:`; SIGHUP live-reloads.
         self.delta_config = _delta_config(delta)
+        # Continuous profiling plane (utils/profiler.py): sampler hz,
+        # loop-lag knobs, capture throttle. YAML `profiling:`; SIGHUP
+        # live-reloads. Applied at start() (before the scheduler forks
+        # seed-serve workers, which inherit the applied config).
+        self.profiling_config = _profiling_config(profiling)
+        self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -518,6 +607,13 @@ class OriginNode:
         # Trace config FIRST: the scheduler start below forks seed-serve
         # workers, which inherit the tracer's applied config wholesale.
         _apply_trace("origin", self.trace_config, self.store.root)
+        # Profiling config before the fork too (workers restart their
+        # own sampler from the inherited config), and the loop-lag
+        # heartbeat before the sentinel (which probes its p99).
+        self.profiling_config = _apply_profiling(
+            "origin", self.profiling_config, self.store.root
+        )
+        _sync_loop_monitor(self, "origin")
         # Startup fsck BEFORE any listener binds: the tree must be
         # reconciled (orphans swept, crash-window blobs verified) before
         # the swarm, replication, or writeback can stream from it.
@@ -684,6 +780,12 @@ class OriginNode:
             self.delta_config = _delta_config(cfg["delta"])
             if self.server is not None:
                 self.server.delta_config = self.delta_config
+        if cfg.get("profiling") is not None:
+            self.profiling_config = _apply_profiling(
+                "origin", _profiling_config(cfg["profiling"]),
+                self.store.root,
+            )
+            _sync_loop_monitor(self, "origin")
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -826,6 +928,8 @@ class OriginNode:
             self._reseed_task.cancel()
         if self.sentinel:
             self.sentinel.stop()
+        if self.loop_monitor:
+            self.loop_monitor.stop()
         if self.scrubber:
             self.scrubber.stop()
         for t in list(self._repair_tasks):
@@ -995,6 +1099,7 @@ class AgentNode:
         resources: dict | ResourcesConfig | None = None,
         trace: dict | TraceConfig | None = None,
         delta: dict | DeltaConfig | None = None,
+        profiling: dict | ProfilerConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -1060,6 +1165,10 @@ class AgentNode:
         # constructed so a reload can enable it without a restart).
         self.delta_config = _delta_config(delta)
         self.delta: Optional[DeltaPlanner] = None
+        # Continuous profiling plane (utils/profiler.py); YAML
+        # `profiling:`; SIGHUP live-reloads.
+        self.profiling_config = _profiling_config(profiling)
+        self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
         self.fsck_report = None
@@ -1104,6 +1213,12 @@ class AgentNode:
         # Trace config before the scheduler forks any seed-serve worker
         # (the fork inherits the applied tracer config).
         _apply_trace("agent", self.trace_config, self.store.root)
+        # Profiling config before the fork; loop-lag heartbeat before
+        # the sentinel (which probes its p99).
+        self.profiling_config = _apply_profiling(
+            "agent", self.profiling_config, self.store.root
+        )
+        _sync_loop_monitor(self, "agent")
         if self.fsck_enabled:
             self.fsck_report = await asyncio.to_thread(
                 run_fsck,
@@ -1207,6 +1322,12 @@ class AgentNode:
             self.delta_config = _delta_config(cfg["delta"])
             if self.delta is not None:
                 self.delta.config = self.delta_config
+        if cfg.get("profiling") is not None:
+            self.profiling_config = _apply_profiling(
+                "agent", _profiling_config(cfg["profiling"]),
+                self.store.root,
+            )
+            _sync_loop_monitor(self, "agent")
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,
@@ -1228,6 +1349,8 @@ class AgentNode:
             self._cleanup_task.cancel()
         if self.sentinel:
             self.sentinel.stop()
+        if self.loop_monitor:
+            self.loop_monitor.stop()
         if self.scrubber:
             self.scrubber.stop()
         if self.scheduler:

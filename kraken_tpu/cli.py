@@ -164,6 +164,48 @@ def run_trace_tool(paths: list[str], trace_id: str | None = None,
     return 1 if total_orphans else 0
 
 
+def run_flame_tool(paths: list[str], top: int = 0) -> int:
+    """`kraken-tpu flame`: fold one or more profile JSONL dumps
+    (utils/profiler.py -- written by the flight-recorder triggers or
+    GET /debug/pprof/profile saved to disk; worker-shard samples ship
+    through the parent, so ONE node dump already covers main loop plus
+    shards) into a single flamegraph-ready collapse on stdout
+    (``node;thread;frames... count``), with the data-plane split
+    (pump/verify/pwrite/serve/...) quantified in a trailing JSON line.
+    Exit codes mirror `kraken-tpu trace`'s orphan gate: 0 clean, 1 when
+    any file is unparseable or TRUNCATED (its header promised more
+    stacks than the file holds -- a torn capture must fail CI loudly,
+    not quietly thin the flamegraph), 3 usage (no input readable at
+    all). In-process callable for tests."""
+    from kraken_tpu.utils.profiler import load_profile_dumps, plane_pct_busy
+
+    stacks, planes, errors = load_profile_dumps(paths)
+    if not stacks and not planes and errors:
+        # Nothing at all was usable (unreadable paths, files with no
+        # profile header): a typo'd glob must not "fold clean". A
+        # truncated-but-headed dump still folds what survived -- and
+        # exits 1 below.
+        for err in errors:
+            print(json.dumps({"event": "error", "message": err}),
+                  flush=True)
+        return 3
+    ordered = stacks.most_common(top if top > 0 else None)
+    for stack, count in ordered:
+        print(f"{stack} {count}")
+    for err in errors:
+        print(json.dumps({"event": "error", "message": err}), flush=True)
+    print(json.dumps({
+        "event": "flame_done",
+        "files": len(paths),
+        "stacks": len(stacks),
+        "samples": sum(stacks.values()),
+        "planes": dict(planes),
+        "plane_pct_busy": plane_pct_busy(planes),
+        "errors": len(errors),
+    }), flush=True)
+    return 1 if errors else 0
+
+
 def _common(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("--host", default=None)
@@ -296,6 +338,21 @@ def main(argv: list[str] | None = None) -> None:
                               " from the dumps)")
     p_trace.add_argument("--slowest", type=int, default=0,
                          help="print only the N slowest traces")
+
+    p_flame = sub.add_parser(
+        "flame", help="offline continuous-profiling reassembly: fold one"
+        " or more profile JSONL dumps (from the flight-recorder triggers"
+        " or /debug/pprof/profile) into a flamegraph-ready collapse with"
+        " the data-plane split (pump/verify/pwrite/serve) quantified;"
+        " exit 1 when any file is unparseable or truncated (CI gates on"
+        " it), 3 when no input is usable"
+    )
+    p_flame.add_argument("dumps", nargs="+",
+                         help="profile JSONL dump files (profile-*.jsonl"
+                              " from <store>/traces/; one node dump"
+                              " already folds main loop + worker shards)")
+    p_flame.add_argument("--top", type=int, default=0,
+                         help="print only the N hottest stacks")
 
     p_locate = sub.add_parser(
         "locate", help="print a digest's ring placement offline"
@@ -431,6 +488,11 @@ def main(argv: list[str] | None = None) -> None:
         import sys
 
         sys.exit(sys_exit)
+
+    if args.component == "flame":
+        import sys
+
+        sys.exit(run_flame_tool(args.dumps, top=args.top))
 
     if args.component == "locate":
         # Where does the ring place a digest? The operator's "which
@@ -596,6 +658,9 @@ def main(argv: list[str] | None = None) -> None:
             ssl_context=ssl_context,
             rpc=rpc_cfg,
             trace=cfg.get("trace"),
+            # YAML: profiling: {enabled, hz, loop-lag knobs...} -- the
+            # continuous-profiling plane (docs/OPERATIONS.md).
+            profiling=cfg.get("profiling"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "tracker"}, args.config)
@@ -694,6 +759,10 @@ def main(argv: list[str] | None = None) -> None:
             # transfer plane (docs/OPERATIONS.md "Delta transfer").
             # Origin side gates GET .../recipe; shipped off.
             delta=cfg.get("delta"),
+            # YAML: profiling: {enabled, hz, window_seconds, loop_lag_*,
+            # ...} -- the continuous-profiling plane (docs/OPERATIONS.md
+            # "Continuous profiling"). SIGHUP live-reloads.
+            profiling=cfg.get("profiling"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -739,6 +808,8 @@ def main(argv: list[str] | None = None) -> None:
             # min_jaccard, min_piece_cover, range_fetch} -- the agent
             # side of the delta-transfer plane; shipped off.
             delta=cfg.get("delta"),
+            # YAML: profiling: -- the continuous-profiling plane.
+            profiling=cfg.get("profiling"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

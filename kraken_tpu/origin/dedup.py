@@ -351,10 +351,28 @@ class DedupIndex:
                 if size == 0:
                     record = self._compute_record(b"")
                 else:
-                    with mmap.mmap(
+                    # Manual lifecycle, not `with`: the continuous
+                    # profiler's sampler (utils/profiler.py) briefly
+                    # holds every thread's frame, which can keep a
+                    # just-returned frame's locals -- views over this
+                    # map included -- alive a beat past the compute.
+                    # An eager close() into that window raises
+                    # BufferError; tolerating it and dropping the map
+                    # instead lets the last view's dealloc unmap it
+                    # (the bufpool.Lease.release precedent). The cache
+                    # fd closes independently via the `with` above.
+                    mm = mmap.mmap(
                         f.fileno(), 0, access=mmap.ACCESS_READ
-                    ) as mm:
-                        record = self._compute_record(memoryview(mm))
+                    )
+                    mv = memoryview(mm)
+                    try:
+                        record = self._compute_record(mv)
+                    finally:
+                        try:
+                            mv.release()
+                            mm.close()
+                        except BufferError:
+                            pass
             if not self.store.in_cache(d):
                 # Eviction (or DELETE) raced this add: the open fd/mmap
                 # kept the bytes readable past the unlink, but indexing

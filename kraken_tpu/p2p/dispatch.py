@@ -107,6 +107,27 @@ class Dispatcher:
         )
         self._peers_seen: set[PeerID] = set()
         self._blacklist_events = 0
+        # Per-pull stage-timing split for the torrent_summary rollup:
+        # plan (metainfo fetch + delta prefill) and dial (handshake)
+        # walls are written in by the scheduler; piece_wait accumulates
+        # request->payload gaps here; verify/write walls live on the
+        # Torrent (storage.py). Stages overlap under pipelining -- they
+        # are cumulative stage costs, not a partition of the wall.
+        self.stage_walls: dict[str, float] = {"plan": 0.0, "dial": 0.0}
+        self._stage_piece_wait = 0.0
+        self._req_ts: dict[int, float] = {}
+        # Sampler plane attribution over this torrent's life: the delta
+        # of the profiler's CUMULATIVE plane counters between creation
+        # and completion rides the summary, so one JSONL line answers
+        # "where did THIS pull's CPU go" (utils/profiler.py tags). The
+        # cumulative counter, not the ring: the ring rotates windows
+        # out, and a baseline against it goes negative on any node up
+        # longer than the ring span.
+        from kraken_tpu.utils.profiler import PROFILER
+
+        self._plane0 = (
+            PROFILER.plane_cumulative() if PROFILER.running else None
+        )
         if torrent.complete():
             self.done.set_result(None)
 
@@ -381,6 +402,11 @@ class Dispatcher:
         data = msg.payload  # bytes or a pooled memoryview -- both flow
         # through verify and os.pwrite untouched; the buffer returns via
         # _spawn_payload's done-callback AFTER the bitfield mark below.
+        t_req = self._req_ts.pop(idx, None)
+        if t_req is not None:
+            self._stage_piece_wait += (
+                asyncio.get_running_loop().time() - t_req
+            )
         self.events.emit(
             "receive_piece", self.torrent.info_hash.hex,
             peer=peer.conn.peer_id.hex, piece=idx, size=len(data),
@@ -433,6 +459,8 @@ class Dispatcher:
                     bytes_up=self._bytes_up,
                     duration_s=round(now - self._created, 3),
                     blacklist_events=self._blacklist_events,
+                    stages=self._stage_split(),
+                    plane_split=self._plane_split(),
                 )
             for other in list(self._peers.values()):
                 try:
@@ -441,6 +469,32 @@ class Dispatcher:
                     pass
         else:
             await self._request_more(peer)
+
+    def _stage_split(self) -> dict:
+        """The per-pull stage walls (seconds): plan/dial from the
+        scheduler, piece-wait from the request->payload gaps here,
+        verify/write from the torrent's accumulators."""
+        return {
+            "plan_s": round(self.stage_walls.get("plan", 0.0), 3),
+            "dial_s": round(self.stage_walls.get("dial", 0.0), 3),
+            "piece_wait_s": round(self._stage_piece_wait, 3),
+            "verify_s": round(getattr(self.torrent, "verify_wall", 0.0), 3),
+            "write_s": round(getattr(self.torrent, "write_wall", 0.0), 3),
+        }
+
+    def _plane_split(self) -> dict:
+        """Sampler plane-tag delta over this torrent's life (sample
+        counts per plane; {} when the profiler is off)."""
+        if self._plane0 is None:
+            return {}
+        from kraken_tpu.utils.profiler import PROFILER
+
+        now = PROFILER.plane_cumulative()
+        return {
+            k: v - self._plane0.get(k, 0)
+            for k, v in now.items()
+            if v - self._plane0.get(k, 0) > 0
+        }
 
     async def _request_more(self, peer: _Peer) -> None:
         if self.torrent.complete():
@@ -470,7 +524,11 @@ class Dispatcher:
         with cm as sp:
             if sp is not None:
                 tp = sp.traceparent  # serve spans nest under this batch
+            now = asyncio.get_running_loop().time()
             for idx in chosen:
+                # First request wins the timestamp: a timeout re-request
+                # must not reset the piece's wait clock.
+                self._req_ts.setdefault(idx, now)
                 self.events.emit(
                     "request_piece", self.torrent.info_hash.hex,
                     peer=peer.conn.peer_id.hex, piece=idx,

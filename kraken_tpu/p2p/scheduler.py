@@ -358,6 +358,7 @@ class Scheduler:
         with trace.span(
             "p2p.download", digest=d.hex[:12], namespace=namespace,
         ) as sp:
+            plan_t0 = asyncio.get_running_loop().time()
             metainfo = await self.metainfo_client.get(namespace, d)
             if (
                 self._delta is not None
@@ -374,7 +375,12 @@ class Scheduler:
                         "delta prefill failed; full swarm pull",
                         extra={"digest": d.hex}, exc_info=True,
                     )
+            plan_wall = asyncio.get_running_loop().time() - plan_t0
             ctl = self._get_or_create_control(metainfo, namespace)
+            # Stage split for the torrent_summary rollup: "plan" is
+            # everything before the swarm could move a byte (metainfo
+            # fetch + delta prefill).
+            ctl.dispatcher.stage_walls["plan"] += plan_wall
             if sp is not None and ctl.trace_parent is None:
                 ctl.trace_parent = trace.ParentContext(
                     sp.trace_id, sp.span_id, sp.sampled
@@ -565,6 +571,18 @@ class Scheduler:
         ctl.spawn(self._dial(ctl, peer))
 
     async def _dial(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
+        # Stage split: "dial" is the connect+handshake wall, successful
+        # or not -- a pull that spends its life redialing soft-busy
+        # seeders shows it here, not as mystery wall time.
+        t0 = asyncio.get_running_loop().time()
+        try:
+            await self._dial_inner(ctl, peer)
+        finally:
+            ctl.dispatcher.stage_walls["dial"] += (
+                asyncio.get_running_loop().time() - t0
+            )
+
+    async def _dial_inner(self, ctl: _TorrentControl, peer: PeerInfo) -> None:
         h = ctl.torrent.info_hash
         # The dial span ADOPTS the conn: _adopt runs inside it, so the
         # conn's pumps (and every io task they spawn) inherit this

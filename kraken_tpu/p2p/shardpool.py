@@ -530,6 +530,7 @@ class _WorkerState:
         })
         self._stats_dirty = False
         self._ship_spans()
+        self._ship_profile()
 
     _SPAN_BUF_MAX = 2048  # drop-oldest bound on the shipping buffer
     _SPAN_BATCH = 64  # spans per SEQPACKET message (size-bounded frames)
@@ -548,6 +549,24 @@ class _WorkerState:
             del self._span_buf[: self._SPAN_BATCH]
             self._send({"t": "spans", "spans": batch})
 
+    def _ship_profile(self) -> None:
+        """Drain this shard's folded-stack delta home (utils/profiler.py
+        restarted its sampler post-fork): the parent adopts it under our
+        node stamp so /debug/pprof/profile -- and a `kraken-tpu flame`
+        collapse -- covers the whole node, shards included. Batched at
+        the span-shipping size: one SEQPACKET datagram of hundreds of
+        deep stacks would exceed the control socket's send buffer (and
+        the parent's recv bound), losing the already-drained samples."""
+        from kraken_tpu.utils import profiler
+
+        while True:
+            batch = profiler.PROFILER.drain_pending(
+                max_stacks=self._SPAN_BATCH
+            )
+            if batch is None:
+                return
+            self._send({"t": "prof", **batch})
+
     async def run(self) -> None:
         loop = asyncio.get_running_loop()
         self.ctrl.setblocking(False)
@@ -562,6 +581,13 @@ class _WorkerState:
             if trace.TRACER.node else f"shard{self.shard}"
         )
         trace.TRACER.on_record = self._on_span
+        # Same story for the sampling profiler: the fork inherited its
+        # config but killed its thread (and may have inherited mid-held
+        # locks) -- restart clean with the shard's node stamp and ship
+        # mode on, so this process's stacks ride the stats tick home.
+        from kraken_tpu.utils import profiler
+
+        profiler.PROFILER.restart_in_child(trace.TRACER.node)
         self._send({"t": "ready", "pid": os.getpid()})
         try:
             while not self._stop_evt.is_set():
@@ -903,6 +929,17 @@ class ShardPool:
             # /debug/trace and flight-recorder dumps hold the WHOLE
             # data plane, forked halves included.
             trace.TRACER.record_foreign(msg.get("spans") or [])
+        elif t == "prof":
+            # Folded-stack deltas from the shard's own sampler: one
+            # /debug/pprof/profile (and one flame collapse) covers the
+            # main loop AND the forked serve plane.
+            from kraken_tpu.utils import profiler
+
+            profiler.PROFILER.record_foreign(
+                str(msg.get("node") or w.label),
+                msg.get("stacks") or [],
+                msg.get("planes") or {},
+            )
         elif t == "ready":
             pass
 

@@ -18,6 +18,7 @@ import asyncio
 import logging
 import os
 import threading
+import time as _time
 from typing import Optional
 
 from kraken_tpu.core.digest import Digest
@@ -195,6 +196,13 @@ class Torrent:
         # (bits are set only after their piece's data write returns).
         self._bits_dirty = False
         self._bits_flusher: Optional[asyncio.Task] = None
+        # Cumulative per-piece stage walls for the torrent_summary
+        # stage split (dispatch.py): how long this torrent's pieces
+        # spent parked on verify vs the data write. Pieces pipeline, so
+        # these OVERLAP each other and the wire wait -- they sum past
+        # the pull's wall clock; they are stage COSTS, not a timeline.
+        self.verify_wall = 0.0
+        self.write_wall = 0.0
 
     BITS_FLUSH_SECONDS = 0.2
 
@@ -362,8 +370,10 @@ class Torrent:
                 f"piece {i}: wrong length {len(data)} != "
                 f"{self.metainfo.piece_length_of(i)}"
             )
+        t0 = _time.perf_counter()
         if not await self._verifier.verify(data, self.metainfo.piece_hash(i)):
             raise PieceError(f"piece {i}: digest mismatch")
+        self.verify_wall += _time.perf_counter() - t0
         if self._status is None or self._status.has(i):
             return False  # duplicate arrival (endgame copies are benign)
         # The data write runs OUTSIDE the lock: pieces occupy disjoint
@@ -373,7 +383,9 @@ class Torrent:
         # rewrites identical bytes -- benign. Completion cannot race this
         # write: it requires every bit set, and piece i's bit is only set
         # below, after this write returns.
+        t0 = _time.perf_counter()
         await asyncio.to_thread(self._write_at, i, data)
+        self.write_wall += _time.perf_counter() - t0
         async with self._lock:
             # Re-check under the lock: a concurrent writer of the same
             # final piece may have completed the torrent (set _status to

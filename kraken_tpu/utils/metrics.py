@@ -451,11 +451,15 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
         # WITHOUT restarting it. Text, greppable, no state mutated.
         import asyncio
         import sys
+        import threading
         import traceback
 
+        from kraken_tpu.utils.resources import task_census
+
+        names = {t.ident: t.name for t in threading.enumerate()}
         out = []
         for tid, frame in sys._current_frames().items():
-            out.append(f"=== thread {tid} ===")
+            out.append(f"=== thread {tid} ({names.get(tid, '?')}) ===")
             out.extend(
                 ln.rstrip() for ln in traceback.format_stack(frame)
             )
@@ -463,6 +467,14 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
             tasks = asyncio.all_tasks()
         except RuntimeError:
             tasks = set()
+        # The census first: "what is this process doing right now" is
+        # usually answered by WHICH coroutines dominate, not by reading
+        # 8000 individual task stacks. Creation-site tagging from
+        # utils/resources.py -- the same sites the sentinel budgets.
+        total, top = task_census(top_n=16)
+        out.append(f"=== asyncio task census: {total} live ===")
+        for site, count in sorted(top.items(), key=lambda kv: -kv[1]):
+            out.append(f"  {count:6d}  {site}")
         out.append(f"=== asyncio tasks: {len(tasks)} ===")
         for t in sorted(tasks, key=lambda t: t.get_name()):
             out.append(f"--- {t.get_name()} done={t.done()} ---")
@@ -544,6 +556,54 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
                 _profile_lock.release()
         return web.json_response({"trace_dir": out_dir, "seconds": seconds})
 
+    async def pprof_profile_endpoint(request):
+        # The always-on sampling profiler's ring (utils/profiler.py):
+        # folded stacks over the last hz x window x keep seconds,
+        # worker-shard samples included. Default is the flamegraph
+        # collapse ("thread;frames... count" -- `curl > x.folded` feeds
+        # any flamegraph tool); ?format=json adds plane split, windows,
+        # and per-source sample counts.
+        from kraken_tpu.utils.profiler import PROFILER
+
+        if request.query.get("format") == "json":
+            return web.json_response(PROFILER.snapshot())
+        lines = [f"{stack} {count}" for stack, count in PROFILER.folded()]
+        return web.Response(
+            text="\n".join(lines) + ("\n" if lines else ""),
+            content_type="text/plain",
+        )
+
+    async def pprof_heap_endpoint(request):
+        # On-demand tracemalloc diff (utils/profiler.py HeapProfiler):
+        # first GET starts tracing + baselines, later GETs report the
+        # top-N growth sites since; ?reset=1 re-baselines after the
+        # diff, ?stop=1 turns tracing back off (it costs real memory).
+        import asyncio
+
+        from kraken_tpu.utils.profiler import HEAP, PROFILER
+
+        if request.query.get("stop") == "1":
+            return web.json_response(HEAP.stop())
+        try:
+            top = max(1, min(100, int(
+                request.query.get("top", PROFILER.config.heap_top)
+            )))
+        except ValueError:
+            return web.Response(status=400, text="malformed top")
+        # take_snapshot walks every traced block -- off the loop.
+        doc = await asyncio.to_thread(HEAP.diff, top)
+        if request.query.get("reset") == "1":
+            await asyncio.to_thread(HEAP.baseline)
+        return web.json_response(doc)
+
+    async def pprof_looplag_endpoint(request):
+        # Every live loop-lag monitor's percentile view + last stall
+        # blame (utils/profiler.py LoopLagMonitor; the histogram
+        # loop_lag_seconds is the /metrics counterpart).
+        from kraken_tpu.utils.profiler import looplag_snapshot
+
+        return web.json_response(looplag_snapshot())
+
     async def resources_endpoint(request):
         # "What is this process holding": fds, RSS, task census by
         # creation site, bufpool leases, conns, store debris -- plus
@@ -616,6 +676,9 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
     app.router.add_get("/debug/healthcheck", healthcheck_endpoint)
     app.router.add_get("/debug/resources", resources_endpoint)
     app.router.add_get("/debug/stacks", stacks_endpoint)
+    app.router.add_get("/debug/pprof/profile", pprof_profile_endpoint)
+    app.router.add_get("/debug/pprof/heap", pprof_heap_endpoint)
+    app.router.add_get("/debug/pprof/looplag", pprof_looplag_endpoint)
     app.router.add_get("/debug/jax-profile", jax_profile_endpoint)
     app.router.add_get("/debug/failpoints", failpoints_get)
     app.router.add_post("/debug/failpoints", failpoints_post)

@@ -233,6 +233,14 @@ class ResourcesConfig:
     max_bufpool_leased: int = 0
     max_conns: int = 0
     max_orphans: int = 0
+    # Event-loop responsiveness budget: breach when the loop-lag
+    # monitor's recent p99 (utils/profiler.py LoopLagMonitor, fed via
+    # the sentinel's ``loop_lag_probe``) exceeds this many seconds.
+    # A wedged loop is a resource exhaustion like any other -- the node
+    # still answers /health (aiohttp keeps limping) while every piece
+    # serve and announce rots in the queue; with ``drain_on_breach``
+    # the node sheds itself before the swarm blacklists it.
+    loop_lag_p99_seconds: float = 0.0
     breach_streak: int = 3
     drain_on_breach: bool = False
     top_tasks: int = 8
@@ -261,6 +269,7 @@ _BUDGETS = (
     ("bufpool_leased", "max_bufpool_leased", "bufpool_leased"),
     ("conns", "max_conns", "conns"),
     ("orphans", "max_orphans", "orphans_total"),
+    ("loop_lag", "loop_lag_p99_seconds", "loop_lag_p99"),
 )
 
 
@@ -279,6 +288,7 @@ class ResourceSentinel:
         store=None,
         upload_ttl_seconds: float = 6 * 3600,
         on_sustained_breach=None,
+        loop_lag_probe=None,
     ):
         self.component = component
         self.config = (
@@ -289,6 +299,9 @@ class ResourceSentinel:
         self.store = store
         self.upload_ttl_seconds = upload_ttl_seconds
         self.on_sustained_breach = on_sustained_breach
+        # () -> recent loop-lag p99 seconds or None (assembly wires the
+        # node's LoopLagMonitor.p99 in); gates the "loop_lag" budget.
+        self.loop_lag_probe = loop_lag_probe
         self.last_sample: dict | None = None
         # (monotonic_ts, open_fds, rss_bytes) history -- the soak
         # harness's least-squares input. Bounded: a week at 30 s/sample.
@@ -412,9 +425,16 @@ class ResourceSentinel:
             fds += worker_fds
         if rss is not None:
             rss += worker_rss
+        loop_lag_p99 = None
+        if self.loop_lag_probe is not None:
+            try:
+                loop_lag_p99 = self.loop_lag_probe()
+            except Exception:  # the probe must never fail the sample
+                loop_lag_p99 = None
         sample = {
             "component": self.component,
             "ts": time.time(),
+            "loop_lag_p99": loop_lag_p99,
             "open_fds": fds,
             "rss_bytes": rss,
             "rss_mb": (rss / (1 << 20)) if rss is not None else None,

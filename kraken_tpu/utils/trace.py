@@ -315,6 +315,13 @@ class Tracer:
         # control channel (the recorder alone would strand them in the
         # child process). Must never raise into finish().
         self.on_record = None
+        # Hook fired on every dump trigger (breaker trip, deadline,
+        # resource breach, lameduck): the continuous-profiling plane
+        # (utils/profiler.py) registers its trigger_capture here so
+        # every postmortem carries STACKS beside spans. Fired before
+        # the dump_dir gate -- the profiler throttles and gates on its
+        # own dir. Must never raise.
+        self.on_trigger = None
         self._rng = random.Random()
         self._dump_lock = threading.Lock()
         self._last_dump: dict[str, float] = {}
@@ -425,6 +432,12 @@ class Tracer:
             "trace_dump_triggers_total",
             "Degradation events that asked for a flight-recorder dump",
         ).inc(trigger=trigger)
+        hook = self.on_trigger
+        if hook is not None:
+            try:
+                hook(trigger, detail)
+            except Exception:
+                pass  # a profile-capture failure must not mute the dump
         if not cfg.dump_dir:
             return None
         now = time.monotonic()

@@ -27,6 +27,7 @@ CLI_KEYS = {
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
+    "profiling",
 }
 
 
@@ -215,6 +216,37 @@ def test_delta_sections_construct_delta_config():
         assert 0.0 <= cfg.min_piece_cover <= 1.0, path
         seen += 1
     assert seen >= 2  # agent + origin register the delta knobs
+
+
+def test_profiling_sections_construct_profiler_config():
+    """Every shipped `profiling:` section must map onto ProfilerConfig
+    through the same from_dict the CLI/assembly use -- a typo'd knob
+    must fail here, not at production boot. The shipped sample rate
+    must stay LOW: the profiler-on overhead band in
+    test_data_plane_band.py is measured at the shipped hz, and a config
+    refresh that ships 250 Hz would tax every process fleet-wide."""
+    from kraken_tpu.utils.profiler import ProfilerConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        pc = load_config(path).get("profiling")
+        if not pc:
+            continue
+        cfg = ProfilerConfig.from_dict(pc)  # raises on unknown keys
+        assert cfg.enabled is True, path
+        assert 0.0 < cfg.hz <= 50.0, (
+            f"{path}: shipped profiling.hz must stay sampled-down"
+            " (the overhead band is measured at the shipped rate)"
+        )
+        assert cfg.window_seconds > 0 and cfg.keep_windows >= 2, path
+        assert cfg.loop_lag_interval_seconds > 0, path
+        assert cfg.loop_lag_threshold_seconds > 0, path
+        assert cfg.dump_min_interval_seconds > 0, path
+        # dump_dir ships unset: assembly defaults it beside the trace
+        # dumps under the node's store root; trackers stay file-free.
+        assert cfg.dump_dir == "", path
+        seen += 1
+    assert seen >= 3  # agent + origin + tracker ship the profiling knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -154,3 +154,53 @@ def test_trace_on_overhead_band():
         f"{[f'{r:.3f}' for r in ratios]} all > 1.05: tracing leaked "
         "into the unsampled data path -- see this test's docstring"
     )
+
+
+def test_profiler_on_overhead_band():
+    """The continuous-profiling plane at SHIPPED rate (base.yaml
+    profiling.hz, pinned sampled-down by test_config_tree) must cost
+    <= 5% pair goodput. Same estimator as the trace band above: MIN OF
+    PAIRWISE off/on ratios over interleaved rounds, so the two legs of
+    each ratio share a rig phase and the shared-core drift cancels. The
+    sampler's entire cost is one ``sys._current_frames()`` walk + a few
+    dict increments per tick, OFF the event loop -- a min pairwise
+    ratio past 1.05 means per-sample work grew (stack depth, plane
+    rules, lock hold) or something leaked onto the data path; look at
+    utils/profiler.py _sample_once before re-pinning."""
+    import asyncio
+    import tempfile
+
+    from bench_pair import run_pair
+    from kraken_tpu.configutil import load_config
+    from kraken_tpu.utils.profiler import PROFILER, ProfilerConfig
+
+    shipped = ProfilerConfig.from_dict(
+        load_config(str(pathlib.Path(__file__).parent.parent
+                        / "config" / "agent" / "base.yaml")).get("profiling")
+    )
+    cfg0 = PROFILER.config
+
+    def wall_once() -> float:
+        with tempfile.TemporaryDirectory() as root:
+            r = asyncio.run(run_pair(64, 256, root))
+            return r["wall_s"]
+
+    ratios: list[float] = []
+    try:
+        PROFILER.apply(ProfilerConfig(enabled=False))
+        wall_once()  # warmup: imports, allocator, page cache
+        for _ in range(4):
+            PROFILER.apply(ProfilerConfig(enabled=False))
+            off = wall_once()
+            PROFILER.apply(shipped)
+            on = wall_once()
+            ratios.append(on / off)
+    finally:
+        PROFILER.apply(cfg0)
+        PROFILER.reset()
+
+    assert min(ratios) <= 1.05, (
+        "profiler-on/off pairwise wall ratios "
+        f"{[f'{r:.3f}' for r in ratios]} all > 1.05: the sampler got "
+        "expensive -- see this test's docstring"
+    )

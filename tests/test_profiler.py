@@ -1,0 +1,622 @@
+"""Continuous profiling plane (utils/profiler.py).
+
+What must hold, per docs/OPERATIONS.md "Continuous profiling":
+
+- the sampler attributes a known busy function correctly (folded-stack
+  form, plane tags), starts/stops idempotently, and live-reloads;
+- the loop-lag monitor observes a deliberately blocking callback on
+  the ``loop_lag_seconds`` histogram AND names the blocking frame in
+  its structured WARN (the sampler's concurrent main-thread stack);
+- the heap differ reports the allocation site that actually grew;
+- worker-shard samples ship home over the shardpool control channel
+  through a REAL 2-worker pull, so one /debug/pprof/profile covers
+  main loop plus forked shards;
+- the flight-recorder triggers (breaker trip et al.) capture a profile
+  window beside the trace dump;
+- `kraken-tpu flame` folds dumps and exits non-zero on unparseable or
+  truncated files (the CI gate);
+- the resource sentinel's `loop_lag` budget kind breaches on a bad p99;
+- torrent_summary carries the per-pull stage split.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+from kraken_tpu.utils import trace
+from kraken_tpu.utils.metrics import REGISTRY
+from kraken_tpu.utils.profiler import (
+    HEAP,
+    PROFILER,
+    LoopLagMonitor,
+    ProfilerConfig,
+    SamplingProfiler,
+    classify_plane,
+    load_profile_dumps,
+)
+
+NS = "library/profiler-test"
+
+
+@pytest.fixture(autouse=True)
+def _profiler_isolation():
+    """The PROFILER is process-global (like the TRACER): snapshot its
+    config/node, reset samples around every test, and restore after so
+    rates chosen here never leak into other suites."""
+    cfg0, node0 = PROFILER.config, PROFILER.node
+    hook0 = trace.TRACER.on_trigger
+    PROFILER.reset()
+    PROFILER._last_dump.clear()
+    yield
+    PROFILER.node = node0
+    PROFILER.apply(cfg0)
+    trace.TRACER.on_trigger = hook0
+    PROFILER.reset()
+    PROFILER._last_dump.clear()
+
+
+# -- config -----------------------------------------------------------------
+
+def test_profiler_config_rejects_unknown_keys_and_bad_rates():
+    with pytest.raises(ValueError):
+        ProfilerConfig.from_dict({"herz": 10})
+    with pytest.raises(ValueError):
+        ProfilerConfig.from_dict({"hz": 0})
+    with pytest.raises(ValueError):
+        ProfilerConfig.from_dict({"hz": 1000})
+    with pytest.raises(ValueError):
+        ProfilerConfig.from_dict({"loop_lag_interval_seconds": 0})
+    cfg = ProfilerConfig.from_dict({"hz": 97, "enabled": True})
+    assert cfg.hz == 97
+
+
+# -- plane tagging ----------------------------------------------------------
+
+def test_plane_classification_rules():
+    assert classify_plane(["conn.py:_recv_loop", "wire.py:recv_message"]) \
+        == "pump"
+    assert classify_plane(
+        ["dispatch.py:_on_payload", "storage.py:write_piece",
+         "storage.py:_write_at"]
+    ) == "pwrite"
+    assert classify_plane(["hasher.py:hash_batch"]) == "verify"
+    assert classify_plane(["shardpool.py:_serve_piece_inner"]) == "serve"
+    assert classify_plane(["scheduler.py:_announce_once"]) == "dispatch"
+    # The leaf decides idleness even when a plane frame sits above it.
+    assert classify_plane(
+        ["base_events.py:_run_once", "selectors.py:select"]
+    ) == "idle"
+    assert classify_plane(["mymodule.py:work"]) == "other"
+
+
+# -- the sampler ------------------------------------------------------------
+
+def _burn_the_cpu(stop: threading.Event) -> None:
+    x = 0
+    while not stop.is_set():
+        x = (x * 31 + 7) % 1000003
+
+
+def test_sampler_folds_a_known_busy_function():
+    prof = SamplingProfiler(ProfilerConfig(hz=200, window_seconds=5.0))
+    stop = threading.Event()
+    t = threading.Thread(target=_burn_the_cpu, args=(stop,),
+                         name="burner", daemon=True)
+    t.start()
+    prof.start()
+    try:
+        time.sleep(0.5)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(1.0)
+    folded = prof.folded()
+    assert folded, "sampler collected nothing"
+    burner = [
+        (s, c) for s, c in folded
+        if s.startswith("burner;") and "_burn_the_cpu" in s
+    ]
+    assert burner, f"busy function never sampled: {folded[:5]}"
+    # ~100 expected at 200 Hz over 0.5 s; anything >= 20 proves the
+    # attribution (shared-core rigs starve the sampler thread).
+    assert sum(c for _s, c in burner) >= 20
+    # Folded form: thread;root;...;leaf with file:func frames.
+    stack = burner[0][0]
+    assert ";" in stack and ":" in stack.split(";", 1)[1]
+
+
+def test_sampler_start_stop_idempotent_and_live_reload():
+    prof = SamplingProfiler(ProfilerConfig(hz=50))
+    assert not prof.running
+    prof.start()
+    prof.start()  # idempotent
+    assert prof.running
+    thread0 = prof._thread
+    # Live reload to a new rate restarts the thread; disabling stops it.
+    prof.apply(ProfilerConfig(hz=100))
+    assert prof.running and prof._thread is not thread0
+    prof.apply(ProfilerConfig(enabled=False))
+    assert not prof.running
+    prof.apply(ProfilerConfig(hz=100))
+    assert prof.running
+    prof.stop()
+    prof.stop()  # idempotent
+    assert not prof.running
+
+
+def test_node_reload_applies_profiling_section(tmp_path):
+    """SIGHUP path: AgentNode.reload({'profiling': ...}) swaps the
+    process-global sampler's rate and the loop-lag knobs live."""
+    from kraken_tpu.assembly import AgentNode
+
+    async def run():
+        agent = AgentNode(
+            store_root=str(tmp_path / "a"), tracker_addr="",
+            profiling={"hz": 31},
+        )
+        await agent.start()
+        try:
+            assert PROFILER.running and PROFILER.config.hz == 31
+            assert agent.loop_monitor is not None
+            agent.reload({"profiling": {
+                "hz": 59, "loop_lag_threshold_seconds": 0.9,
+            }})
+            assert PROFILER.config.hz == 59
+            assert agent.loop_monitor.config.loop_lag_threshold_seconds \
+                == 0.9
+            # dump_dir defaulted beside the trace dumps.
+            assert agent.profiling_config.dump_dir.endswith("traces")
+            # Disabling live stops BOTH halves (sampler + heartbeat)
+            # and unhooks the sentinel's loop_lag probe; re-enabling
+            # brings them all back -- the toggle must govern the whole
+            # plane, not just the sampler thread.
+            agent.reload({"profiling": {"enabled": False}})
+            assert not PROFILER.running
+            assert agent.loop_monitor is None
+            assert agent.sentinel.loop_lag_probe is None
+            agent.reload({"profiling": {"hz": 41}})
+            assert PROFILER.running and PROFILER.config.hz == 41
+            assert agent.loop_monitor is not None
+            assert agent.sentinel.loop_lag_probe is not None
+        finally:
+            await agent.stop()
+
+    asyncio.run(run())
+
+
+def test_plane_cumulative_survives_window_rotation():
+    """Regression: the per-pull plane_split baselines against the
+    CUMULATIVE plane counter, not the ring -- the ring rotates windows
+    out, so a ring-based delta goes negative/empty on any process up
+    longer than the ring span. With a tiny ring, the cumulative count
+    must keep every sample the ring already dropped."""
+    prof = SamplingProfiler(ProfilerConfig(
+        hz=200, window_seconds=0.05, keep_windows=2,
+    ))
+    stop = threading.Event()
+    t = threading.Thread(target=_burn_the_cpu, args=(stop,), daemon=True)
+    t.start()
+    prof.start()
+    try:
+        time.sleep(0.6)
+        cum_mid = sum(prof.plane_cumulative().values())
+        time.sleep(0.2)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join(1.0)
+    ring = sum(prof.plane_totals().values())
+    cum = sum(prof.plane_cumulative().values())
+    assert cum >= cum_mid  # monotonic
+    # ~0.8 s of samples vs a <=0.1 s ring: rotation dropped most of
+    # the ring, the cumulative counter kept everything.
+    assert cum > ring, (cum, ring)
+
+
+# -- loop lag ---------------------------------------------------------------
+
+def _block_the_loop_for(seconds: float) -> None:
+    time.sleep(seconds)  # deliberately synchronous: the stall under test
+
+
+def test_loop_lag_detects_blocking_callback(caplog):
+    async def run():
+        cfg = ProfilerConfig(
+            hz=200,
+            loop_lag_interval_seconds=0.05,
+            loop_lag_threshold_seconds=0.2,
+        )
+        PROFILER.apply(cfg)
+        mon = LoopLagMonitor("lag-test", cfg)
+        mon.start()
+        try:
+            await asyncio.sleep(0.2)  # a few healthy ticks
+            _block_the_loop_for(0.5)
+            await asyncio.sleep(0.2)  # let the stalled tick land
+        finally:
+            mon.stop()
+        return mon
+
+    stalls0 = REGISTRY.counter("loop_lag_stalls_total").value(
+        component="lag-test"
+    )
+    with caplog.at_level(logging.WARNING, logger="kraken.profiler"):
+        mon = asyncio.run(run())
+    snap = mon.snapshot()
+    assert snap["stalls"] >= 1, snap
+    assert snap["max_s"] >= 0.3, snap
+    assert REGISTRY.counter("loop_lag_stalls_total").value(
+        component="lag-test"
+    ) > stalls0
+    assert REGISTRY.histogram("loop_lag_seconds").count(
+        component="lag-test"
+    ) >= 3
+    # The WARN names the blocking frame: the sampler caught the main
+    # thread inside the synchronous block.
+    warns = [r for r in caplog.records if "event loop stalled" in r.msg]
+    assert warns, "no stall WARN logged"
+    blame = getattr(warns[-1], "blame", "")
+    assert "_block_the_loop_for" in blame, blame
+    assert "_block_the_loop_for" in (snap["last_blame"] or "")
+
+
+def test_loop_lag_p99_feeds_the_sentinel_budget():
+    """Satellite: `resources: loop_lag_p99_seconds` is a budget kind --
+    a wedged loop breaches as kind="loop_lag" and respects the same
+    sustained-breach drain latch as every other budget."""
+    from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
+
+    fired: list[list[str]] = []
+
+    async def run():
+        sentinel = ResourceSentinel(
+            "lagbudget",
+            ResourcesConfig(
+                loop_lag_p99_seconds=0.05, breach_streak=2,
+                drain_on_breach=True,
+            ),
+            loop_lag_probe=lambda: 0.4,
+            on_sustained_breach=fired.append,
+        )
+        try:
+            s1 = await sentinel.sample()
+            s2 = await sentinel.sample()
+            s3 = await sentinel.sample()
+        finally:
+            sentinel.stop()
+        return s1, s2, s3
+
+    c = REGISTRY.counter("resource_budget_breaches_total")
+    before = c.value(kind="loop_lag")
+    s1, s2, s3 = asyncio.run(run())
+    assert "loop_lag" in s1["breached"]
+    assert s1["loop_lag_p99"] == 0.4
+    assert c.value(kind="loop_lag") >= before + 3
+    # Latched: the sustained hook fired once, not per sample.
+    assert fired == [["loop_lag"]]
+
+    async def healthy():
+        sentinel = ResourceSentinel(
+            "lagbudget2",
+            ResourcesConfig(loop_lag_p99_seconds=0.05),
+            loop_lag_probe=lambda: 0.001,
+        )
+        try:
+            return await sentinel.sample()
+        finally:
+            sentinel.stop()
+
+    assert "loop_lag" not in asyncio.run(healthy())["breached"]
+
+
+# -- heap diff --------------------------------------------------------------
+
+def test_heap_diff_reports_the_growing_site():
+    HEAP.stop()
+    try:
+        assert HEAP.diff()["status"] == "baseline"  # first call baselines
+        hoard = [bytes(1024) for _ in range(3000)]  # ~3 MB at THIS line
+        doc = HEAP.diff(top_n=5)
+        assert doc["status"] == "diff"
+        assert doc["traced_current_bytes"] > 0
+        top = doc["top"]
+        assert top, "no growth sites reported"
+        assert any("test_profiler.py" in row["site"] for row in top), top
+        assert top[0]["size_diff_bytes"] > 1 << 20
+        del hoard
+    finally:
+        HEAP.stop()
+    import tracemalloc
+
+    assert not tracemalloc.is_tracing()
+
+
+# -- dumps + the flame CLI --------------------------------------------------
+
+def _sampled_profiler(tmp_path) -> None:
+    """Point the global profiler at a dump dir and give it samples."""
+    PROFILER.apply(ProfilerConfig(hz=200, dump_dir=str(tmp_path)))
+    PROFILER.node = "testnode"
+    time.sleep(0.15)
+
+
+def test_dump_and_flame_roundtrip(tmp_path, capsys):
+    from kraken_tpu.cli import run_flame_tool
+
+    _sampled_profiler(tmp_path)
+    PROFILER.record_foreign(
+        "testnode/shard0",
+        [["MainThread;shardpool.py:_serve_piece_inner", 7]],
+        {"serve": 7},
+    )
+    path = PROFILER.dump("manual", "roundtrip")
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        header = json.loads(f.readline())
+        body = [json.loads(ln) for ln in f]
+    assert header["profile"] == "manual"
+    assert header["stacks"] == len(body)
+    assert any(row["node"] == "testnode/shard0" for row in body)
+
+    assert run_flame_tool([path]) == 0
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    done = json.loads(lines[-1])
+    assert done["event"] == "flame_done" and done["errors"] == 0
+    assert done["stacks"] == header["stacks"]
+    # The collapse carries node-stamped folded stacks, shards included.
+    assert any(ln.startswith("testnode/shard0;") for ln in lines[:-1])
+    assert "serve" in done["planes"]
+
+
+def test_flame_gates_on_truncated_and_garbage_files(tmp_path, capsys):
+    from kraken_tpu.cli import run_flame_tool
+
+    _sampled_profiler(tmp_path)
+    path = PROFILER.dump("manual")
+    assert path is not None
+
+    # Truncated: drop the last stack line the header promised.
+    truncated = str(tmp_path / "truncated.jsonl")
+    with open(path) as f:
+        lines = f.readlines()
+    with open(truncated, "w") as f:
+        f.writelines(lines[:-1])
+    assert run_flame_tool([truncated]) == 1
+    out = capsys.readouterr().out
+    assert "truncated" in out
+
+    # Unparseable line inside an otherwise-valid dump: exit 1, not crash.
+    garbled = str(tmp_path / "garbled.jsonl")
+    with open(garbled, "w") as f:
+        f.write(lines[0])
+        f.write("%%% not json %%%\n")
+        f.writelines(lines[1:])
+    assert run_flame_tool([garbled]) == 1
+    capsys.readouterr()
+
+    # Nothing usable at all (no header): usage-grade exit 3.
+    garbage = str(tmp_path / "garbage.jsonl")
+    with open(garbage, "w") as f:
+        f.write("not a dump\n")
+    assert run_flame_tool([garbage]) == 3
+    assert run_flame_tool([str(tmp_path / "absent.jsonl")]) == 3
+    capsys.readouterr()
+
+    # loader surface: errors name the file.
+    _stacks, _planes, errors = load_profile_dumps([truncated])
+    assert errors and "truncated" in errors[0]
+
+
+def test_breaker_trip_captures_a_profile_window(tmp_path):
+    """The PR-8 flight-recorder triggers now carry STACKS: a breaker
+    trip writes profile-breaker_trip-*.jsonl beside the trace dump,
+    throttled per trigger kind."""
+    from kraken_tpu.placement.healthcheck import PassiveFilter
+
+    dump_dir = str(tmp_path / "traces")
+    trace.TRACER.apply(
+        trace.TraceConfig(sample_rate=1.0, dump_dir=dump_dir)
+    )
+    PROFILER.apply(ProfilerConfig(hz=200, dump_dir=dump_dir))
+    trace.TRACER.on_trigger = PROFILER.trigger_capture
+    time.sleep(0.1)  # give the sampler a window
+    with trace.span("rpc.download", addr="origin9:7610"):
+        pass
+    try:
+        pf = PassiveFilter(fail_threshold=1, name="profiler-test")
+        pf.failed("origin9:7610")
+        files = glob.glob(os.path.join(dump_dir, "profile-breaker_trip-*"))
+        assert len(files) == 1, "breaker trip captured no profile"
+        with open(files[0]) as f:
+            header = json.loads(f.readline())
+        assert header["profile"] == "breaker_trip"
+        assert header["samples"] > 0
+        # Throttled: a second trip inside the floor adds no file.
+        pf2 = PassiveFilter(fail_threshold=1, name="profiler-test-2")
+        pf2.failed("origin9:7610")
+        assert len(
+            glob.glob(os.path.join(dump_dir, "profile-breaker_trip-*"))
+        ) == 1
+    finally:
+        trace.TRACER.apply(trace.TraceConfig())
+        trace.TRACER.recorder.clear()
+        trace.TRACER._last_dump.clear()
+
+
+# -- worker-shard aggregation (a real 2-worker pull) ------------------------
+
+def test_worker_samples_aggregate_through_2worker_pull(tmp_path):
+    """Forked seed-serve shards restart their own sampler and ship
+    folded-stack deltas home over the control channel: after a real
+    pull with data_plane_workers=2, the parent's profile surface holds
+    shard-stamped samples -- one collapse covers the whole node."""
+    from tests.test_shardpool import FakeTracker, _metainfo, make_sched
+
+    import numpy as np
+
+    async def run():
+        PROFILER.apply(ProfilerConfig(hz=97))
+        PROFILER.node = "origin"
+        blob = np.random.default_rng(5).integers(
+            0, 256, size=4 << 20, dtype=np.uint8
+        ).tobytes()
+        mi = _metainfo(blob, 256 << 10)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+        origin, _ostore = make_sched(
+            tmp_path, "origin", tracker, seed_blobs=[blob], workers=2
+        )
+        agent, astore = make_sched(tmp_path, "agent", tracker)
+        await origin.start()
+        try:
+            origin.seed(mi, NS)
+            await agent.start()
+            try:
+                await asyncio.wait_for(agent.download(NS, mi.digest), 60)
+            finally:
+                await agent.stop()
+            with open(astore.cache_path(mi.digest), "rb") as f:
+                assert f.read() == blob
+            # Shards ship on the 0.25 s stats tick; wait for samples to
+            # come home (their idle loop samples too, so this converges
+            # even when the serves themselves were fast).
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if PROFILER.snapshot()["foreign_samples"]:
+                    break
+                await asyncio.sleep(0.1)
+        finally:
+            await origin.stop()
+        return PROFILER.snapshot()
+
+    snap = asyncio.run(run())
+    foreign = snap["foreign_samples"]
+    assert foreign, "no worker-shard samples ever shipped home"
+    assert all("/shard" in node for node in foreign), foreign
+    # The collapse prefixes shard stacks with their node stamp (the
+    # shard suffix is the stable part -- the prefix is whatever node
+    # name this process's tracer carried when the worker forked).
+    assert any(
+        "/shard" in stack.split(";", 1)[0]
+        for stack, _c in PROFILER.folded()
+    )
+
+
+# -- the mux surfaces -------------------------------------------------------
+
+def test_debug_pprof_surfaces_live_on_agent(tmp_path):
+    from kraken_tpu.assembly import AgentNode
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    async def run():
+        agent = AgentNode(
+            store_root=str(tmp_path / "a"), tracker_addr="",
+            profiling={"hz": 97},
+        )
+        await agent.start()
+        http = HTTPClient()
+        try:
+            await asyncio.sleep(0.3)
+            # profile: folded text default, JSON on ?format=json.
+            folded = (await http.get(
+                f"http://{agent.addr}/debug/pprof/profile"
+            )).decode()
+            assert folded.strip(), "empty profile"
+            assert all(
+                ln.rsplit(" ", 1)[1].isdigit()
+                for ln in folded.strip().splitlines()
+            )
+            snap = json.loads(await http.get(
+                f"http://{agent.addr}/debug/pprof/profile?format=json"
+            ))
+            assert snap["running"] and snap["hz"] == 97
+            assert sum(snap["planes"].values()) > 0
+            # heap: baseline then diff, stop releases tracemalloc.
+            assert json.loads(await http.get(
+                f"http://{agent.addr}/debug/pprof/heap"
+            ))["status"] == "baseline"
+            assert json.loads(await http.get(
+                f"http://{agent.addr}/debug/pprof/heap"
+            ))["status"] == "diff"
+            assert json.loads(await http.get(
+                f"http://{agent.addr}/debug/pprof/heap?stop=1"
+            ))["status"] == "stopped"
+            # looplag: this node's monitor reports percentiles.
+            lag = json.loads(await http.get(
+                f"http://{agent.addr}/debug/pprof/looplag"
+            ))
+            mine = [
+                m for m in lag["monitors"].values()
+                if m["component"] == "agent"
+            ]
+            assert mine and mine[0]["ticks"] >= 1
+            # stacks: the satellite census section is in the dump.
+            stacks = (await http.get(
+                f"http://{agent.addr}/debug/stacks"
+            )).decode()
+            assert "asyncio task census" in stacks
+            assert "assembly.py" in stacks or "_cleanup_loop" in stacks \
+                or "LoopLagMonitor" in stacks or "_loop" in stacks
+        finally:
+            await http.close()
+            await agent.stop()
+
+    asyncio.run(run())
+
+
+# -- stage split (satellite) ------------------------------------------------
+
+def test_torrent_summary_carries_stage_split(tmp_path):
+    """The per-pull stage-timing split rides torrent_summary: plan
+    (metainfo fetch) and dial (handshake) from the scheduler, piece
+    wait from request->payload gaps, verify/write walls from the
+    torrent's accumulators. Cumulative stage costs, not a timeline."""
+    from kraken_tpu.p2p.networkevent import Producer
+    from tests.test_shardpool import FakeTracker, _metainfo, make_sched
+
+    async def run():
+        blob = os.urandom(2 << 20)
+        mi = _metainfo(blob, 256 << 10)
+        tracker = FakeTracker()
+        tracker.metainfos[mi.digest.hex] = mi
+        origin, _ostore = make_sched(
+            tmp_path, "origin", tracker, seed_blobs=[blob]
+        )
+        agent, astore = make_sched(tmp_path, "agent", tracker)
+        events = Producer("leecher")
+        agent.events = events
+        await origin.start()
+        try:
+            origin.seed(mi, NS)
+            await agent.start()
+            try:
+                await asyncio.wait_for(agent.download(NS, mi.digest), 30)
+            finally:
+                await agent.stop()
+        finally:
+            await origin.stop()
+        return events.events
+
+    events = asyncio.run(run())
+    summaries = [e for e in events if e["name"] == "torrent_summary"]
+    assert len(summaries) == 1
+    stages = summaries[0]["stages"]
+    assert set(stages) == {
+        "plan_s", "dial_s", "piece_wait_s", "verify_s", "write_s"
+    }
+    # Every piece waited on the wire and went through verify + pwrite.
+    assert stages["piece_wait_s"] > 0
+    assert stages["verify_s"] > 0
+    assert stages["write_s"] >= 0
+    assert stages["dial_s"] > 0
+    assert stages["plan_s"] >= 0
+    assert isinstance(summaries[0]["plane_split"], dict)
