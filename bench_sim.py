@@ -33,6 +33,15 @@ def main():
                          "pull (overrides --pieces)")
     ap.add_argument("--restart-at", type=float, default=0.0)
     ap.add_argument("--restart-frac", type=float, default=0.0)
+    # Tracker HA fleet (round 12): shard announces over N trackers and
+    # optionally kill the blob-0 owners mid-run, with a like-for-like
+    # no-kill control (same seed/config) in the output.
+    ap.add_argument("--trackers", type=int, default=1)
+    ap.add_argument("--tracker-kill-at", type=float, default=0.0)
+    ap.add_argument("--tracker-kill", type=int, default=0)
+    ap.add_argument("--tracker-restart-after", type=float, default=0.0)
+    ap.add_argument("--tracker-down-mode", default="refuse",
+                    choices=["refuse", "blackhole"])
     args = ap.parse_args()
 
     t0 = time.time()
@@ -47,9 +56,25 @@ def main():
             tuple(int(x) for x in args.layers.split(",")) if args.layers
             else None
         ),
+        n_trackers=args.trackers,
+        tracker_down_mode=args.tracker_down_mode,
+        tracker_restart_after_s=args.tracker_restart_after,
     )
     r = run_sim(**kw, restart_at_s=args.restart_at,
-                restart_frac=args.restart_frac)
+                restart_frac=args.restart_frac,
+                tracker_kill_at_s=args.tracker_kill_at,
+                tracker_kill=args.tracker_kill)
+    if args.tracker_kill > 0 and args.tracker_kill_at > 0:
+        # Like-for-like healthy-fleet control (same seed/config, no
+        # kill): "the tracker death cost X of announce p99" is a
+        # measured delta, not a cross-shape comparison.
+        control = run_sim(**kw, restart_at_s=args.restart_at,
+                          restart_frac=args.restart_frac)
+        r["control_no_tracker_kill"] = control
+        if r["announce_p99_s"] is not None and control["announce_p99_s"]:
+            r["tracker_kill_announce_p99_ratio"] = round(
+                r["announce_p99_s"] / control["announce_p99_s"], 3
+            )
     if args.restart_frac > 0 and args.restart_at > 0:
         # Like-for-like control: the SAME seed and config with the wave
         # switched off, so "the restart wave cost X seconds of p99" is a

@@ -57,7 +57,11 @@ from kraken_tpu.store import CAStore
 from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 from kraken_tpu.store.recovery import run_fsck, write_clean_shutdown
 from kraken_tpu.store.scrub import ScrubConfig, Scrubber
-from kraken_tpu.tracker.client import TrackerClient
+from kraken_tpu.tracker.client import (
+    TrackerClient,  # noqa: F401 (re-exported; harnesses construct it)
+    make_tracker_client,
+    parse_tracker_addrs,
+)
 from kraken_tpu.tracker.peerstore import InMemoryPeerStore, RedisPeerStore
 from kraken_tpu.tracker.server import TrackerServer
 
@@ -114,6 +118,32 @@ async def _ring_refresh_loop(get_cluster, interval: float) -> None:
             # Flapping DNS / dead origins must show on /metrics, not
             # vanish into the retry loop.
             _ring_refresh_failures.record("ring refresh", e)
+
+
+def _reload_tracker_addrs(node, spec) -> None:
+    """SIGHUP ``tracker:`` handling shared by agent and origin: a fleet
+    client swaps its membership live (ownership re-shards, ~1/N of
+    swarms move); a single-host client retargets when the new list is
+    still one addr. Growing 1 -> N needs a restart -- the client
+    protocol object is chosen at construction."""
+    client = node._tracker_client
+    if client is None or spec is None:
+        return
+    addrs = parse_tracker_addrs(spec)
+    if not addrs:
+        return
+    node.tracker_addr = ",".join(addrs)
+    if hasattr(client, "set_addrs"):
+        client.set_addrs(addrs)
+        _log.info("tracker fleet addrs reloaded", extra={"addrs": addrs})
+    elif len(addrs) == 1:
+        client.addr = addrs[0]
+        _log.info("tracker addr reloaded", extra={"addr": addrs[0]})
+    else:
+        _log.warning(
+            "tracker list grew from one addr to %d: the single->fleet"
+            " topology change requires a restart", len(addrs),
+        )
 
 
 def _rpc_config(rpc) -> RPCConfig:
@@ -328,6 +358,8 @@ class TrackerNode:
                  peer_ttl_seconds: float = 30.0,
                  ring_refresh_seconds: float = 5.0,
                  redis_addr: str = "",
+                 fleet: str | list[str] | None = None,
+                 self_addr: str = "",
                  ssl_context=None,
                  rpc: dict | RPCConfig | None = None,
                  trace: dict | TraceConfig | None = None,
@@ -335,6 +367,13 @@ class TrackerNode:
         self.host = host
         self.port = port
         self.rpc = _rpc_config(rpc)
+        # Tracker HA fleet (docs/OPERATIONS.md "Tracker fleet"): the
+        # full fleet's addrs + this tracker's own addr as it appears
+        # there. Drives shard ownership and non-owner announce
+        # forwarding; clients shard/fail over on their own copy of the
+        # same list. SIGHUP live-reloads (`fleet:` / `self_addr:`).
+        self.fleet_addrs = parse_tracker_addrs(fleet or [])
+        self.self_addr = self_addr
         # Store-less node: dump_dir stays "" (no file postmortems)
         # unless the YAML sets one explicitly; /debug/trace still works.
         self.trace_config = _trace_config(trace)
@@ -354,6 +393,11 @@ class TrackerNode:
             peer_store=peer_store,
             origin_cluster=origin_cluster,
             announce_interval_seconds=announce_interval_seconds,
+            fleet_addrs=self.fleet_addrs,
+            self_addr=self.self_addr,
+            # Trackers sharing a Redis store already rendezvous there:
+            # non-owner forwarding would only duplicate writes.
+            shared_store=bool(redis_addr),
         )
         self.ring_refresh = ring_refresh_seconds
         self.ssl_context = ssl_context
@@ -379,9 +423,27 @@ class TrackerNode:
         ))
 
     def reload(self, cfg: dict) -> None:
-        """SIGHUP: apply the ``trace:`` and ``rpc:`` sections live (the
-        latter to the metainfo-proxy cluster client -- hedge delay, read
-        deadline, brown-out threshold on its breaker)."""
+        """SIGHUP: apply the ``trace:``, ``fleet:``/``self_addr:``, and
+        ``rpc:`` sections live (the latter to the metainfo-proxy cluster
+        client -- hedge delay, read deadline, brown-out threshold on its
+        breaker)."""
+        # Fleet membership swap: ownership re-shards on the next
+        # announce (add/remove moves ~1/N of the swarms -- the
+        # rendezvous-hash property the rebalance test pins). An EMPTY
+        # parse is skipped, not applied: the shipped base.yaml carries
+        # `fleet: ""`, and a SIGHUP for an unrelated section must not
+        # silently dissolve a fleet configured via --fleet flags
+        # (topology changes need a restart, like the client side).
+        reload_fleet = parse_tracker_addrs(cfg.get("fleet") or [])
+        if reload_fleet:
+            self.fleet_addrs = reload_fleet
+            if cfg.get("self_addr"):
+                self.self_addr = cfg["self_addr"].strip()
+            self.server.set_fleet(self.fleet_addrs, self.self_addr)
+            _log.info(
+                "tracker fleet reloaded",
+                extra={"fleet": self.fleet_addrs, "self": self.self_addr},
+            )
         if cfg.get("trace") is not None:
             self.trace_config = _trace_config(cfg["trace"])
             _apply_trace("tracker", self.trace_config)
@@ -403,14 +465,29 @@ class TrackerNode:
                 )
         _log.info("rpc config reloaded", extra={"node": self.addr})
 
+    async def drain(self, timeout: float | None = None) -> None:
+        """Lameduck drain (SIGTERM / POST /debug/lameduck): /health
+        flips to 503 and new announces/proxy reads are refused -- fleet
+        clients fail over to the next ring tracker immediately, which is
+        what makes a rolling tracker restart routine. In-flight handlers
+        finish up to ``drain_timeout``; :meth:`stop` follows."""
+        await _drain_node(
+            self.server, None,
+            self.rpc.drain_timeout_seconds if timeout is None else timeout,
+            "tracker",
+        )
+
     async def stop(self) -> None:
+        # Refusal-before-teardown, as on agent/origin: no new announce
+        # lands while the runner below is mid-teardown.
+        self.server.enter_lameduck()
         if self._refresh_task:
             self._refresh_task.cancel()
         if self.loop_monitor:
             self.loop_monitor.stop()
         if self._runner:
             await self._runner.cleanup()
-        await self.server.peers.close()
+        await self.server.close()
 
 
 class OriginNode:
@@ -635,10 +712,14 @@ class OriginNode:
         )
         peer_id = factory.create(self.host, self.p2p_port)
         # The p2p scheduler seeds cached blobs; origins announce as origin
-        # peers so trackers hand them out last.
-        self._tracker_client = TrackerClient(
+        # peers so trackers hand them out last. A comma-separated
+        # tracker list builds the sharded fleet client (failover,
+        # breakers, hedged metainfo reads -- tracker/client.py).
+        self._tracker_client = make_tracker_client(
             self.tracker_addr, peer_id, self.host, 0, is_origin=True,
             announce_timeout_seconds=self.rpc.announce_timeout_seconds,
+            request_deadline_seconds=self.rpc.request_deadline_seconds,
+            hedge_delay_seconds=self.rpc.hedge_delay_seconds,
         )
         self.scheduler = Scheduler(
             peer_id=peer_id,
@@ -759,12 +840,13 @@ class OriginNode:
         return SchedulerConfig.from_dict({**doc, "conn_state": conn})
 
     def reload(self, cfg: dict) -> None:
-        """Apply a re-read config's ``scheduler:`` and ``rpc:`` sections
-        live (SIGHUP)."""
+        """Apply a re-read config's ``scheduler:``, ``tracker:``, and
+        ``rpc:`` sections live (SIGHUP)."""
         if self.scheduler is not None:
             self.scheduler.reload(
                 self.build_scheduler_config(cfg.get("scheduler"))
             )
+        _reload_tracker_addrs(self, cfg.get("tracker"))
         if cfg.get("rpc") is not None:
             self.apply_rpc(_rpc_config(cfg["rpc"]))
         if cfg.get("resources") is not None:
@@ -794,6 +876,14 @@ class OriginNode:
         self.rpc = rpc
         if self._tracker_client is not None:
             self._tracker_client.announce_timeout = rpc.announce_timeout_seconds
+            if hasattr(self._tracker_client, "request_deadline"):
+                # Fleet client: the hedged-read knobs reload too.
+                self._tracker_client.request_deadline = (
+                    rpc.request_deadline_seconds
+                )
+                self._tracker_client.hedge_delay = (
+                    rpc.hedge_delay_seconds or None
+                )
         if self.server is not None:
             self.server.rpc = rpc
             c = self.server._heal_cluster
@@ -1095,6 +1185,7 @@ class AgentNode:
         registry_strict_accept: bool = False,
         scrub: dict | ScrubConfig | None = None,
         fsck: bool = True,
+        recipe_cache_ttl_seconds: float = 60.0,
         rpc: dict | RPCConfig | None = None,
         resources: dict | ResourcesConfig | None = None,
         trace: dict | TraceConfig | None = None,
@@ -1152,6 +1243,11 @@ class AgentNode:
         self.scrub_config = (
             ScrubConfig(**scrub) if isinstance(scrub, dict) else scrub
         )
+        # Agent-side TTL cache for delta-plane control reads (recipes +
+        # /similar): a tracker failover must never re-fetch a recipe
+        # this agent just had. Recipes are CAS-immutable, so only
+        # /similar pays staleness (bounded by this TTL). 0 disables.
+        self.recipe_cache_ttl = recipe_cache_ttl_seconds
         # Overload & degradation knobs (YAML `rpc:`; live-reloadable).
         self.rpc = _rpc_config(rpc)
         # Resource sentinel budgets (YAML `resources:`; live-reloadable).
@@ -1234,9 +1330,16 @@ class AgentNode:
             PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
         )
         peer_id = factory.create(self.host, self.p2p_port)
-        self._tracker_client = TrackerClient(
+        # Comma-separated tracker list -> sharded fleet client with
+        # failover (tracker/client.py). The recipe/similar TTL cache
+        # rides the client so a tracker failover never re-fetches a
+        # recipe this agent just had.
+        self._tracker_client = make_tracker_client(
             self.tracker_addr, peer_id, self.host, 0,
             announce_timeout_seconds=self.rpc.announce_timeout_seconds,
+            request_deadline_seconds=self.rpc.request_deadline_seconds,
+            hedge_delay_seconds=self.rpc.hedge_delay_seconds,
+            recipe_cache_ttl_seconds=self.recipe_cache_ttl,
         )
         archive = AgentTorrentArchive(self.store, self.verifier)
         # Always constructed (cheap: one idle HTTP client); the config's
@@ -1298,16 +1401,24 @@ class AgentNode:
             )
 
     def reload(self, cfg: dict) -> None:
-        """Apply a re-read config's ``scheduler:`` and ``rpc:`` sections
-        live (SIGHUP)."""
+        """Apply a re-read config's ``scheduler:``, ``tracker:``, and
+        ``rpc:`` sections live (SIGHUP)."""
         if self.scheduler is not None and cfg.get("scheduler") is not None:
             self.scheduler.reload(SchedulerConfig.from_dict(cfg["scheduler"]))
+        _reload_tracker_addrs(self, cfg.get("tracker"))
         if cfg.get("rpc") is not None:
             self.rpc = _rpc_config(cfg["rpc"])
             if self._tracker_client is not None:
                 self._tracker_client.announce_timeout = (
                     self.rpc.announce_timeout_seconds
                 )
+                if hasattr(self._tracker_client, "request_deadline"):
+                    self._tracker_client.request_deadline = (
+                        self.rpc.request_deadline_seconds
+                    )
+                    self._tracker_client.hedge_delay = (
+                        self.rpc.hedge_delay_seconds or None
+                    )
             _log.info("rpc config reloaded", extra={"node": self.addr})
         if cfg.get("resources") is not None:
             self.resources_config = _resources_config(cfg["resources"])

@@ -220,11 +220,24 @@ def main(argv: list[str] | None = None) -> None:
     _common(p_tracker)
     p_tracker.add_argument("--origins", default=None,
                            help="comma-separated origin http addrs")
+    p_tracker.add_argument("--fleet", default=None,
+                           help="comma-separated addrs of the WHOLE"
+                                " tracker fleet (including this one):"
+                                " enables sharded announce ownership +"
+                                " non-owner forwarding (docs/OPERATIONS"
+                                ".md 'Tracker fleet')")
+    p_tracker.add_argument("--self-addr", default=None,
+                           help="this tracker's address AS IT APPEARS in"
+                                " --fleet (required with --fleet)")
 
     p_origin = sub.add_parser("origin")
     _common(p_origin)
     p_origin.add_argument("--store", default=None)
-    p_origin.add_argument("--tracker", default=None)
+    p_origin.add_argument("--tracker", default=None,
+                          help="tracker addr, or a comma-separated fleet"
+                               " (announces shard by info hash and fail"
+                               " over on tracker death; SIGHUP reloads"
+                               " the list)")
     p_origin.add_argument("--p2p-port", type=int, default=None)
     p_origin.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
     p_origin.add_argument("--hash-workers", type=int, default=None,
@@ -255,7 +268,11 @@ def main(argv: list[str] | None = None) -> None:
     p_agent = sub.add_parser("agent")
     _common(p_agent)
     p_agent.add_argument("--store", default=None)
-    p_agent.add_argument("--tracker", default=None)
+    p_agent.add_argument("--tracker", default=None,
+                         help="tracker addr, or a comma-separated fleet"
+                              " (announces shard by info hash and fail"
+                              " over on tracker death; SIGHUP reloads"
+                              " the list)")
     p_agent.add_argument("--p2p-port", type=int, default=None)
     p_agent.add_argument("--hasher", default=None, choices=["cpu", "tpu", "tpu-sharded"])
     p_agent.add_argument("--hash-workers", type=int, default=None,
@@ -650,11 +667,32 @@ def main(argv: list[str] | None = None) -> None:
 
     if args.component == "tracker":
         cluster = origin_cluster(pick(args.origins, "origins", ""), "tracker")
+        # Tracker HA fleet: --fleet/-fleet: lists EVERY tracker (incl.
+        # this one); self_addr names this one among them (ownership +
+        # forwarding must know which shard is "us"). One parser for the
+        # list AND the membership check -- whitespace in a YAML comma
+        # list must not reject a valid config or mis-shard ownership.
+        from kraken_tpu.tracker.client import parse_tracker_addrs
+
+        fleet = pick(args.fleet, "fleet", "") or ""
+        tracker_self = (pick(args.self_addr, "self_addr", "") or "").strip()
+        fleet_addrs = parse_tracker_addrs(fleet)
+        if fleet_addrs and not tracker_self:
+            parser.error("--fleet requires --self-addr (this tracker's"
+                         " addr as it appears in the fleet list)")
+        if fleet_addrs and tracker_self not in fleet_addrs:
+            parser.error(
+                f"--self-addr {tracker_self!r} does not appear in --fleet"
+                " (must match one entry verbatim, or every announce this"
+                " tracker accepts would look mis-sharded)"
+            )
         node = TrackerNode(
             host=host, port=port, origin_cluster=cluster,
             announce_interval_seconds=cfg.get("announce_interval_seconds", 3.0),
             peer_ttl_seconds=cfg.get("peer_ttl_seconds", 30.0),
             redis_addr=cfg.get("peerstore_redis", ""),
+            fleet=fleet_addrs,
+            self_addr=tracker_self,
             ssl_context=ssl_context,
             rpc=rpc_cfg,
             trace=cfg.get("trace"),

@@ -9,21 +9,17 @@ unverified; SURVEY.md SS2.4.
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import Callable, Optional
 
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.metainfo import MetaInfo
 from kraken_tpu.core.peer import BlobInfo
 from kraken_tpu.placement.hashring import Ring
+from kraken_tpu.placement.replicawalk import _RAISE, walk_replicas
 from urllib.parse import quote
 
-from kraken_tpu.utils import failpoints, trace
-from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
+from kraken_tpu.utils.deadline import Deadline
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
-from kraken_tpu.utils.metrics import REGISTRY
-
-_RAISE = object()  # _try_each sentinel: no default, raise on exhaustion
 
 
 class BlobClient:
@@ -261,225 +257,29 @@ class ClusterClient:
         if self.health is not None:
             (self.health.succeeded if ok else self.health.failed)(c.addr)
 
-    def _observe(self, c: BlobClient, ok: bool, seconds: float) -> None:
-        if self.health is None:
-            return
-        if hasattr(self.health, "observe"):
-            self.health.observe(c.addr, ok, seconds)
-        else:
-            (self.health.succeeded if ok else self.health.failed)(c.addr)
-
-    def _admit(self, addr: str):
-        """Breaker request admission: True (closed), a probe token (this
-        call holds a half-open host's single probe grant), or False
-        (skip)."""
-        h = self.health
-        if h is None or not hasattr(h, "try_acquire_probe"):
-            return True
-        return h.try_acquire_probe(addr)
-
-    def _release_probe(self, addr: str, token) -> None:
-        """Return an unused probe grant (cancelled attempt). Token-
-        matched: a stale release must never free a grant a later caller
-        has since acquired."""
-        h = self.health
-        if token is not None and h is not None and hasattr(h, "release_probe"):
-            h.release_probe(addr, token)
-
-    async def _attempt(self, c: BlobClient, op, deadline, as_hedge: bool,
-                       probe_token=None, op_name: str = "rpc"):
-        """One replica attempt: latency-timed, outcome fed to the
-        breaker. Two outcomes are NOT host evidence: a cancelled attempt
-        (losing hedge, teardown) and the caller's own budget running out
-        (DeadlineExceeded) -- blaming the host for either would trip or
-        re-open breakers on replicas that never misbehaved. Both return
-        the probe token and stay silent.
-
-        Each attempt is its own child span (``hedge`` attr marks the
-        racers), so a hedged read shows up in /debug/trace as the primary
-        and the hedge side by side -- which one won, and by how much, is
-        readable off the tree instead of inferred from counters."""
-        if as_hedge:
-            # Failpoint rpc.hedge.lose: delay the hedge so the primary
-            # wins the race -- drives the loser-cancellation chaos path.
-            hit = failpoints.fire("rpc.hedge.lose")
-            if hit:
-                await asyncio.sleep(hit.delay_s)
-        with trace.span(
-            f"rpc.{op_name}", addr=c.addr, hedge=as_hedge,
-        ):
-            t0 = time.monotonic()
-            try:
-                out = await op(c, deadline)
-            except asyncio.CancelledError:
-                self._release_probe(c.addr, probe_token)
-                raise
-            except DeadlineExceeded:
-                self._release_probe(c.addr, probe_token)
-                raise
-            except Exception:
-                self._observe(c, False, time.monotonic() - t0)
-                raise
-            self._observe(c, True, time.monotonic() - t0)
-            return out
-
     async def _try_each(
         self, d: Digest, op, *, default=_RAISE,
         deadline: Deadline | None = None, op_name: str = "rpc",
         hedge: bool = False,
     ):
         """Read policy: walk replicas in breaker order under one total
-        budget; idempotent ops hedge. First success wins; with all
-        replicas failed, raise the last error (or return ``default`` if
-        given and no replica errored -- i.e. the ring was empty).
+        budget; idempotent ops hedge (placement/replicawalk.py -- the
+        walk machinery is shared with the tracker fleet client). First
+        success wins; with all replicas failed, raise the last error (or
+        return ``default`` if given and no replica errored -- i.e. the
+        ring was empty).
 
         ``op`` is an async callable ``(client, deadline)`` so the budget
         reaches the HTTP layer of every attempt."""
         if deadline is None and self.deadline_seconds:
             deadline = Deadline(self.deadline_seconds, component=self.component)
-        clients = self.clients_for(d)
-        if hedge and self.hedge_delay is not None and len(clients) > 1:
-            return await self._hedged(d, clients, op, deadline, op_name, default)
-        return await self._serial(
-            d, clients, op, deadline, op_name, default, admit=True
+        return await walk_replicas(
+            self.clients_for(d), op,
+            key=d.hex[:12], missing_key=str(d),
+            health=self.health,
+            hedge_delay=self.hedge_delay if hedge else None,
+            deadline=deadline, op_name=op_name, default=default,
         )
-
-    async def _serial(
-        self, d: Digest, clients, op, deadline, op_name, default,
-        admit: bool,
-    ):
-        last: Exception | None = None
-        attempted = False
-        for c in clients:
-            if deadline is not None and deadline.expired:
-                raise deadline.exceeded(f"{op_name} {d.hex[:12]}") from last
-            admitted = self._admit(c.addr) if admit else True
-            if not admitted:
-                continue  # half-open host: someone else holds the probe
-            attempted = True
-            try:
-                return await self._attempt(
-                    c, op, deadline, as_hedge=False,
-                    probe_token=None if admitted is True else admitted,
-                    op_name=op_name,
-                )
-            except DeadlineExceeded:
-                raise  # the budget is gone: walking further is theater
-            except Exception as e:
-                last = e
-        if not attempted and admit and clients:
-            # Every replica was skipped by the probe gate: serving badly
-            # beats serving nothing -- retry the walk without admission.
-            return await self._serial(
-                d, clients, op, deadline, op_name, default, admit=False
-            )
-        if last is not None:
-            raise last
-        if default is not _RAISE:
-            return default
-        raise KeyError(str(d))
-
-    async def _hedged(
-        self, d: Digest, clients, op, deadline, op_name, default
-    ):
-        """Staggered race: the primary attempt starts now; every
-        ``hedge_delay`` without an answer (or immediately on a failure)
-        the next admitted replica joins. First success cancels the rest.
-        Wall-clock worst case stays bounded by ``deadline``."""
-        hedges = REGISTRY.counter(
-            "rpc_hedges_total",
-            "Hedge attempts launched (idempotent reads, after hedge_delay)",
-        )
-        wins = REGISTRY.counter(
-            "rpc_hedge_wins_total",
-            "Hedged reads where the hedge answered before the primary",
-        )
-        # task -> (client, launched-as-hedge)
-        tasks: dict[asyncio.Task, tuple[BlobClient, bool]] = {}
-        idx = 0
-        last: Exception | None = None
-        attempted = False
-
-        def launch(as_hedge: bool) -> bool:
-            nonlocal idx, attempted
-            while idx < len(clients):
-                c = clients[idx]
-                idx += 1
-                admitted = self._admit(c.addr)
-                if not admitted:
-                    continue
-                token = None if admitted is True else admitted
-                t = asyncio.create_task(
-                    self._attempt(c, op, deadline, as_hedge,
-                                  probe_token=token, op_name=op_name)
-                )
-                if token is not None:
-                    # A task cancelled before its first step never runs
-                    # _attempt's own release -- the done-callback covers
-                    # that gap. Token-matched, so this stale release can
-                    # never free a grant a later caller acquired.
-                    t.add_done_callback(
-                        lambda t, a=c.addr, tok=token:
-                        self._release_probe(a, tok) if t.cancelled() else None
-                    )
-                tasks[t] = (c, as_hedge)
-                attempted = True
-                if as_hedge:
-                    hedges.inc(op=op_name)
-                return True
-            return False
-
-        try:
-            launch(False)
-            if not tasks:
-                # Every replica skipped by the probe gate: degrade to
-                # the serial all-in walk.
-                return await self._serial(
-                    d, clients, op, deadline, op_name, default, admit=False
-                )
-            while True:
-                timeout = self.hedge_delay if idx < len(clients) else None
-                if deadline is not None:
-                    rem = deadline.remaining()
-                    if rem <= 0:
-                        raise deadline.exceeded(
-                            f"{op_name} {d.hex[:12]}"
-                        ) from last
-                    timeout = rem if timeout is None else min(timeout, rem)
-                done, _pending = await asyncio.wait(
-                    tasks, timeout=timeout,
-                    return_when=asyncio.FIRST_COMPLETED,
-                )
-                if not done:
-                    # Hedge timer fired (or a deadline tick with nothing
-                    # finished): bring in the next replica.
-                    launch(True)
-                    continue
-                for t in done:
-                    c, was_hedge = tasks.pop(t)
-                    err = t.exception()
-                    if err is None:
-                        if was_hedge:
-                            wins.inc(op=op_name)
-                        return t.result()
-                    if isinstance(err, DeadlineExceeded):
-                        raise err
-                    last = err
-                if not tasks and not launch(False):
-                    break
-            if last is not None:
-                raise last
-            if default is not _RAISE:
-                return default
-            raise KeyError(str(d))
-        finally:
-            # Losers (and everything on an error path) are cancelled AND
-            # reaped: a leaked transfer task would keep pulling bytes --
-            # and holding buffers -- for a result nobody wants.
-            for t in tasks:
-                t.cancel()
-            if tasks:
-                await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _fan_out(self, d: Digest, op) -> None:
         """Write policy: send to EVERY replica (as the reference's proxy

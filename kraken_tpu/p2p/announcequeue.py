@@ -9,11 +9,19 @@ ready/pending rotation), instead of one announce task per torrent firing
 every interval.
 
 Time-budget contract (round 8): every announce this queue's pump fires
-runs under ``TrackerClient.announce``'s total deadline
+runs under the tracker client's total deadline
 (``rpc.announce_timeout_seconds`` -> utils/deadline.Deadline), so a hung
 tracker socket exhausts ONE budget and re-enters the heap at the next
 interval -- the pump itself never blocks on a wedged announce (it spawns
 per-announce tasks), and no key can wedge the rotation.
+
+Failure-backoff contract (round 12, the tracker HA plane): a FAILED
+announce re-enters the heap on a per-torrent decorrelated-jitter delay
+capped at the announce interval (scheduler ``_announce_once``), never on
+the fixed tick -- so a tracker death does not synchronize every
+torrent's retry into one storm, and with a tracker FLEET
+(tracker/client.TrackerFleetClient) the jittered retry lands on the
+next ring replica within ~one base delay.
 """
 
 from __future__ import annotations

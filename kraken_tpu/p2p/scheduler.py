@@ -42,10 +42,11 @@ from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 
 from kraken_tpu.utils import trace
+from kraken_tpu.utils.backoff import DecorrelatedJitter
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.bufpool import BufferPool
 from kraken_tpu.utils.dedup import RequestCoalescer
-from kraken_tpu.utils.metrics import FailureMeter
+from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 
 _log = logging.getLogger("kraken.p2p")
 
@@ -164,6 +165,12 @@ class _TorrentControl:
         # downloader's contextvar scope, so the control carries the
         # parent explicitly for them to join. None for pure seeders.
         self.trace_parent: trace.ParentContext | None = None
+        # Decorrelated-jitter carry for FAILED announces (0 = healthy):
+        # a dead tracker must not make every torrent's retry land on the
+        # same tick fleet-wide (the synchronized-storm shape), and the
+        # first retry should come FASTER than a full interval so
+        # failover finds peers quickly.
+        self.announce_backoff = 0.0
 
     def spawn(self, coro) -> asyncio.Task:
         """Track a task for cleanup; finished tasks self-prune (a seeding
@@ -538,6 +545,7 @@ class Scheduler:
                 peers, interval_r = await self.announce_client.announce(
                     ctl.torrent.digest, h, ctl.namespace, complete
                 )
+            ctl.announce_backoff = 0.0  # healthy again: next failure is fresh
             if not complete and interval_r:
                 interval = interval_r
             self.events.emit("announce", h.hex, returned=len(peers))
@@ -546,9 +554,22 @@ class Scheduler:
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            # Tracker hiccup: retry next interval -- but METERED, or a
-            # dead tracker is invisible on /metrics.
+            # Tracker hiccup: retry with per-torrent decorrelated-jitter
+            # backoff, capped at the announce interval -- METERED (a
+            # dead tracker must be visible on /metrics), and NEVER on a
+            # fixed tick (a tracker death otherwise synchronizes every
+            # torrent's retry into one storm at its revival).
             _announce_failures.record(f"announce {h.hex[:12]}", e)
+            jitter = DecorrelatedJitter(
+                base_seconds=min(1.0, interval), max_seconds=interval
+            )
+            ctl.announce_backoff = jitter.next(ctl.announce_backoff)
+            interval = ctl.announce_backoff
+            REGISTRY.counter(
+                "announce_retry_backoffs_total",
+                "Failed announces rescheduled with decorrelated-jitter"
+                " backoff instead of the fixed interval",
+            ).inc()
         if h in self._controls:
             self._announce_queue.schedule(
                 h, asyncio.get_running_loop().time() + interval

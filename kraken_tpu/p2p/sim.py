@@ -39,6 +39,21 @@ Model (deliberately simple, stated so results are interpretable):
   debounced-bitfield crash window), and the agent rejoins after
   ``restart_down_s`` via a fresh announce -- the mid-swarm agent-restart
   chaos shape.
+- ``n_trackers`` > 1 models the tracker HA plane (round 12): announces
+  shard by info hash over the SAME rendezvous ranking production's
+  ``TrackerFleetClient`` uses, each peer carries a real production
+  :class:`PassiveFilter` breaker over the tracker hosts (driven with
+  sim time), and a failed attempt walks to the next ring tracker.
+  Each tracker owns an independent in-memory membership store that DIES
+  with it (``tracker_kill_at_s``/``tracker_kill`` kill the blob-0
+  owners first; ``tracker_restart_after_s`` revives them empty), so the
+  sim measures the real re-form dynamics: failover announces rebuild
+  the survivor's swarm view within ~one announce interval. Per-announce
+  latency (walk cost included) is reported as ``announce_p50_s`` /
+  ``announce_p99_s`` -- the number the tier-1 fleet band pins.
+  ``tracker_down_mode`` "refuse" charges one latency hop per dead
+  attempt (a killed process RSTs instantly); "blackhole" charges the
+  full announce budget (a partitioned host).
 
 Determinism: one seeded ``random.Random`` drives every stochastic choice
 (handout shuffle + selection tiebreaks route through ``random`` module
@@ -58,6 +73,8 @@ from kraken_tpu.core.peer import PeerID, PeerInfo
 from kraken_tpu.p2p.announcequeue import AnnounceQueue
 from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
 from kraken_tpu.p2p.piecerequest import RequestManager
+from kraken_tpu.placement.healthcheck import PassiveFilter
+from kraken_tpu.placement.hrw import rendezvous_hash
 from kraken_tpu.tracker.peerhandout import default_priority
 
 
@@ -87,9 +104,23 @@ class SimConfig:
     restart_frac: float = 0.0
     restart_down_s: float = 1.0
     restart_lose_pieces: int = 1
+    # Tracker HA fleet (round 12; 1 = the legacy single-tracker model,
+    # bit-for-bit -- the 1k regression band depends on that).
+    n_trackers: int = 1
+    tracker_kill_at_s: float = 0.0
+    tracker_kill: int = 0  # blob-0 owners die first (a miss-less kill)
+    tracker_restart_after_s: float = 0.0  # 0 = stays dead
+    tracker_down_mode: str = "refuse"  # "refuse" | "blackhole"
+    tracker_fail_timeout_s: float = 5.0  # blackhole: announce budget
+    tracker_breaker_fails: int = 3
+    tracker_breaker_cooldown_s: float = 10.0
 
     def blobs(self) -> tuple[int, ...]:
         return self.blob_pieces or (self.num_pieces,)
+
+    @property
+    def fleet(self) -> bool:
+        return self.n_trackers > 1
 
 
 class _Peer:
@@ -104,6 +135,7 @@ class _Peer:
         "pid", "origin", "join_t", "done_t", "blob_done_t", "has", "avail",
         "conns", "requests", "cs", "bl", "busy_until", "recv_until",
         "uplink_bps", "offline_until", "order", "incarnation",
+        "tracker_health",
     )
 
     def __init__(self, pid: PeerID, cfg: SimConfig, origin: bool, join_t: float):
@@ -145,6 +177,18 @@ class _Peer:
         self.uplink_bps = cfg.origin_uplink_bps if origin else cfg.uplink_bps
         self.offline_until = 0.0  # restart chaos: no serve/dial while down
         self.order: list[list[int]] = [[] for _ in blobs]  # arrival order
+        # Fleet mode: the PRODUCTION breaker over tracker hosts, driven
+        # with explicit sim `now` everywhere (like the Blacklist above).
+        # One shared instance name keeps the per-filter gauge at a
+        # single series however many sim peers exist.
+        self.tracker_health: PassiveFilter | None = (
+            PassiveFilter(
+                fail_threshold=cfg.tracker_breaker_fails,
+                cooldown_seconds=cfg.tracker_breaker_cooldown_s,
+                name="sim-tracker-fleet",
+            )
+            if cfg.fleet else None
+        )
         # Bumped on every restart: events scheduled against the OLD
         # process (queued serves, in-flight pieces) must not charge or
         # feed the reborn one.
@@ -158,6 +202,24 @@ class _Peer:
 
     def blob_complete(self, t: int) -> bool:
         return self.origin or self.blob_done_t[t] is not None
+
+
+class _SimTracker:
+    """One fleet tracker: an up/down flag and an independent in-memory
+    membership store (per torrent) that dies with the process."""
+
+    __slots__ = ("name", "up", "members", "member_set")
+
+    def __init__(self, name: str, n_blobs: int):
+        self.name = name
+        self.up = True
+        self.members: list[list[PeerID]] = [[] for _ in range(n_blobs)]
+        self.member_set: list[set[PeerID]] = [set() for _ in range(n_blobs)]
+
+    def wipe(self) -> None:
+        for t in range(len(self.members)):
+            self.members[t].clear()
+            self.member_set[t].clear()
 
 
 class SwarmSim:
@@ -186,6 +248,17 @@ class SwarmSim:
         # state, a one-interval-fresher view than the tracker's records.
         self._members: list[list[PeerID]] = [[] for _ in self.blobs]
         self._member_set: list[set[PeerID]] = [set() for _ in self.blobs]
+        # Fleet mode state (cfg.n_trackers > 1; legacy single-tracker
+        # runs never touch any of it, preserving bit-exact replays).
+        self.trackers: list[_SimTracker] = [
+            _SimTracker(f"tracker{i}", len(self.blobs))
+            for i in range(cfg.n_trackers)
+        ] if cfg.fleet else []
+        self._tracker_by_name = {tr.name: tr for tr in self.trackers}
+        self.announce_lat: list[float] = []
+        self.announce_failovers = 0  # attempts that walked past a tracker
+        self.announce_failures = 0   # walks that exhausted the whole fleet
+        self.tracker_kills = 0
 
     # -- event plumbing ----------------------------------------------------
 
@@ -200,8 +273,21 @@ class SwarmSim:
             pid = PeerID("ff" * 2 + f"{i:036x}")
             self.peers[pid] = _Peer(pid, cfg, origin=True, join_t=0.0)
             for t in range(len(self.blobs)):
-                self._members[t].append(pid)
-                self._member_set[t].add(pid)
+                if cfg.fleet:
+                    # The origin registers with each swarm's shard OWNER
+                    # (where its production seed-announce would land) and
+                    # keeps re-announcing via the queue -- that periodic
+                    # announce is what re-registers it with the failover
+                    # tracker after the owner dies.
+                    tr = self._tracker_by_name[self._owner(t)]
+                    tr.members[t].append(pid)
+                    tr.member_set[t].add(pid)
+                    self.announce_q.schedule(
+                        (pid, t), cfg.announce_interval_s
+                    )
+                else:
+                    self._members[t].append(pid)
+                    self._member_set[t].add(pid)
         for i in range(cfg.n_agents):
             pid = PeerID(f"{i:040x}")
             self.peers[pid] = _Peer(pid, cfg, origin=False, join_t=0.0)
@@ -213,6 +299,8 @@ class SwarmSim:
         self._at(cfg.churn_tick_s, self._churn_tick)
         if cfg.restart_frac > 0 and cfg.restart_at_s > 0:
             self._at(cfg.restart_at_s, self._restart_wave)
+        if cfg.fleet and cfg.tracker_kill > 0 and cfg.tracker_kill_at_s > 0:
+            self._at(cfg.tracker_kill_at_s, self._tracker_kill_wave)
 
         while self._heap and self.now <= cfg.max_sim_s and self._remaining:
             t, _seq, fn = heapq.heappop(self._heap)
@@ -241,6 +329,9 @@ class SwarmSim:
         )
 
     def _announce(self, p: _Peer, t: int) -> None:
+        if self.cfg.fleet:
+            self._announce_fleet(p, t)
+            return
         self.announces += 1
         # Tracker side: record membership, sample candidates (as the
         # production peerstore does), order with the production policy.
@@ -259,6 +350,101 @@ class SwarmSim:
             return  # seeders announce for discoverability, don't dial
         for info in handout:
             self._try_dial(p, info.peer_id, t)
+
+    # -- tracker fleet (round 12) ------------------------------------------
+
+    def _owner(self, t: int) -> str:
+        return rendezvous_hash(
+            self.hs[t].hex, [tr.name for tr in self.trackers], k=1
+        )[0]
+
+    def _announce_fleet(self, p: _Peer, t: int) -> None:
+        """One announce through the fleet: rendezvous ranking (owner
+        first), production-breaker ordering and probe admission, walk on
+        failure -- the TrackerFleetClient policy in sim time. The walk's
+        accumulated cost IS the announce latency the band test pins."""
+        self.announces += 1
+        names = [tr.name for tr in self.trackers]
+        ranked = rendezvous_hash(self.hs[t].hex, names, k=len(names))
+        health = p.tracker_health
+        chosen: _SimTracker | None = None
+        delay = 0.0
+        for admit in (True, False):
+            attempted = False
+            for name in health.order(ranked, now=self.now):
+                if admit:
+                    if not health.try_acquire_probe(name, now=self.now + delay):
+                        continue  # open-and-cooling, or probe taken
+                attempted = True
+                tr = self._tracker_by_name[name]
+                if not tr.up:
+                    # "refuse": a killed process RSTs instantly -- one
+                    # hop to learn. "blackhole": the attempt burns the
+                    # announce budget before the walk moves on.
+                    delay += (
+                        self.cfg.latency_s
+                        if self.cfg.tracker_down_mode == "refuse"
+                        else self.cfg.tracker_fail_timeout_s
+                    )
+                    self.announce_failovers += 1
+                    health.observe(name, False, now=self.now + delay)
+                    continue
+                rtt = 2 * self.cfg.latency_s
+                delay += rtt
+                health.observe(name, True, rtt, now=self.now + delay)
+                chosen = tr
+                break
+            if chosen is not None or attempted:
+                break
+            # Every tracker was skipped by the probe gate: walk again
+            # all-in (serving badly beats serving nothing -- the same
+            # degrade the production walk takes).
+        self.announce_lat.append(delay)
+        self.announce_q.schedule(
+            (p.pid, t), self.now + delay + self.cfg.announce_interval_s
+        )
+        if chosen is None:
+            self.announce_failures += 1  # whole fleet down: retry next tick
+            return
+        self._at(self.now + delay,
+                 lambda: self._announce_apply(p, t, chosen))
+
+    def _announce_apply(self, p: _Peer, t: int, tr: _SimTracker) -> None:
+        """The announce lands at a live tracker: register membership in
+        ITS store (re-forming the swarm there after a failover), sample
+        a handout from what IT knows, dial."""
+        if not tr.up or p.offline(self.now):
+            return  # the tracker (or the announcer) died in flight
+        if p.pid not in tr.member_set[t]:
+            tr.member_set[t].add(p.pid)
+            tr.members[t].append(p.pid)
+        limit = self.cfg.handout_limit
+        k = min(len(tr.members[t]), limit + 1)
+        candidates = random.sample(tr.members[t], k)
+        others = [self._info(q, t) for q in candidates if q != p.pid][:limit]
+        handout = default_priority(others)
+        if p.blob_complete(t):
+            return  # seeders announce for discoverability, don't dial
+        for info in handout:
+            self._try_dial(p, info.peer_id, t)
+
+    def _tracker_kill_wave(self) -> None:
+        """Kill the blob-0 shard owners first (a random victim could
+        miss the shard under test entirely), wiping their in-memory
+        stores -- exactly what a process death does. Optional revival
+        brings them back EMPTY; announces re-form the swarm."""
+        names = [tr.name for tr in self.trackers]
+        ranked = rendezvous_hash(self.hs[0].hex, names, k=len(names))
+        for name in ranked[: self.cfg.tracker_kill]:
+            tr = self._tracker_by_name[name]
+            tr.up = False
+            tr.wipe()
+            self.tracker_kills += 1
+            if self.cfg.tracker_restart_after_s > 0:
+                self._at(
+                    self.now + self.cfg.tracker_restart_after_s,
+                    lambda tr=tr: setattr(tr, "up", True),
+                )
 
     # -- conn plane --------------------------------------------------------
 
@@ -472,6 +658,12 @@ class SwarmSim:
         n = len(lat)
         incomplete = self.cfg.n_agents - n
         q = (lambda f: lat[min(n - 1, int(f * n))]) if n else (lambda f: None)
+        alat = sorted(self.announce_lat)
+        na = len(alat)
+        aq = (
+            (lambda f: alat[min(na - 1, int(f * na))]) if na
+            else (lambda f: None)
+        )
         return {
             "agents": self.cfg.n_agents,
             "blobs": len(self.blobs),
@@ -488,6 +680,14 @@ class SwarmSim:
             "duplicate_transfers": self.duplicates,
             "busy_rejects": self.busy_rejects,
             "restarts": self.restarts,
+            # Tracker fleet plane (None/0 outside fleet mode: legacy
+            # announces are instantaneous in-model).
+            "n_trackers": self.cfg.n_trackers,
+            "announce_p50_s": aq(0.50),
+            "announce_p99_s": aq(0.99),
+            "announce_failovers": self.announce_failovers,
+            "announce_failures": self.announce_failures,
+            "tracker_kills": self.tracker_kills,
         }
 
 

@@ -21,11 +21,15 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import random
 import time
 from typing import Optional
 
 from kraken_tpu.core.peer import PeerInfo
+from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
+
+_log = logging.getLogger("kraken.tracker.peerstore")
 
 
 class PeerStore:
@@ -207,6 +211,20 @@ class RedisPeerStore(PeerStore):
         self.timeout = timeout_seconds
         self._conn: Optional[_RespConn] = None
         self._lock = asyncio.Lock()
+        # A dropped/desynced store conn is a reconnect, not an outage:
+        # visible on /metrics so a flapping Redis is diagnosable before
+        # it becomes announce 500s.
+        self._reconnects = REGISTRY.counter(
+            "redis_peerstore_reconnects_total",
+            "Redis peerstore connections invalidated (timeout, EOF,"
+            " protocol garbage) and rebuilt on the next attempt",
+        )
+        self._errors = FailureMeter(
+            "redis_peerstore_errors_total",
+            "Redis peerstore operations that failed after the reconnect"
+            " retry (the announce handler 500s and the swarm retries)",
+            _log,
+        )
 
     async def _get_conn(self) -> _RespConn:
         if self._conn is None:
@@ -224,9 +242,14 @@ class RedisPeerStore(PeerStore):
                 try:
                     conn = await self._get_conn()
                     return await asyncio.wait_for(op(conn), self.timeout)
+                except RespError:
+                    # A clean server error reply ("-ERR ..."): the stream
+                    # is still in sync -- the conn stays; the error is
+                    # the caller's to handle.
+                    raise
                 except (ConnectionError, OSError,
                         asyncio.IncompleteReadError, asyncio.TimeoutError,
-                        ValueError):
+                        ValueError) as e:
                     # IncompleteReadError is an EOFError, not a
                     # ConnectionError: the server died mid-reply.
                     # ValueError = unparseable reply bytes (protocol
@@ -235,7 +258,11 @@ class RedisPeerStore(PeerStore):
                     if self._conn is not None:
                         self._conn.close()
                     self._conn = None
+                    self._reconnects.inc()
                     if attempt:
+                        self._errors.record(
+                            f"redis {self.host}:{self.port}", e
+                        )
                         raise
 
     async def _cmd(self, *args):
@@ -285,7 +312,18 @@ class RedisPeerStore(PeerStore):
             except (ValueError, KeyError):
                 dead.append(field)
         if dead:
-            await self._cmd("HDEL", self._key(info_hash), *dead)
+            # Best-effort reap: the read already has its answer -- a
+            # store hiccup on this housekeeping HDEL must not turn a
+            # successful handout into a 500 (the fields stay dead-but-
+            # present and the next read retries the reap).
+            try:
+                await self._cmd("HDEL", self._key(info_hash), *dead)
+            except (RespError, ConnectionError, OSError,
+                    asyncio.IncompleteReadError, asyncio.TimeoutError,
+                    ValueError) as e:
+                self._errors.record(
+                    f"lazy HDEL {self.host}:{self.port}", e
+                )
         if len(out) <= limit:
             return out
         # SAMPLE, not slice: HGETALL field order is stable per key, so a

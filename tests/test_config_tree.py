@@ -27,7 +27,7 @@ CLI_KEYS = {
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
-    "profiling",
+    "profiling", "fleet",
 }
 
 

@@ -397,6 +397,109 @@ def test_redis_peerstore_survives_protocol_garbage():
     asyncio.run(main())
 
 
+def test_redis_peerstore_reconnects_when_socket_dies_mid_get_peers():
+    """Kill the fake-Redis socket MID-REPLY (half an HGETALL answer,
+    then EOF): the client must invalidate the half-read stream, count
+    the reconnect, retry on a fresh conn, and answer -- a dropped store
+    conn must never poison subsequent announces."""
+
+    async def main():
+        class DiesMidReply(FakeRedis):
+            def __init__(self):
+                super().__init__()
+                self.die_mid_hgetall = False
+
+            async def _handle(self, reader, writer):
+                try:
+                    while True:
+                        line = (await reader.readline()).rstrip(b"\r\n")
+                        if not line:
+                            return
+                        assert line[:1] == b"*"
+                        args = []
+                        for _ in range(int(line[1:])):
+                            lenline = (await reader.readline()).rstrip(b"\r\n")
+                            n = int(lenline[1:])
+                            args.append(
+                                (await reader.readexactly(n + 2))[:-2]
+                            )
+                        reply = self._dispatch(args)
+                        if (self.die_mid_hgetall
+                                and args[0].upper() == b"HGETALL"):
+                            self.die_mid_hgetall = False
+                            # Half the reply, then the process "dies".
+                            writer.write(reply[: max(1, len(reply) // 2)])
+                            await writer.drain()
+                            writer.close()
+                            return
+                        writer.write(reply)
+                        await writer.drain()
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                finally:
+                    writer.close()
+
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        reconnects = REGISTRY.counter("redis_peerstore_reconnects_total")
+        async with DiesMidReply() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=30,
+                                   timeout_seconds=2)
+            try:
+                await store.update("h", _peer(1))
+                await store.update("h", _peer(2))
+                before = reconnects.value()
+                srv.die_mid_hgetall = True
+                got = await store.get_peers("h")  # reconnect + retry
+                assert len(got) == 2
+                assert reconnects.value() > before
+                # And the stream stays clean afterwards.
+                await store.update("h", _peer(3))
+                assert len(await store.get_peers("h")) == 3
+            finally:
+                await store.close()
+
+    asyncio.run(main())
+
+
+def test_redis_peerstore_lazy_hdel_failure_does_not_poison_reads():
+    """The read path's housekeeping HDEL is best-effort: a server error
+    there must not turn a successful handout into a 500 (the announce
+    already has its peers)."""
+
+    async def main():
+        class HdelErrs(FakeRedis):
+            def _dispatch(self, args):
+                if args[0].upper() == b"HDEL":
+                    return b"-ERR hdel refused\r\n"
+                return super()._dispatch(args)
+
+        import json as _json
+
+        async with HdelErrs() as srv:
+            store = RedisPeerStore(srv.addr, ttl_seconds=1,
+                                   timeout_seconds=2)
+            try:
+                await store.update("h", _peer(1))
+                await store.update("h", _peer(2))
+                # Expire peer 1 far enough back that the lazy reap (one
+                # extra TTL of grace) wants to HDEL it.
+                h = srv.hashes[b"swarm:h"]
+                f = _peer(1).peer_id.hex.encode()
+                doc = _json.loads(h[f])
+                doc["_expiry"] = 0
+                h[f] = _json.dumps(doc).encode()
+                got = await store.get_peers("h")  # HDEL fails inside
+                assert [p.ip for p in got] == ["10.0.0.2"]
+                # Store keeps working (conn not invalidated: the -ERR
+                # reply left the stream in sync).
+                assert len(await store.get_peers("h")) == 1
+            finally:
+                await store.close()
+
+    asyncio.run(main())
+
+
 def test_redis_peerstore_pipeline_error_keeps_stream_synced():
     """A server error mid-pipeline (e.g. WRONGTYPE on HSET) must consume
     the remaining replies: the NEXT command must read its own reply, not

@@ -117,6 +117,57 @@ def test_restart_wave_recovers():
     assert r == r2
 
 
+def test_tracker_fleet_healthy_matches_single_tracker_completion():
+    """Fleet mode sanity: with every tracker healthy, sharding announces
+    over 3 trackers must not change whether (or how fast, within noise)
+    the swarm completes."""
+    single = run_sim(n_agents=200, num_pieces=16, seed=8)
+    fleet = run_sim(n_agents=200, num_pieces=16, seed=8, n_trackers=3)
+    assert fleet["completed"] == single["completed"] == 200
+    assert fleet["p99_s"] < single["p99_s"] * 2
+    assert fleet["announce_failovers"] == 0
+    assert fleet["announce_p99_s"] is not None
+
+
+def test_tracker_fleet_band_1k_kill_one_of_three():
+    """CI band for the tracker HA plane (ISSUE 12 acceptance): 1k
+    agents, 3 trackers, the blob's shard owner killed mid-run. The
+    fleet must shrug: ZERO failed pulls, and announce p99 <= 3x the
+    healthy-fleet control (same seed/config, no kill) -- per-agent
+    breakers cap the damage at fail_threshold fast-refused hops before
+    everyone routes around the corpse. Deterministic per (seed,
+    config), so this is a band, not a flake."""
+    kw = dict(n_agents=1000, num_pieces=64, seed=0, n_trackers=3)
+    control = run_sim(**kw)
+    killed = run_sim(**kw, tracker_kill_at_s=3.0, tracker_kill=1)
+    assert control["completed"] == 1000 and control["announce_failovers"] == 0
+    # Zero failed pulls through the tracker death.
+    assert killed["completed"] == 1000 and killed["incomplete"] == 0
+    assert killed["tracker_kills"] == 1
+    assert killed["announce_failovers"] > 0  # the death was actually felt
+    assert killed["announce_failures"] == 0  # but no announce ever died
+    # THE band: announce p99 within 3x of the healthy control.
+    assert killed["announce_p99_s"] <= control["announce_p99_s"] * 3.0, (
+        killed["announce_p99_s"], control["announce_p99_s"],
+    )
+    # Swarm-completion time stays in family too (the sim's pull p99 is
+    # dominated by bandwidth, not announces; a wedged announce plane
+    # would blow this out).
+    assert killed["p99_s"] <= control["p99_s"] * 1.5
+
+
+@pytest.mark.slow
+def test_tracker_fleet_band_30k_kill_one_of_three():
+    """The bench-scale variant (PERF.md swarm plane): 30k agents
+    through the same 1-of-3 tracker death."""
+    kw = dict(n_agents=30_000, num_pieces=64, seed=1, n_trackers=3)
+    control = run_sim(**kw)
+    killed = run_sim(**kw, tracker_kill_at_s=5.0, tracker_kill=1)
+    assert killed["completed"] == 30_000
+    assert killed["announce_failures"] == 0
+    assert killed["announce_p99_s"] <= control["announce_p99_s"] * 3.0
+
+
 def test_1k_regression_band():
     """CI regression gate (VERDICT r4 #8): p99 at 1k agents stays within
     +/-5% of the recorded golden (12.43 s, round 5; cross-seed spread

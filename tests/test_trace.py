@@ -351,19 +351,16 @@ def test_metrics_endpoint_negotiates_openmetrics_exemplars(tmp_path):
 
 
 def test_hedge_attempt_spans_carry_op_and_hedge_flag():
-    """Each cluster-read attempt is its own child span with the hedge
+    """Each replica-walk attempt is its own child span with the hedge
     attribute, so a hedged read shows up in /debug/trace as primary and
-    hedge side by side -- which one won is readable off the tree."""
-    from kraken_tpu.origin.client import ClusterClient
-    from kraken_tpu.placement import HostList, Ring
+    hedge side by side -- which one won is readable off the tree. (The
+    walk lives in placement/replicawalk.py since round 12, shared by the
+    origin ClusterClient and the tracker fleet client.)"""
+    from kraken_tpu.placement.replicawalk import _attempt
 
     _apply(sample_rate=1.0)
 
     async def main():
-        cluster = ClusterClient(
-            Ring(HostList(static=["h1:1", "h2:2"]), max_replica=2)
-        )
-
         class _C:
             addr = "h1:1"
 
@@ -371,11 +368,10 @@ def test_hedge_attempt_spans_carry_op_and_hedge_flag():
             return b"ok"
 
         with trace.span("caller") as root:
-            out = await cluster._attempt(
-                _C(), op, None, as_hedge=True, op_name="download"
+            out = await _attempt(
+                None, _C(), op, None, as_hedge=True, op_name="download"
             )
         assert out == b"ok"
-        await cluster.close()
         spans = {s["name"]: s for s in TRACER.recorder.snapshot()}
         sp = spans["rpc.download"]
         assert sp["attrs"]["hedge"] is True

@@ -1,0 +1,521 @@
+"""Tracker high-availability plane (round 12).
+
+The contract under test: a swarm hangs off a FLEET of trackers, not one
+address. Clients shard each request by info hash over the rendezvous
+ring and fail over along it through the degradation machinery
+(breakers, deadline budgets, hedged reads); trackers serve any swarm,
+forward non-owner announces toward the live owner, and drain via the
+standard lameduck contract -- so killing 1-of-N trackers mid-pull is a
+blip in announce latency, never a failed pull.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import InfoHash
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.origin.client import BlobClient, ClusterClient
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.placement.hrw import rendezvous_hash
+from kraken_tpu.tracker.client import (
+    TrackerClient,
+    TrackerFleetClient,
+    make_tracker_client,
+    parse_tracker_addrs,
+)
+from kraken_tpu.tracker.server import TrackerServer
+from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.metrics import REGISTRY
+
+NS = "library/fleet"
+
+
+def _pid(i: int) -> PeerID:
+    return PeerID(f"{i:040x}")
+
+
+def _peer(i: int) -> PeerInfo:
+    return PeerInfo(peer_id=_pid(i), ip="10.0.0.%d" % (i % 250 + 1),
+                    port=7000 + i)
+
+
+async def _start_trackers(n: int, **kw):
+    trackers = [TrackerNode(announce_interval_seconds=0.1,
+                            peer_ttl_seconds=5.0, **kw) for _ in range(n)]
+    for t in trackers:
+        await t.start()
+    # The fleet list exists only after every port is bound.
+    addrs = [t.addr for t in trackers]
+    for t in trackers:
+        t.server.set_fleet(addrs, t.addr)
+        t.fleet_addrs, t.self_addr = list(addrs), t.addr
+    return trackers, addrs
+
+
+def _fleet_client(addrs, i=1, **kw) -> TrackerFleetClient:
+    return TrackerFleetClient(
+        addrs, _pid(i), "127.0.0.1", 7000 + i,
+        announce_timeout_seconds=kw.pop("announce_timeout_seconds", 3.0),
+        **kw,
+    )
+
+
+# -- client-side sharding + failover ----------------------------------------
+
+
+def test_make_tracker_client_picks_shape():
+    """<= 1 addr keeps the pre-fleet single-host client (including the
+    legacy empty-addr construction); >= 2 builds the fleet."""
+    single = make_tracker_client("1.2.3.4:7602", _pid(1), "h", 1)
+    assert isinstance(single, TrackerClient)
+    empty = make_tracker_client("", _pid(1), "h", 1)
+    assert isinstance(empty, TrackerClient) and empty.addr == ""
+    fleet = make_tracker_client("a:1, b:2,,c:3", _pid(1), "h", 1)
+    assert isinstance(fleet, TrackerFleetClient)
+    assert fleet.addrs == ["a:1", "b:2", "c:3"]
+    assert parse_tracker_addrs(["x:1", "", "y:2"]) == ["x:1", "y:2"]
+
+
+def test_fleet_shards_announces_by_info_hash(tmp_path):
+    """In a healthy fleet every announce lands on its rendezvous owner:
+    each tracker's peer store holds exactly the swarms it owns."""
+
+    async def main():
+        trackers, addrs = await _start_trackers(3)
+        client = _fleet_client(addrs)
+        try:
+            hashes = [InfoHash(f"{i:02x}" + "cd" * 31) for i in range(12)]
+            for h in hashes:
+                await client.announce(None, h, NS, complete=False)
+            for h in hashes:
+                owner = rendezvous_hash(h.hex, addrs, k=1)[0]
+                for t in trackers:
+                    stored = t.server.peers._swarms.get(h.hex)
+                    if t.addr == owner:
+                        assert stored, f"owner {owner} missing swarm"
+                    else:
+                        assert not stored, (
+                            f"non-owner {t.addr} got swarm {h.hex[:8]}"
+                        )
+            assert client.owner_of(hashes[0].hex) == rendezvous_hash(
+                hashes[0].hex, addrs, k=1
+            )[0]
+        finally:
+            await client.close()
+            for t in trackers:
+                await t.stop()
+
+    asyncio.run(main())
+
+
+def test_fleet_fails_over_when_owner_dies(tmp_path):
+    """Kill a swarm's shard owner: announces fail over to the next ring
+    tracker (counted), the breaker records the dead host, and the
+    handout still works -- no announce ever errors because the owner
+    died."""
+
+    async def main():
+        trackers, addrs = await _start_trackers(3)
+        h = InfoHash("ee" * 32)
+        owner = rendezvous_hash(h.hex, addrs, k=1)[0]
+        client = _fleet_client(addrs, i=1)
+        client2 = _fleet_client(addrs, i=2)
+        failovers = REGISTRY.counter("tracker_fleet_failovers_total")
+        before = failovers.value(op="announce")
+        try:
+            await client.announce(None, h, NS, complete=True)
+            # The owner dies (process gone: connections refused).
+            victim = next(t for t in trackers if t.addr == owner)
+            await victim.stop()
+            peers, interval = await client2.announce(
+                None, h, NS, complete=False
+            )
+            assert interval > 0  # served, not errored
+            assert failovers.value(op="announce") > before
+            # The swarm re-forms on the survivor within one announce:
+            # client1 re-announces (failing over too), then client2 sees
+            # it in the handout.
+            await client.announce(None, h, NS, complete=True)
+            peers, _ = await client2.announce(None, h, NS, complete=False)
+            assert any(p.peer_id == _pid(1) for p in peers)
+            # Breaker evidence: the dead owner is held unhealthy in this
+            # client's breaker after enough failures (the walk marks one
+            # failure per announce that had to route around it).
+            for _ in range(3):
+                await client2.announce(None, h, NS, complete=False)
+            snap = client2.health.snapshot()
+            assert owner in snap["hosts"]
+        finally:
+            await client.close()
+            await client2.close()
+            for t in trackers:
+                if t is not victim:
+                    await t.stop()
+
+    asyncio.run(main())
+
+
+def test_fleet_set_addrs_reshards_and_prunes(tmp_path):
+    """SIGHUP membership swap: dropped trackers lose their sub-clients
+    and breaker verdicts; ownership re-shards on the next call."""
+
+    async def main():
+        client = _fleet_client(["a:1", "b:2", "c:3"])
+        try:
+            client.health.failed("c:3")
+            client.set_addrs(["a:1", "b:2"])
+            assert client.addrs == ["a:1", "b:2"]
+            assert "c:3" not in client.health.snapshot()["hosts"]
+            assert client.owner_of("ab" * 32) in ("a:1", "b:2")
+            with pytest.raises(ValueError):
+                client.set_addrs([])
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_fleet_port_setter_fans_out():
+    """Assembly learns the p2p port post-bind; the setter must reach
+    every lazily-built sub-client."""
+
+    async def main():
+        client = _fleet_client(["a:1", "b:2"])
+        try:
+            sub = client._client("a:1")
+            client.port = 4242
+            assert sub.port == 4242
+            assert client._client("b:2").port == 4242
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_recipe_cache_survives_failover(monkeypatch):
+    """The agent-side TTL cache: a recipe fetched once is never
+    re-fetched across a tracker failover (recipes are CAS-immutable),
+    with hit/miss counters."""
+
+    calls = {"recipe": 0, "similar": 0}
+
+    async def fake_recipe(self, namespace, d, deadline=None):
+        calls["recipe"] += 1
+        return ("RECIPE", "origin:1")
+
+    async def fake_similar(self, namespace, d, deadline=None):
+        calls["similar"] += 1
+        return [{"digest": "ab" * 32, "score": 0.9}]
+
+    async def main():
+        monkeypatch.setattr(TrackerClient, "get_recipe", fake_recipe)
+        monkeypatch.setattr(TrackerClient, "similar", fake_similar)
+        client = _fleet_client(
+            ["a:1", "b:2", "c:3"], recipe_cache_ttl_seconds=60.0
+        )
+        hits = REGISTRY.counter("tracker_recipe_cache_total")
+        h0 = hits.value(op="recipe", result="hit")
+        d = Digest.from_bytes(b"target")
+        try:
+            assert await client.get_recipe(NS, d) == ("RECIPE", "origin:1")
+            assert calls["recipe"] == 1
+            # Failover (membership swap = the owner changed): the cache
+            # answers; no sub-client call happens.
+            client.set_addrs(["b:2", "c:3"])
+            assert await client.get_recipe(NS, d) == ("RECIPE", "origin:1")
+            assert calls["recipe"] == 1
+            assert hits.value(op="recipe", result="hit") == h0 + 1
+            # /similar caches the same way.
+            assert len(await client.similar(NS, d)) == 1
+            assert len(await client.similar(NS, d)) == 1
+            assert calls["similar"] == 1
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_blackholed_owner_pays_one_slice_not_the_whole_budget(monkeypatch):
+    """A PARTITIONED owner (SYN blackhole: the socket hangs, no RST)
+    must cost one per-attempt slice of the walk budget, be counted as
+    host evidence, and the announce must still succeed via a survivor
+    inside the budget -- a whole-budget hang would make failover
+    unreachable for every swarm the corpse owns."""
+
+    import time as _time
+
+    h = InfoHash("dd" * 32)
+
+    async def main():
+        client = _fleet_client(
+            ["a:1", "b:2", "c:3"], announce_timeout_seconds=1.5
+        )
+        owner = client.owner_of(h.hex)
+
+        async def fake_announce(self, d, ih, namespace, complete,
+                                deadline=None):
+            if self.addr == owner:
+                await asyncio.sleep(3600)  # the blackhole
+            return [], 0.5
+
+        monkeypatch.setattr(TrackerClient, "announce", fake_announce)
+        try:
+            t0 = _time.monotonic()
+            peers, interval = await client.announce(None, h, NS, False)
+            wall = _time.monotonic() - t0
+            assert interval == 0.5  # a survivor answered
+            # Paid ~one slice (budget/fleet = 0.5 s), not the whole 1.5.
+            assert wall < 1.2, wall
+            # The hang IS host evidence: the breaker recorded it, so
+            # fail_threshold announces later the owner is skipped cold.
+            snap = client.health.snapshot()
+            assert snap["hosts"][owner]["consecutive_fails"] >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+# -- hashring rebalance properties -------------------------------------------
+
+
+def test_rebalance_moves_about_one_nth_of_ownership():
+    """The property the whole plane leans on: adding (or removing) one
+    tracker moves only ~1/N of info-hash ownership. Pinned with slack
+    for hash variance; a change to the rendezvous scoring that breaks
+    minimal reshuffling must fail here."""
+    keys = [Digest.from_bytes(os.urandom(32)).hex for _ in range(2000)]
+    three = ["t1:7602", "t2:7602", "t3:7602"]
+    four = three + ["t4:7602"]
+
+    def owners(addrs):
+        return {k: rendezvous_hash(k, addrs, k=1)[0] for k in keys}
+
+    o3, o4 = owners(three), owners(four)
+    moved_add = sum(1 for k in keys if o3[k] != o4[k]) / len(keys)
+    # Expected exactly 1/4 on add; allow hash variance around it.
+    assert 0.15 <= moved_add <= 0.35, moved_add
+    # Every moved key moved TO the new tracker -- rendezvous never
+    # shuffles ownership between survivors.
+    assert all(
+        o4[k] == "t4:7602" for k in keys if o3[k] != o4[k]
+    )
+    # Removal: only the dead tracker's keys move (to survivors).
+    o2 = owners(three[:2])
+    moved_rm = [k for k in keys if o3[k] != o2[k]]
+    assert all(o3[k] == "t3:7602" for k in moved_rm)
+    assert 0.23 <= len(moved_rm) / len(keys) <= 0.43
+
+
+def test_membership_change_announce_never_loses_a_peer(tmp_path):
+    """A client with a STALE fleet view announces to a tracker that is
+    no longer the owner: the non-owner accepts (its local handout
+    works) AND forwards to the live owner, so clients with the fresh
+    view find the peer there -- a registered peer is never lost to a
+    membership change."""
+
+    async def main():
+        trackers, addrs = await _start_trackers(3)
+        try:
+            h = InfoHash("aa" * 32)
+            owner = rendezvous_hash(h.hex, addrs, k=1)[0]
+            non_owner = next(t for t in trackers if t.addr != owner)
+            owner_node = next(t for t in trackers if t.addr == owner)
+            http = HTTPClient()
+            try:
+                # The stale-view announce lands on the non-owner.
+                body = await http.post(
+                    f"http://{non_owner.addr}/announce",
+                    data=json.dumps({
+                        "info_hash": h.hex, "peer": _peer(7).to_dict(),
+                    }),
+                )
+                assert json.loads(body)["interval"] > 0
+                # Accepted locally (never an error; handout from the
+                # local store works immediately)...
+                assert h.hex in non_owner.server.peers._swarms
+                # ...and forwarded: the owner's store learns the peer.
+                for _ in range(100):
+                    if h.hex in owner_node.server.peers._swarms:
+                        break
+                    await asyncio.sleep(0.02)
+                swarm = owner_node.server.peers._swarms.get(h.hex, {})
+                assert _pid(7).hex in swarm
+                # Fresh-view clients asking the owner get the peer.
+                body = await http.post(
+                    f"http://{owner}/announce",
+                    data=json.dumps({
+                        "info_hash": h.hex, "peer": _peer(8).to_dict(),
+                    }),
+                )
+                handed = json.loads(body)["peers"]
+                assert any(p["peer_id"] == _pid(7).hex for p in handed)
+            finally:
+                await http.close()
+        finally:
+            for t in trackers:
+                await t.stop()
+
+    asyncio.run(main())
+
+
+def test_forwarded_announces_are_not_reforwarded(tmp_path):
+    """The X-Kraken-Forwarded marker stops forwarding loops: a tracker
+    whose fleet view disagrees must not bounce one announce around the
+    fleet forever."""
+
+    async def main():
+        server = TrackerServer(
+            fleet_addrs=["other:1", "me:2"], self_addr="me:2",
+        )
+        forwarded = []
+        server._maybe_forward = (
+            lambda ih, doc: forwarded.append(ih)
+        )
+
+        class Req:
+            headers = {"X-Kraken-Forwarded": "1"}
+
+            async def json(self):
+                return {"info_hash": "ab" * 32,
+                        "peer": _peer(1).to_dict()}
+
+        resp = await server._announce_inner(Req())
+        assert resp.status == 200
+        assert forwarded == []  # marker honored
+        await server.close()
+
+    asyncio.run(main())
+
+
+def test_tracker_lameduck_drains_and_fleet_routes_around(tmp_path):
+    """The PR-5 drain contract on trackers: POST /debug/lameduck flips
+    /health AND /announce to 503+Retry-After, and a fleet client simply
+    fails over -- the rolling-restart runbook's step 1."""
+
+    async def main():
+        trackers, addrs = await _start_trackers(2)
+        client = _fleet_client(addrs)
+        h = InfoHash("bb" * 32)
+        owner = rendezvous_hash(h.hex, addrs, k=1)[0]
+        victim = next(t for t in trackers if t.addr == owner)
+        http = HTTPClient(retries=0)
+        try:
+            await client.announce(None, h, NS, complete=True)
+            body = await http.post(f"http://{victim.addr}/debug/lameduck")
+            assert json.loads(body)["lameduck"] is True
+            # /health and /announce refuse with the drain 503.
+            for path, method in (("/health", "GET"), ("/announce", "POST")):
+                status, headers, _ = await http.request_full(
+                    method, f"http://{victim.addr}{path}",
+                    data=json.dumps({"info_hash": h.hex,
+                                     "peer": _peer(3).to_dict()})
+                    if method == "POST" else None,
+                    ok_statuses=(503,), retry_5xx=False,
+                )
+                assert status == 503 and "Retry-After" in headers
+            # The fleet shrugs: the owner's drain 503 walks to the peer.
+            peers, interval = await client.announce(
+                None, h, NS, complete=False
+            )
+            assert interval > 0
+        finally:
+            await http.close()
+            await client.close()
+            for t in trackers:
+                await t.stop()
+
+    asyncio.run(main())
+
+
+# -- the acceptance herd: 3 trackers + origin + agent, kill one mid-pull -----
+
+
+def test_kill_one_of_three_trackers_mid_pull_completes_bit_identical(tmp_path):
+    """THE acceptance scenario: a real 3-tracker fleet fronting an
+    origin and an agent; the blob's announce shard owner dies MID-PULL;
+    the pull completes bit-identically with zero intervention, and the
+    dead tracker's breaker state is visible on the agent's
+    /debug/healthcheck."""
+
+    async def main():
+        from kraken_tpu.origin.metainfogen import PieceLengthConfig
+
+        trackers, addrs = await _start_trackers(3)
+        fleet_spec = ",".join(addrs)
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"), tracker_addr=fleet_spec,
+            # Small pieces: the agent's ingress token bucket can only
+            # pace requests <= its capacity (oversize frames pass whole).
+            piece_lengths=PieceLengthConfig(table=((0, 65536),)),
+        )
+        await origin.start()
+        ring = Ring(HostList(static=[origin.addr]), max_replica=2)
+        cluster = ClusterClient(ring)
+        for t in trackers:
+            t.server.origin_cluster = cluster
+        agent = AgentNode(
+            store_root=str(tmp_path / "agent"), tracker_addr=fleet_spec,
+            # Throttle the pull so the tracker death lands mid-transfer
+            # (the token bucket's initial burst = 1 s of rate, so a
+            # 1.2 MB blob takes ~5 s at this cap).
+            p2p_bandwidth={"ingress_bps": 200_000, "egress_bps": 0},
+        )
+        await agent.start()
+        assert isinstance(agent._tracker_client, TrackerFleetClient)
+        assert isinstance(origin._tracker_client, TrackerFleetClient)
+        http = HTTPClient(timeout_seconds=120.0)
+        victim = None
+        try:
+            blob = os.urandom(1_200_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(origin.addr)
+            await oc.upload(NS, d, blob, chunk_size=400_000)
+            mi = await oc.get_metainfo(NS, d)
+            await oc.close()
+            owner = rendezvous_hash(mi.info_hash.hex, addrs, k=1)[0]
+            victim = next(t for t in trackers if t.addr == owner)
+
+            pull = asyncio.create_task(http.get(
+                f"http://{agent.addr}/namespace/"
+                f"{NS.replace('/', '%2F')}/blobs/{d.hex}"
+            ))
+            # Let the pull engage (metainfo + announce + first pieces),
+            # then kill the swarm's announce shard owner.
+            await asyncio.sleep(0.6)
+            assert not pull.done()
+            await victim.stop()
+
+            got = await asyncio.wait_for(pull, timeout=90)
+            assert got == blob  # bit-identical through the tracker death
+
+            # Failover is observable: subsequent announces route around
+            # the dead owner, and the breaker surface the operators read
+            # (GET /debug/healthcheck on the agent) names it.
+            for _ in range(200):
+                snap = json.loads(await http.get(
+                    f"http://{agent.addr}/debug/healthcheck"
+                ))
+                fleet = {
+                    name: doc for name, doc in snap.items()
+                    if owner in doc.get("hosts", {})
+                }
+                if fleet:
+                    break
+                await asyncio.sleep(0.05)
+            assert fleet, f"dead tracker absent from breaker surface: {snap}"
+        finally:
+            await http.close()
+            await agent.stop()
+            await origin.stop()
+            await cluster.close()
+            for t in trackers:
+                if t is not victim:
+                    await t.stop()
+
+    asyncio.run(main())
