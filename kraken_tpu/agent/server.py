@@ -88,11 +88,13 @@ class AgentServer(LameduckMixin):
                 self._inflight_downloads -= 1
         if self.cleanup is not None:
             self.cleanup.touch(d)  # feed the eviction clock (throttled)
-        # sendfile from the cache: O(1) request memory for any blob size.
-        return web.FileResponse(
-            self.store.cache_path(d),
-            headers={"Content-Type": "application/octet-stream"},
-        )
+        # One Range-capable streaming path over BOTH storage
+        # representations (store/serve.py): the reader opens the flat
+        # fd or the chunk manifest atomically, so the post-pull
+        # chunk-tier conversion racing this serve can never 404/500 it.
+        from kraken_tpu.store.serve import blob_response
+
+        return await blob_response(req, self.store, d)
 
     async def _stat(self, req: web.Request) -> web.Response:
         d = self._digest(req)

@@ -54,6 +54,7 @@ from kraken_tpu.p2p.storage import (
     OriginTorrentArchive,
 )
 from kraken_tpu.store import CAStore
+from kraken_tpu.store.chunkstore import ChunkGC, ChunkStore, ChunkStoreConfig
 from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 from kraken_tpu.store.recovery import run_fsck, write_clean_shutdown
 from kraken_tpu.store.scrub import ScrubConfig, Scrubber
@@ -180,6 +181,48 @@ def _profiling_config(profiling) -> ProfilerConfig:
     if isinstance(profiling, ProfilerConfig):
         return profiling
     return ProfilerConfig.from_dict(profiling)
+
+
+def _chunkstore_config(chunkstore) -> ChunkStoreConfig:
+    """Same normalization for the YAML ``chunkstore:`` section."""
+    if isinstance(chunkstore, ChunkStoreConfig):
+        return chunkstore
+    return ChunkStoreConfig.from_dict(chunkstore)
+
+
+def _sync_chunkstore(node) -> None:
+    """Attach (or re-configure) a node's chunk tier to match its
+    ``chunkstore:`` config -- at construction AND on SIGHUP reload.
+    The tier object attaches when the knob is on OR when the tier
+    directory already holds state: a node restarted with the knob
+    turned off must keep serving its manifest-backed blobs (disabling
+    gates NEW conversions only; the runbook's rollback path is
+    materialize-or-repull, docs/OPERATIONS.md "Chunk store")."""
+    store: CAStore = node.store
+    cfg: ChunkStoreConfig = node.chunkstore_config
+    if store.chunkstore is not None:
+        store.chunkstore.config = cfg
+        return
+    chunks_root = os.path.join(store.root, "chunks")
+    if cfg.enabled or os.path.isdir(chunks_root):
+        store.attach_chunkstore(ChunkStore(
+            chunks_root, cfg,
+            quarantine_dir=store.quarantine_dir,
+            durability=store.durability,
+        ))
+
+
+def _sync_chunk_gc(node) -> None:
+    """Start the budgeted zero-ref reaper once a tier is attached and a
+    loop is running (start() and the live-enable reload path)."""
+    if node.store.chunkstore is None or node.chunk_gc is not None:
+        return
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return  # offline reload: the next start() picks it up
+    node.chunk_gc = ChunkGC(node.store.chunkstore)
+    node.chunk_gc.start()
 
 
 def _apply_profiling(component: str, cfg: ProfilerConfig,
@@ -527,6 +570,7 @@ class OriginNode:
         trace: dict | TraceConfig | None = None,
         delta: dict | DeltaConfig | None = None,
         profiling: dict | ProfilerConfig | None = None,
+        chunkstore: dict | ChunkStoreConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -535,6 +579,14 @@ class OriginNode:
         self.p2p_port = p2p_port
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root, durability=durability)
+        # Content-addressed chunk tier (store/chunkstore.py): keep each
+        # chunk once, serve blobs as manifests. YAML `chunkstore:`;
+        # shipped OFF; SIGHUP live-reloads (enable = attach + convert
+        # from the next dedup pass on). Attached BEFORE fsck so the
+        # startup pass covers the tier.
+        self.chunkstore_config = _chunkstore_config(chunkstore)
+        self.chunk_gc: Optional[ChunkGC] = None
+        _sync_chunkstore(self)
         self.hasher_name = hasher
         # hash_workers sizes the HOST piece-hash pool (cpu hasher only;
         # device hashers parallelize over the batch axis instead). 1 =
@@ -786,6 +838,9 @@ class OriginNode:
         # (utils/resources.py); budgets from the YAML `resources:`
         # section, surfaced on /debug/resources and /metrics.
         self.sentinel = _start_sentinel(self, "origin")
+        # Chunk-tier GC: budgeted zero-ref chunk reaper (watermark
+        # pressure bypasses the budget inside the cleanup sweep).
+        _sync_chunk_gc(self)
         # Seed everything already on disk (origin startup behavior). A blob
         # whose metainfo sidecar was lost (partial disk restore, manual
         # cleanup) gets its metainfo REGENERATED -- otherwise it would stay
@@ -868,6 +923,14 @@ class OriginNode:
                 self.store.root,
             )
             _sync_loop_monitor(self, "origin")
+        if cfg.get("chunkstore") is not None:
+            # Live enable = rollout step (attach tier + start GC; new
+            # blobs convert from the next dedup pass). Live disable
+            # stops NEW conversions only -- manifest-backed blobs keep
+            # serving.
+            self.chunkstore_config = _chunkstore_config(cfg["chunkstore"])
+            _sync_chunkstore(self)
+            _sync_chunk_gc(self)
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -1022,6 +1085,9 @@ class OriginNode:
             self.loop_monitor.stop()
         if self.scrubber:
             self.scrubber.stop()
+        if self.chunk_gc:
+            self.chunk_gc.stop()
+            self.chunk_gc = None
         for t in list(self._repair_tasks):
             t.cancel()
         self.retry.stop()
@@ -1191,6 +1257,7 @@ class AgentNode:
         trace: dict | TraceConfig | None = None,
         delta: dict | DeltaConfig | None = None,
         profiling: dict | ProfilerConfig | None = None,
+        chunkstore: dict | ChunkStoreConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -1203,6 +1270,14 @@ class AgentNode:
         self.build_index_addr = build_index_addr
         self.tracker_addr = tracker_addr
         self.store = CAStore(store_root, durability=durability)
+        # Content-addressed chunk tier (store/chunkstore.py): completed
+        # pulls whose recipe the delta planner fetched convert to
+        # manifest + refcounted chunks -- agents are the tier's FIRST
+        # rollout ring (OPERATIONS.md runbook). YAML `chunkstore:`;
+        # shipped OFF; SIGHUP live-reloads. Attached before fsck.
+        self.chunkstore_config = _chunkstore_config(chunkstore)
+        self.chunk_gc: Optional[ChunkGC] = None
+        _sync_chunkstore(self)
         # CPU verify: one-tick batching (per-piece hashlib is cheap; a
         # fixed window only adds latency). TPU verify: keep a 2 ms window
         # so arrivals coalesce into real device batches -- a batch-of-1
@@ -1381,6 +1456,7 @@ class AgentNode:
             )
             self.scrubber.start()
         self.sentinel = _start_sentinel(self, "agent")
+        _sync_chunk_gc(self)
         if self.build_index_addr:
             from kraken_tpu.buildindex.server import TagClient
             from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -1439,6 +1515,13 @@ class AgentNode:
                 self.store.root,
             )
             _sync_loop_monitor(self, "agent")
+        if cfg.get("chunkstore") is not None:
+            # Agents-first rollout: SIGHUP-enable attaches the tier and
+            # converts from the next completed pull on; disable stops
+            # new conversions, manifest-backed blobs keep serving.
+            self.chunkstore_config = _chunkstore_config(cfg["chunkstore"])
+            _sync_chunkstore(self)
+            _sync_chunk_gc(self)
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,
@@ -1464,6 +1547,9 @@ class AgentNode:
             self.loop_monitor.stop()
         if self.scrubber:
             self.scrubber.stop()
+        if self.chunk_gc:
+            self.chunk_gc.stop()
+            self.chunk_gc = None
         if self.scheduler:
             await self.scheduler.stop()
         if self._runner:

@@ -482,8 +482,19 @@ def main(argv: list[str] | None = None) -> None:
                 "message": f"store root does not exist: {args.root}",
             }), flush=True)
             sys.exit(3)
+        store = CAStore(args.root)
+        # Attach the chunk tier when the store has one: the offline
+        # fsck must cover manifests/refcounts/orphan chunks exactly as
+        # the startup pass does (exit codes gate deploys either way).
+        chunks_root = os.path.join(args.root, "chunks")
+        if os.path.isdir(chunks_root):
+            from kraken_tpu.store.chunkstore import ChunkStore
+
+            store.attach_chunkstore(ChunkStore(
+                chunks_root, quarantine_dir=store.quarantine_dir
+            ))
         report = run_fsck(
-            CAStore(args.root),
+            store,
             upload_ttl_seconds=args.upload_ttl,
             expect_namespace=args.expect_namespace,
             verify=args.verify,
@@ -801,6 +812,10 @@ def main(argv: list[str] | None = None) -> None:
             # ...} -- the continuous-profiling plane (docs/OPERATIONS.md
             # "Continuous profiling"). SIGHUP live-reloads.
             profiling=cfg.get("profiling"),
+            # YAML: chunkstore: {enabled, min_blob_bytes, gc_*} -- the
+            # content-addressed chunk tier (docs/OPERATIONS.md "Chunk
+            # store"). Shipped off; origins opt in AFTER the agent soak.
+            chunkstore=cfg.get("chunkstore"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -848,6 +863,9 @@ def main(argv: list[str] | None = None) -> None:
             delta=cfg.get("delta"),
             # YAML: profiling: -- the continuous-profiling plane.
             profiling=cfg.get("profiling"),
+            # YAML: chunkstore: -- the content-addressed chunk tier
+            # (agents are the first rollout ring; shipped off).
+            chunkstore=cfg.get("chunkstore"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -286,6 +286,16 @@ class ChunkRecipe:
     def num_chunks(self) -> int:
         return len(self._fps)
 
+    @property
+    def fps(self) -> tuple:
+        """Per-chunk fingerprints in blob order (the chunk tier's
+        manifest table shares this derivation)."""
+        return self._fps
+
+    @property
+    def sizes(self) -> tuple:
+        return self._sizes
+
     def chunks(self) -> Iterator[tuple[int, int, int]]:
         """Yield ``(fp, offset, size)`` in blob order."""
         off = 0

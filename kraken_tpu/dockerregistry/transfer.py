@@ -9,6 +9,7 @@ put + replicate) -- upstream path, unverified; SURVEY.md SS2.4.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import tempfile
 import uuid as uuidlib
@@ -78,9 +79,24 @@ class ReadOnlyTransferer:
     async def download_path(
         self, namespace: str, d: Digest
     ) -> tuple[str, bool]:
-        """(cache path, is_temp=False): blobs stream straight off the CAStore."""
+        """(cache path, is_temp=False): blobs stream straight off the
+        CAStore. A CHUNK-backed blob (store/chunkstore.py) has no flat
+        path to hand to FileResponse -- export a temp flat copy and
+        return it as is_temp=True, which the registry's streaming
+        branch serves with Range support and unlinks afterwards."""
         await self._ensure_local(namespace, d)
-        return self.store.cache_path(d), False
+        path = self.store.cache_path(d)
+        if os.path.exists(path):
+            return path, False
+        fd, tmp = tempfile.mkstemp(prefix="kraken-registry-")
+        os.close(fd)
+        try:
+            await asyncio.to_thread(self.store.export_to_file, d, tmp)
+        except Exception:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+        return tmp, True
 
     async def upload(self, namespace: str, d: Digest, data: bytes) -> None:
         raise PermissionError("agent registry is read-only; push via the proxy")

@@ -158,6 +158,30 @@ class BlobClient:
                     break  # zero-length blob: one empty PATCH
         await self._commit_upload(namespace, d, uid)
 
+    async def upload_from_store(
+        self, namespace: str, d: Digest, store,
+        chunk_size: int = 16 * 1024 * 1024,
+    ) -> None:
+        """Chunked upload streamed straight from a CAStore -- works for
+        flat AND chunk-backed blobs (``open_cache_file`` composes the
+        tier's reads), so replication of a manifest-backed blob never
+        needs a flat copy on disk. O(chunk) memory either way."""
+        uid = await self._start_upload(namespace, d)
+        off = 0
+        f = store.open_cache_file(d)  # KeyError when absent
+        try:
+            while True:
+                chunk = await asyncio.to_thread(f.read, chunk_size)
+                if not chunk and off > 0:
+                    break
+                await self._patch_chunk(namespace, d, uid, off, chunk)
+                off += len(chunk)
+                if not chunk:
+                    break  # zero-length blob: one empty PATCH
+        finally:
+            f.close()
+        await self._commit_upload(namespace, d, uid)
+
     async def _start_upload(self, namespace: str, d: Digest) -> str:
         body = await self._http.post(
             self._url(f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}/uploads")

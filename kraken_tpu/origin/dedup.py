@@ -347,8 +347,18 @@ class DedupIndex:
             # sequentially, so the heap stays O(chunk) and the pages are
             # reclaimable file cache even for multi-GiB layers.
             with self.store.open_cache_file(d) as f:  # KeyError if absent
-                size = os.fstat(f.fileno()).st_size
-                if size == 0:
+                try:
+                    fileno = f.fileno()
+                except OSError:
+                    # Chunk-backed blob (no single fd to mmap): rare --
+                    # a chunked blob normally HAS its sketch sidecar
+                    # (the recipe that chunked it came from one) -- so
+                    # buffering the composed read is acceptable here.
+                    record = self._compute_record(f.read())
+                    fileno = None
+                if fileno is None:
+                    pass
+                elif os.fstat(fileno).st_size == 0:
                     record = self._compute_record(b"")
                 else:
                     # Manual lifecycle, not `with`: the continuous
@@ -466,6 +476,16 @@ class DedupIndex:
                 self._admit(d, record)
                 n += 1
         return n
+
+    def chunk_table(self, d: Digest) -> tuple[list[int], list[int]] | None:
+        """The blob's persisted ``(fps, sizes)`` chunk table, or None
+        when no sketch sidecar exists -- what the origin's chunk-tier
+        conversion feeds ``CAStore.convert_to_chunks`` (one derivation
+        shared with the dedup ledger and the delta recipes)."""
+        record = self._load_record(d)
+        if record is None:
+            return None
+        return record.fps.tolist(), record.sizes.tolist()
 
     # -- chunk recipes (delta-transfer plane) -------------------------------
 

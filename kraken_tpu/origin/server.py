@@ -587,6 +587,7 @@ class OriginServer(LameduckMixin):
         async def run():
             try:
                 await self.dedup.add_blob(d)
+                await self._maybe_convert_to_chunks(d)
             except DedupEvictionRace:
                 # Benign: eviction/DELETE won the race; the blob is gone
                 # and must not be indexed. Counted apart from real
@@ -606,6 +607,44 @@ class OriginServer(LameduckMixin):
         task = asyncio.create_task(run())
         self._dedup_tasks.add(task)
         task.add_done_callback(self._dedup_tasks.discard)
+
+    async def _maybe_convert_to_chunks(self, d: Digest) -> None:
+        """Origin-side chunk-tier handover (store/chunkstore.py): once
+        the dedup pass persisted the blob's chunk table, convert the
+        flat blob to manifest + refcounted chunks -- near-duplicate
+        builds then cost unique bytes at rest on the origin too. Gated
+        on ``chunkstore.enabled`` (origins opt in AFTER the agent soak
+        -- OPERATIONS.md runbook); every read/serve/replicate path is
+        chunk-aware, and a conversion failure just leaves the blob
+        flat."""
+        cs = getattr(self.store, "chunkstore", None)
+        if cs is None or not cs.config.enabled or self.dedup is None:
+            return
+        try:
+            if self.store.cache_size(d) < cs.config.min_blob_bytes:
+                return
+        except KeyError:
+            return
+        table = await asyncio.to_thread(self.dedup.chunk_table, d)
+        if table is None:
+            return
+        converts = REGISTRY.counter(
+            "chunkstore_converts_total",
+            "Completed pulls converted to manifest + refcounted chunks, "
+            "by outcome (converted / skipped / mismatch / error)",
+        )
+        res = await asyncio.to_thread(
+            self.store.convert_to_chunks, d, table[0], table[1]
+        )
+        if res is None:
+            converts.inc(outcome="mismatch")
+            return
+        converts.inc(outcome="converted")
+        _log.info(
+            "blob converted to chunk tier",
+            extra={"digest": d.hex, "new_bytes": res["new_bytes"],
+                   "dup_bytes": res["dup_bytes"]},
+        )
 
     # -- replication to ring peers -----------------------------------------
 
@@ -702,9 +741,11 @@ class OriginServer(LameduckMixin):
         peer = BlobClient(addr)
         try:
             if await peer.stat(ns, d) is None:
-                # Stream from disk: replication of a 10 GiB layer must not
-                # hold the layer in RAM.
-                await peer.upload_from_file(ns, d, self.store.cache_path(d))
+                # Stream from the store: replication of a 10 GiB layer
+                # must not hold the layer in RAM -- and a chunk-backed
+                # blob streams through its composed reader, no flat
+                # copy needed.
+                await peer.upload_from_store(ns, d, self.store)
         finally:
             await peer.close()
         self._unpin_if_last_replication(d)
@@ -937,11 +978,15 @@ class OriginServer(LameduckMixin):
         d = self._digest(req)
         await self._ensure_local(ns, d)
         self._touch(d)
-        # sendfile from the cache: O(1) request memory for any blob size.
-        return web.FileResponse(
-            self.store.cache_path(d),
-            headers={"Content-Type": "application/octet-stream"},
-        )
+        # One Range-capable streaming path over BOTH storage
+        # representations (store/serve.py): the reader opens the flat
+        # fd or the chunk manifest atomically, so a chunk-tier
+        # conversion racing this request can never 404/500 it. O(1)
+        # request memory for any blob size; the delta planner's
+        # need-span 206s serve from either representation.
+        from kraken_tpu.store.serve import blob_response
+
+        return await blob_response(req, self.store, d)
 
     async def _metainfo(self, req: web.Request) -> web.Response:
         await self._brownout_gate()

@@ -9,6 +9,7 @@ SURVEY.md SS2.3/SS3.2.
 from __future__ import annotations
 
 import asyncio
+import os
 
 from kraken_tpu.backend import Manager as BackendManager
 from kraken_tpu.core.digest import Digest
@@ -58,8 +59,28 @@ class WritebackExecutor:
         client = self.backends.get_client(namespace)
         # File-based: backends stream/multipart it (S3), or buffer via the
         # base-class default; either way writeback never holds a layer in
-        # RAM itself. The backend owns pathing.
-        await client.upload_file(namespace, d.hex, self.store.cache_path(d))
+        # RAM itself. The backend owns pathing. A chunk-backed blob has
+        # no flat path to hand over -- materialize a temporary flat copy
+        # in the upload spool (the export escape hatch), upload, drop it.
+        path = self.store.cache_path(d)
+        uploaded = False
+        if os.path.exists(path):
+            try:
+                await client.upload_file(namespace, d.hex, path)
+                uploaded = True
+            except FileNotFoundError:
+                # A chunk-tier conversion unlinked the flat file between
+                # the check and the backend's open: fall through to the
+                # export path -- the bytes are fully readable.
+                pass
+        if not uploaded:
+            uid = self.store.create_upload()
+            tmp = self.store.upload_path(uid)
+            try:
+                await asyncio.to_thread(self.store.export_to_file, d, tmp)
+                await client.upload_file(namespace, d.hex, tmp)
+            finally:
+                self.store.abort_upload(uid)
         # Landed durably: drop the writeback pin -- but only once no OTHER
         # pending writeback references this blob (the pin is a reason-set,
         # not a counter: the first namespace's writeback landing must not

@@ -36,7 +36,6 @@ import asyncio
 import dataclasses
 import hashlib
 import logging
-import os
 from typing import NamedTuple, Protocol
 
 from kraken_tpu.core.digest import Digest
@@ -101,21 +100,24 @@ class DeltaClient(Protocol):
 
 
 class HaveSpan(NamedTuple):
-    """One target chunk the base also holds: copy ``size`` bytes from
-    ``base_off`` in the base blob to ``target_off`` in the target, valid
-    only if the copied bytes still hash to ``fp``."""
+    """One target chunk a cached base also holds: copy ``size`` bytes
+    from ``base_off`` in base number ``base`` (index into the pull's
+    selected-base list) to ``target_off`` in the target, valid only if
+    the copied bytes still hash to ``fp``."""
 
     target_off: int
     size: int
     base_off: int
     fp: int
+    base: int = 0
 
 
 def diff_recipes(
     target: ChunkRecipe, base: ChunkRecipe
 ) -> tuple[list[HaveSpan], list[tuple[int, int]]]:
-    """Partition the target blob against a base: per-chunk ``have`` spans
-    (fp-verifiable copies) and merged ``(offset, size)`` ``need`` spans.
+    """Partition the target blob against ONE base: per-chunk ``have``
+    spans (fp-verifiable copies) and merged ``(offset, size)`` ``need``
+    spans. The single-base view of :func:`diff_recipes_multi`.
 
     Invariant (property-tested): the have spans plus the need spans tile
     ``[0, target.length)`` exactly -- no overlap, no gap. Matching is by
@@ -123,20 +125,68 @@ def diff_recipes(
     chunks therefore cannot mispair, and a same-size collision is caught
     by the copy-time re-hash.
     """
-    base_map: dict[tuple[int, int], int] = {}
-    for fp, off, size in base.chunks():
-        base_map.setdefault((fp, size), off)
+    return diff_recipes_multi(target, [base])
+
+
+def diff_recipes_multi(
+    target: ChunkRecipe, bases: list[ChunkRecipe]
+) -> tuple[list[HaveSpan], list[tuple[int, int]]]:
+    """Partition the target against the UNION of several bases: each
+    target chunk copies from the first base (in list order) that holds
+    its ``(fp, size)``; chunks no base holds merge into need spans. The
+    same tiling invariant as the single-base diff, property-tested over
+    both."""
+    base_map: dict[tuple[int, int], tuple[int, int]] = {}
+    for i, base in enumerate(bases):
+        for fp, off, size in base.chunks():
+            base_map.setdefault((fp, size), (i, off))
     haves: list[HaveSpan] = []
     needs: list[tuple[int, int]] = []
     for fp, off, size in target.chunks():
         b = base_map.get((fp, size))
         if b is not None:
-            haves.append(HaveSpan(off, size, b, fp))
+            haves.append(HaveSpan(off, size, b[1], fp, b[0]))
         elif needs and needs[-1][0] + needs[-1][1] == off:
             needs[-1] = (needs[-1][0], needs[-1][1] + size)
         else:
             needs.append((off, size))
     return haves, needs
+
+
+def pick_cover_bases(
+    target: ChunkRecipe,
+    candidates: list[tuple[Digest, ChunkRecipe]],
+    max_bases: int,
+) -> list[tuple[Digest, ChunkRecipe]]:
+    """Greedy set-cover over recipe fps: repeatedly take the candidate
+    adding the most not-yet-covered target bytes, stop at ``max_bases``
+    or zero marginal gain. Build-over-build corpora split shared content
+    across SEVERAL cached prior builds -- union coverage is the ROADMAP
+    ceiling (0.25-0.51 vs 0.16-0.28 single-base on the headline corpus).
+    Greedy is the classic ln(n)-approximation and exact for the common
+    two-base case."""
+    remaining: dict[tuple[int, int], int] = {}
+    for fp, _off, size in target.chunks():
+        key = (fp, size)
+        remaining[key] = remaining.get(key, 0) + size
+    cand_keys = [
+        (d, recipe, {(fp, size) for fp, _o, size in recipe.chunks()})
+        for d, recipe in candidates
+    ]
+    picked: list[tuple[Digest, ChunkRecipe]] = []
+    while len(picked) < max_bases and cand_keys and remaining:
+        best_i, best_gain = -1, 0
+        for i, (_d, _r, keys) in enumerate(cand_keys):
+            gain = sum(remaining.get(k, 0) for k in keys)
+            if gain > best_gain:
+                best_i, best_gain = i, gain
+        if best_i < 0:
+            break
+        d, recipe, keys = cand_keys.pop(best_i)
+        picked.append((d, recipe))
+        for k in keys:
+            remaining.pop(k, None)
+    return picked
 
 
 class _RangeUnsupported(Exception):
@@ -195,6 +245,27 @@ class DeltaPlanner:
             "Delta-assembled pieces that failed the piece-hash verify "
             "and fell back to the swarm",
         )
+        self._bases_used = REGISTRY.counter(
+            "delta_bases_used_total",
+            "Cached near-duplicate bases the multi-base planner copied "
+            "from, summed over delta pulls (>1 per pull = union cover)",
+        )
+        self._converts = REGISTRY.counter(
+            "chunkstore_converts_total",
+            "Completed pulls converted to manifest + refcounted chunks, "
+            "by outcome (converted / skipped / mismatch / error)",
+        )
+        # Recipes this planner fetched recently, kept for the chunk-tier
+        # handover: a completed pull converts to manifest + chunks using
+        # the SAME table the plan used -- no re-fetch, no re-chunk.
+        self._recipes: dict[str, ChunkRecipe] = {}
+
+    _RECIPE_KEEP = 128
+
+    def _remember_recipe(self, recipe: ChunkRecipe) -> None:
+        self._recipes[recipe.digest.hex] = recipe
+        while len(self._recipes) > self._RECIPE_KEEP:
+            self._recipes.pop(next(iter(self._recipes)))
 
     async def close(self) -> None:
         await self._http.close()
@@ -234,23 +305,33 @@ class DeltaPlanner:
                 self._recipe_misses.inc(side="target")
                 self._pulls.inc(outcome="recipe_miss")
                 return None
-            picked = await self._pick_base(namespace, d, target)
-            if picked is None:
+            # Remember the validated recipe for the chunk-tier handover
+            # (chunk_completed) -- even a no-base first pull converts.
+            self._remember_recipe(target)
+            picked = await self._pick_bases(namespace, d, target)
+            if not picked:
                 self._pulls.inc(outcome="no_base")
                 return None
-            base_d, haves = picked
+            bases = [b for b, _r in picked]
+            haves, _needs = diff_recipes_multi(
+                target, [r for _b, r in picked]
+            )
             if sp is not None:
                 sp.set(
-                    base=base_d.hex[:12],
+                    base=bases[0].hex[:12],
+                    bases=len(bases),
                     have_bytes=sum(h.size for h in haves),
                 )
         if failpoints.fire("p2p.delta.base.evict"):
             # Model cache eviction racing the plan->copy window: the base
             # bytes vanish under the planner, which must fall back to the
             # full swarm pull cleanly (tests/test_delta.py chaos tier).
-            self.store.delete_cache_file(base_d)
+            for b in bases:
+                self.store.delete_cache_file(b)
         result = {
-            "base": base_d.hex,
+            "base": bases[0].hex,
+            "bases": [b.hex for b in bases],
+            "bases_used": 0,
             "pieces": 0,
             "copied": 0,
             "fetched": 0,
@@ -259,7 +340,7 @@ class DeltaPlanner:
         try:
             if not torrent.complete():
                 await self._assemble(
-                    torrent, metainfo, namespace, base_d, haves,
+                    torrent, metainfo, namespace, bases, haves,
                     origin_addr, result,
                 )
                 # Hand progress over NOW: the scheduler builds a fresh
@@ -271,11 +352,13 @@ class DeltaPlanner:
         self._pulls.inc(outcome="delta" if result["pieces"] else "no_cover")
         self._copied.inc(result["copied"])
         self._fetched.inc(result["fetched"])
+        self._bases_used.inc(result["bases_used"])
         _log.info(
             "delta prefill",
             extra={
                 "digest": d.hex,
-                "base": base_d.hex,
+                "bases": result["bases"],
+                "bases_used": result["bases_used"],
                 "pieces": result["pieces"],
                 "copied_bytes": result["copied"],
                 "fetched_bytes": result["fetched"],
@@ -283,10 +366,19 @@ class DeltaPlanner:
         )
         return result
 
-    async def _pick_base(
+    async def _pick_bases(
         self, namespace: str, d: Digest, target: ChunkRecipe
-    ) -> tuple[Digest, list[HaveSpan]] | None:
-        """Best locally-held /similar candidate by covered bytes."""
+    ) -> list[tuple[Digest, ChunkRecipe]]:
+        """Locally-held /similar candidates, greedy set-cover selected.
+
+        Up to ``2 * max_bases`` cached candidates fetch recipes (the
+        selection needs slack to beat best-of-N), then
+        :func:`pick_cover_bases` keeps the ``max_bases`` whose UNION
+        covers the most target bytes -- several prior builds each
+        holding a different slice of the target beat the single best
+        base (ROADMAP item 2's multi-base ceiling). Candidates whose
+        manifest/recipe fetch fails just drop out; zero usable
+        candidates = full pull."""
         try:
             sims = await self.client.similar(namespace, d)
         except Exception as e:
@@ -294,10 +386,8 @@ class DeltaPlanner:
                 "delta: /similar unavailable; full pull",
                 extra={"digest": d.hex, "error": repr(e)},
             )
-            return None
-        best: tuple[Digest, list[HaveSpan]] | None = None
-        best_cover = 0
-        tried = 0
+            return []
+        candidates: list[tuple[Digest, ChunkRecipe]] = []
         for s in sims:
             try:
                 score = float(s.get("score", 0.0))
@@ -308,8 +398,7 @@ class DeltaPlanner:
                 continue
             if not self.store.in_cache(base_d):
                 continue
-            tried += 1
-            if tried > self.config.max_bases:
+            if len(candidates) >= 2 * self.config.max_bases:
                 break
             try:
                 base_recipe, _addr = await self.client.get_recipe(
@@ -318,11 +407,55 @@ class DeltaPlanner:
             except Exception:
                 self._recipe_misses.inc(side="base")
                 continue
-            haves, _needs = diff_recipes(target, base_recipe)
-            cover = sum(h.size for h in haves)
-            if cover > best_cover:
-                best, best_cover = (base_d, haves), cover
-        return best if best_cover > 0 else None
+            candidates.append((base_d, base_recipe))
+        return pick_cover_bases(target, candidates, self.config.max_bases)
+
+    # -- chunk-tier handover ------------------------------------------------
+
+    async def chunk_completed(self, metainfo: MetaInfo, namespace: str) -> dict | None:
+        """Convert a just-completed pull into the chunk tier (manifest +
+        refcounted chunks) using the recipe the prefill fetched -- the
+        scheduler calls this after every download when the tier is
+        enabled. A near-duplicate of a cached build then stores only its
+        unique chunks at rest, and the flat file the swarm wrote is
+        dropped. Failures (recipe absent, fp/byte mismatch, tier IO)
+        leave the blob flat -- conversion is an optimization, never a
+        durability change."""
+        cs = getattr(self.store, "chunkstore", None)
+        if cs is None or not cs.config.enabled:
+            return None
+        d = metainfo.digest
+        if metainfo.length < cs.config.min_blob_bytes:
+            return None
+        recipe = self._recipes.get(d.hex)
+        if recipe is None or recipe.length != metainfo.length:
+            return None
+        with trace.span(
+            "delta.chunk_convert", digest=d.hex[:12], namespace=namespace
+        ):
+            try:
+                res = await asyncio.to_thread(
+                    self.store.convert_to_chunks,
+                    d, list(recipe.fps), list(recipe.sizes),
+                )
+            except Exception:
+                self._converts.inc(outcome="error")
+                raise
+        if res is None:
+            # Absent / already chunked / recipe-byte mismatch: the
+            # store kept whichever representation it had.
+            self._converts.inc(outcome="mismatch")
+            return None
+        self._converts.inc(outcome="converted")
+        _log.info(
+            "blob converted to chunk tier",
+            extra={
+                "digest": d.hex,
+                "new_bytes": res["new_bytes"],
+                "dup_bytes": res["dup_bytes"],
+            },
+        )
+        return res
 
     # -- copy + fetch -------------------------------------------------------
 
@@ -331,7 +464,7 @@ class DeltaPlanner:
         torrent,
         metainfo: MetaInfo,
         namespace: str,
-        base_d: Digest,
+        bases: list[Digest],
         haves: list[HaveSpan],
         origin_addr: str,
         result: dict,
@@ -350,17 +483,27 @@ class DeltaPlanner:
             if origin_addr
             else ""
         )
-        try:
-            base_fd = self.store.open_cache_fd(base_d)
-        except KeyError:
-            # Base evicted between plan and copy: nothing to copy -- the
-            # swarm takes the whole pull. (An eviction AFTER this open is
-            # harmless: the fd pins the immutable bytes past the unlink.)
-            _log.debug(
-                "delta: base evicted before copy; full pull",
-                extra={"base": base_d.hex},
-            )
+        # Per-base reader lifecycle: one positional-read handle per
+        # selected base, opened up front, closed in the finally. A base
+        # evicted between plan and copy just drops out (its spans'
+        # pieces ride the swarm; spans of the surviving bases still
+        # copy). open_cache_reader composes over BOTH representations,
+        # so a base already living in the chunk tier serves copies too.
+        readers: list = []
+        alive = 0
+        for b in bases:
+            try:
+                readers.append(self.store.open_cache_reader(b))
+                alive += 1
+            except KeyError:
+                readers.append(None)
+                _log.debug(
+                    "delta: base evicted before copy",
+                    extra={"base": b.hex},
+                )
+        if alive == 0:
             return
+        result["bases_used"] = alive
         # Per-chunk verify verdicts, shared across pieces: a chunk that
         # straddles a piece boundary is read+hashed once, not once per
         # piece, and a corrupt one is counted once. _copy_piece calls
@@ -369,7 +512,7 @@ class DeltaPlanner:
         try:
             with trace.span(
                 "delta.copy", digest=metainfo.digest.hex[:12],
-                base=base_d.hex[:12],
+                base=bases[0].hex[:12], bases=len(bases),
             ):
                 for i in torrent.missing_pieces():
                     spans = cover.get(i)
@@ -378,7 +521,7 @@ class DeltaPlanner:
                     p0 = i * plen
                     pl = metainfo.piece_length_of(i)
                     out = await asyncio.to_thread(
-                        self._copy_piece, base_fd, p0, pl, spans, verified
+                        self._copy_piece, readers, p0, pl, spans, verified
                     )
                     if out is None:
                         continue  # fp reject: this piece rides the swarm
@@ -426,11 +569,13 @@ class DeltaPlanner:
                     result["copied"] += copied
                     result["pieces"] += 1
         finally:
-            os.close(base_fd)
+            for r in readers:
+                if r is not None:
+                    r.close()
 
     def _copy_piece(
         self,
-        base_fd: int,
+        readers: list,
         p0: int,
         pl: int,
         spans: list[HaveSpan],
@@ -438,13 +583,16 @@ class DeltaPlanner:
     ) -> tuple[bytearray, list[tuple[int, int]], int] | None:
         """Build piece ``[p0, p0+pl)`` from base chunks (worker thread).
 
-        Returns ``(buf, holes, copied_bytes)`` where ``holes`` are the
-        piece-relative ``(off, size)`` intervals no verified chunk
-        covered, or None when a chunk failed its fp re-verify (corrupt
-        base: the piece must not be assembled from it). ``verified``
-        carries per-chunk verdicts across this pull's pieces: a chunk
-        straddling a piece boundary is fully read + hashed by the first
-        piece that sees it, and later pieces read only their overlap."""
+        ``readers[h.base]`` is the span's base handle (None = that base
+        was evicted before copy; its spans reject so the piece rides the
+        swarm). Returns ``(buf, holes, copied_bytes)`` where ``holes``
+        are the piece-relative ``(off, size)`` intervals no verified
+        chunk covered, or None when a chunk failed its fp re-verify
+        (corrupt base: the piece must not be assembled from it).
+        ``verified`` carries per-chunk verdicts across this pull's
+        pieces: a chunk straddling a piece boundary is fully read +
+        hashed by the first piece that sees it, and later pieces read
+        only their overlap."""
         buf = bytearray(pl)
         filled: list[tuple[int, int]] = []
         copied = 0
@@ -456,28 +604,39 @@ class DeltaPlanner:
             ok = verified.get(h)
             if ok is False:
                 return None
-            if ok is None:
-                chunk = os.pread(base_fd, h.size, h.base_off)
-                if len(chunk) != h.size or chunk_fp(chunk) != h.fp:
-                    # The base no longer holds what the recipe says
-                    # (at-rest corruption, or a recipe/blob mismatch):
-                    # nothing copied from it can be trusted.
-                    self._chunk_rejects.inc()
-                    verified[h] = False
-                    return None
-                verified[h] = True
-                part = chunk[lo - h.target_off : hi - h.target_off]
-            else:
-                # Verified by an earlier piece: read just the overlap.
-                part = os.pread(
-                    base_fd, hi - lo, h.base_off + (lo - h.target_off)
-                )
-                if len(part) != hi - lo:
-                    # Immutable-CAS fds can't short-read inside the file;
-                    # treat anything else as a reject, not silent holes.
-                    self._chunk_rejects.inc()
-                    verified[h] = False
-                    return None
+            reader = readers[h.base] if h.base < len(readers) else None
+            if reader is None:
+                return None  # base gone: this piece rides the swarm
+            try:
+                if ok is None:
+                    chunk = reader.pread(h.size, h.base_off)
+                    if len(chunk) != h.size or chunk_fp(chunk) != h.fp:
+                        # The base no longer holds what the recipe says
+                        # (at-rest corruption, or a recipe/blob
+                        # mismatch): nothing copied from it is trusted.
+                        self._chunk_rejects.inc()
+                        verified[h] = False
+                        return None
+                    verified[h] = True
+                    part = chunk[lo - h.target_off : hi - h.target_off]
+                else:
+                    # Verified by an earlier piece: read just the overlap.
+                    part = reader.pread(
+                        hi - lo, h.base_off + (lo - h.target_off)
+                    )
+                    if len(part) != hi - lo:
+                        # Immutable-CAS reads can't short-read inside the
+                        # file; treat anything else as a reject, not
+                        # silent holes.
+                        self._chunk_rejects.inc()
+                        verified[h] = False
+                        return None
+            except OSError:
+                # A chunk-backed base whose chunk file vanished under us
+                # (quarantine race): same verdict as a failed re-hash.
+                self._chunk_rejects.inc()
+                verified[h] = False
+                return None
             rel = lo - p0
             buf[rel : rel + (hi - lo)] = part
             filled.append((rel, hi - lo))

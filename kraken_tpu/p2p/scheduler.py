@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import os
 from typing import Optional, Protocol
 
 from kraken_tpu.core.digest import Digest
@@ -224,6 +225,7 @@ class Scheduler:
         # what delta could not cover. Gated inside the planner on its
         # live-reloadable config; a prefill failure never fails the pull.
         self._delta = delta
+        self._convert_tasks: set[asyncio.Task] = set()  # strong refs
         self.conn_state = ConnState(self.config.conn_state)
         # Which Conn instance owns each conn-state active slot: a stale
         # conn's close must never release a slot a newer conn has taken.
@@ -307,6 +309,11 @@ class Scheduler:
         if self._announce_pump_task is not None:
             self._announce_pump_task.cancel()
         for t in list(self._announce_tasks):
+            t.cancel()
+        for t in list(self._convert_tasks):
+            # Safe to cut: convert_to_chunks runs inside ONE to_thread
+            # hop, so a cancel lands before it starts or after it
+            # finished -- never mid-conversion.
             t.cancel()
         for ctl in list(self._controls.values()):
             ctl.cancel_tasks()
@@ -417,10 +424,35 @@ class Scheduler:
         )
         # Become discoverable as a seeder immediately (still rate-paced).
         self._announce_queue.schedule(metainfo.info_hash, 0.0)
+        if self._delta is not None:
+            # Chunk-tier handover (store/chunkstore.py): a completed
+            # pull whose recipe the prefill planner fetched converts to
+            # manifest + refcounted chunks, so the NEXT near-duplicate
+            # build stores only its unique bytes. A BACKGROUND task --
+            # conversion re-reads the whole blob, and blocking here
+            # would add seconds to every large pull's completion; every
+            # serve path picks its representation atomically
+            # (store/serve.py, open_cache_reader), so racing readers
+            # are safe. Failures never fail the pull: the blob just
+            # stays flat.
+            t = asyncio.create_task(
+                self._chunk_convert(metainfo, namespace)
+            )
+            self._convert_tasks.add(t)
+            t.add_done_callback(self._convert_tasks.discard)
         if not self.config.seed_on_complete:
             # Download-only mode: tear the torrent down instead of
             # lazily seeding it (e.g. bandwidth-constrained edge agents).
             self._remove_control(metainfo.info_hash)
+
+    async def _chunk_convert(self, metainfo: MetaInfo, namespace: str) -> None:
+        try:
+            await self._delta.chunk_completed(metainfo, namespace)
+        except Exception:
+            _log.warning(
+                "chunk-tier conversion failed; blob stays flat",
+                extra={"digest": metainfo.digest.hex}, exc_info=True,
+            )
 
     def _remove_control(self, h: InfoHash) -> None:
         ctl = self._controls.pop(h, None)
@@ -709,6 +741,12 @@ class Scheduler:
         if pool is None or not pool.can_accept:
             return False
         if not ctl.torrent.complete() or self.bandwidth is not None:
+            return False
+        if not os.path.exists(ctl.torrent.blob_path):
+            # Chunk-backed blob (store/chunkstore.py): there is no flat
+            # file for the worker's long-lived sendfile fd. Serve from
+            # the main loop, whose piece reads compose through the
+            # chunk tier -- correctness over the shard fast path.
             return False
         transport = writer.transport
         sock = transport.get_extra_info("socket")

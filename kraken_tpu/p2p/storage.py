@@ -145,6 +145,27 @@ class BatchedVerifier:
                 fut.set_result(bytes(got) == expected)
 
 
+class _FlatIO:
+    """Raw-fd IO handle for flat-file torrents: the pread/pwrite/close
+    trio :class:`Torrent` ref-counts, shaped exactly like the chunk
+    tier's ChunkReader so both storage representations share the piece
+    IO path (reads; only flat files ever take writes)."""
+
+    __slots__ = ("_fd",)
+
+    def __init__(self, fd: int):
+        self._fd = fd
+
+    def pread(self, n: int, off: int) -> bytes:
+        return os.pread(self._fd, n, off)
+
+    def pwrite(self, data, off: int) -> int:
+        return os.pwrite(self._fd, data, off)
+
+    def close(self) -> None:
+        os.close(self._fd)
+
+
 class Torrent:
     """Piece-addressed access to one blob in the store.
 
@@ -224,7 +245,10 @@ class Torrent:
     def blob_path(self) -> str:
         """Filesystem path of the backing file (the committed cache path
         once complete) -- what the seed-serve worker shards open for
-        their long-lived sendfile fd."""
+        their long-lived sendfile fd. A chunk-backed blob has NO flat
+        path: the scheduler's shard handoff checks existence and keeps
+        such conns on the main loop, whose piece reads compose through
+        the chunk tier (materialize_flat is the opt-in escape hatch)."""
         return self._path
 
     def complete(self) -> bool:
@@ -254,8 +278,29 @@ class Torrent:
 
     # -- pieces ------------------------------------------------------------
 
+    def _open_io(self):
+        """The torrent's IO handle: a raw fd on the backing file, or --
+        for a COMPLETE blob whose bytes live in the chunk tier -- a
+        composed :class:`~kraken_tpu.store.chunkstore.ChunkReader`.
+        Both expose ``pread``; only the flat handle can ``pwrite``
+        (incomplete torrents always write into a flat ``.part``)."""
+        if self._status is None:
+            try:
+                fd = os.open(self._path, os.O_RDONLY)
+            except FileNotFoundError:
+                reader = self.store._chunk_reader(self.metainfo.digest)
+                if reader is None:
+                    raise
+                return reader
+            return _FlatIO(fd)
+        # O_RDWR while incomplete (piece writes land here); a committed
+        # blob is read-only. Completion does NOT reopen: commit is a
+        # rename, so the fd keeps addressing the same inode the cache
+        # path now names.
+        return _FlatIO(os.open(self._path, os.O_RDWR))
+
     def _with_fd(self, op):
-        """Run ``op(fd)`` (a pread/pwrite) with the fd ref-counted.
+        """Run ``op(io)`` (a pread/pwrite) with the handle ref-counted.
 
         Teardown races are real: cancelling an _io_task does NOT stop a
         worker thread already inside os.pwrite, and closing the fd under
@@ -267,12 +312,7 @@ class Torrent:
             if self._fd_closed:
                 raise PieceError("torrent closed")
             if self._fd is None:
-                # O_RDWR while incomplete (piece writes land here); a
-                # committed blob is read-only. Completion does NOT
-                # reopen: commit is a rename, so the fd keeps addressing
-                # the same inode the cache path now names.
-                flags = os.O_RDONLY if self._status is None else os.O_RDWR
-                self._fd = os.open(self._path, flags)
+                self._fd = self._open_io()
             self._fd_refs += 1
             fd = self._fd
         try:
@@ -281,20 +321,21 @@ class Torrent:
             with self._fd_lock:
                 self._fd_refs -= 1
                 if self._fd_closed and self._fd_refs == 0 and self._fd is not None:
-                    os.close(self._fd)
+                    self._fd.close()
                     self._fd = None
 
     def release_fd(self) -> None:
-        """Drop the cached fd if no IO is in flight; the next piece IO
-        reopens it. The dispatcher calls this when a torrent's last peer
-        leaves, so a long-lived origin seeding thousands of blobs holds
-        fds only for torrents with LIVE conns -- without this, steady-
-        state fd usage grows with every blob ever served until EMFILE
-        (and conn churn already guarantees idle torrents shed their
-        peers). Best-effort: in-flight IO keeps the fd until close()."""
+        """Drop the cached IO handle if no IO is in flight; the next
+        piece IO reopens it. The dispatcher calls this when a torrent's
+        last peer leaves, so a long-lived origin seeding thousands of
+        blobs holds fds only for torrents with LIVE conns -- without
+        this, steady-state fd usage grows with every blob ever served
+        until EMFILE (and conn churn already guarantees idle torrents
+        shed their peers). Best-effort: in-flight IO keeps the handle
+        until close()."""
         with self._fd_lock:
             if self._fd_refs == 0 and self._fd is not None and not self._fd_closed:
-                os.close(self._fd)
+                self._fd.close()
                 self._fd = None
 
     def close(self) -> None:
@@ -340,7 +381,7 @@ class Torrent:
         with self._fd_lock:
             self._fd_closed = True
             if self._fd_refs == 0 and self._fd is not None:
-                os.close(self._fd)
+                self._fd.close()
                 self._fd = None
 
     def read_piece(self, i: int) -> bytes:
@@ -348,7 +389,7 @@ class Torrent:
             raise PieceError(f"piece {i} not present")
         off = i * self.metainfo.piece_length
         ln = self.metainfo.piece_length_of(i)
-        data = self._with_fd(lambda fd: os.pread(fd, ln, off))
+        data = self._with_fd(lambda io_: io_.pread(ln, off))
         if len(data) != ln:
             raise PieceError(f"short read on piece {i}")
         return data
@@ -417,7 +458,7 @@ class Torrent:
 
     def _write_at(self, i: int, data: bytes) -> None:
         self._with_fd(
-            lambda fd: os.pwrite(fd, data, i * self.metainfo.piece_length)
+            lambda io_: io_.pwrite(data, i * self.metainfo.piece_length)
         )
 
     def _mark_bits_dirty(self) -> None:

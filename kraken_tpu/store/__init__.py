@@ -7,6 +7,7 @@ SURVEY.md SS2.3.
 
 from kraken_tpu.store.castore import CAStore, FileExistsInCacheError, UploadNotFoundError
 from kraken_tpu.store.metadata import (
+    ChunkManifestMetadata,
     Metadata,
     PieceStatusMetadata,
     TTIMetadata,
@@ -15,6 +16,7 @@ from kraken_tpu.store.metadata import (
 
 __all__ = [
     "CAStore",
+    "ChunkManifestMetadata",
     "FileExistsInCacheError",
     "UploadNotFoundError",
     "Metadata",

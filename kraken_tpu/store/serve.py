@@ -1,0 +1,107 @@
+"""HTTP blob serving over both storage representations.
+
+One code path for flat AND chunk-backed blobs: ``open_cache_reader``
+picks the representation ATOMICALLY (a flat open pins the fd -- the
+chunk-tier conversion unlinking the path mid-request is harmless; a
+miss falls to the manifest), and a Range-capable ``StreamResponse``
+streams 1 MiB positional reads off-loop -- O(slice) memory for any blob
+size. An exists-then-FileResponse split would 404/500 the µs race where
+a conversion unlinks the flat file between the check and aiohttp's own
+open (FileResponse.prepare swallows the OSError and sends its own 404,
+so it cannot fall through); the atomic reader has no such window, and
+on this class of rig the pread+send path measured at parity with the
+emulated sendfile (PERF.md "Multi-core data plane" microbench).
+
+Supported Range forms (the single-range subset real clients and the
+delta planner's need-span fetches send): ``bytes=a-b``, ``bytes=a-``,
+``bytes=-n``. Multi-range or malformed headers fall back to a full 200
+(a valid server response to any Range request); unsatisfiable ranges
+get 416 with ``Content-Range: bytes */length``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+_SLICE = 1 << 20
+
+
+def _parse_range(req: web.Request, length: int) -> tuple[int, int] | None | str:
+    """``(start, end_inclusive)``, None for "serve the whole blob", or
+    ``"unsatisfiable"``. Delegates to aiohttp's ``req.http_range`` --
+    the SAME parser the docker-registry blob path uses
+    (dockerregistry/registry.py), so every blob surface agrees on
+    lenient/strict cases; malformed or multi-range headers raise
+    ValueError there and fall back to a full 200 (permitted by RFC
+    9110)."""
+    try:
+        rng = req.http_range
+    except ValueError:
+        return None
+    start, stop = rng.start, rng.stop
+    if start is None and stop is None:
+        return None
+    if start is None:
+        start = 0
+    if start < 0:  # suffix range: bytes=-N
+        start = max(length + start, 0)
+        end = length - 1
+    else:
+        # Clamp an end past EOF to the last byte (RFC 9110: a
+        # too-large last-byte-pos is satisfiable).
+        end = min(stop - 1 if stop is not None else length - 1, length - 1)
+    if start >= length or start > end:
+        return "unsatisfiable"
+    return start, end
+
+
+async def blob_response(
+    req: web.Request, store, d
+) -> web.StreamResponse:
+    """Serve blob ``d`` from ``store``, flat or chunk-backed. Raises
+    ``web.HTTPNotFound`` when the blob is in neither representation
+    (callers already ensured presence; this covers eviction races)."""
+    try:
+        reader = store.open_cache_reader(d)
+    except KeyError:
+        raise web.HTTPNotFound(text="blob not found")
+    try:
+        length = reader.length
+        rng = _parse_range(req, length)
+        if rng == "unsatisfiable":
+            raise web.HTTPRequestRangeNotSatisfiable(
+                headers={"Content-Range": f"bytes */{length}"}
+            )
+        if rng is None:
+            start, end, status = 0, length - 1, 200
+        else:
+            start, end = rng
+            status = 206
+        resp = web.StreamResponse(status=status)
+        resp.headers["Content-Type"] = "application/octet-stream"
+        resp.headers["Accept-Ranges"] = "bytes"
+        n = end - start + 1 if length else 0
+        resp.content_length = n
+        if status == 206:
+            resp.headers["Content-Range"] = f"bytes {start}-{end}/{length}"
+        await resp.prepare(req)
+        off = start
+        remaining = n
+        while remaining > 0:
+            take = min(_SLICE, remaining)
+            data = await asyncio.to_thread(reader.pread, take, off)
+            if len(data) != take:
+                # A chunk vanished mid-stream (quarantined under us):
+                # the transfer is already partially written -- abort the
+                # conn so the client sees a hard failure, never a short
+                # body that parses as truncated-but-complete.
+                raise ConnectionResetError("blob read truncated mid-serve")
+            await resp.write(data)
+            off += take
+            remaining -= take
+        await resp.write_eof()
+        return resp
+    finally:
+        reader.close()

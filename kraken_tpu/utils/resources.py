@@ -196,10 +196,18 @@ def scan_store_orphans(
                         counts["tmp_sidecar"] += 1
                     continue
                 base = name.split("._md_", 1)[0]
-                # A sidecar beside its data file, or beside a live
+                # A sidecar beside its data file, beside a live
                 # ``.part`` (the piece bitfield crash-resume depends
-                # on), is not an orphan.
-                if base in present or f"{base}.part" in present:
+                # on), or beside a chunk-tier manifest (the blob's
+                # bytes live in the chunk tier; the manifest IS its
+                # committed presence) is not an orphan.
+                from kraken_tpu.store.metadata import ChunkManifestMetadata
+
+                if (
+                    base in present
+                    or f"{base}.part" in present
+                    or f"{base}._md_{ChunkManifestMetadata.name}" in present
+                ):
                     continue
                 a = age(path)
                 if a is not None and a > min_age_seconds:

@@ -27,7 +27,7 @@ CLI_KEYS = {
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
-    "profiling", "fleet",
+    "profiling", "fleet", "chunkstore",
 }
 
 
@@ -216,6 +216,31 @@ def test_delta_sections_construct_delta_config():
         assert 0.0 <= cfg.min_piece_cover <= 1.0, path
         seen += 1
     assert seen >= 2  # agent + origin register the delta knobs
+
+
+def test_chunkstore_sections_construct_chunkstore_config():
+    """Every shipped `chunkstore:` section must map onto
+    ChunkStoreConfig through the same from_dict the CLI/assembly use --
+    a typo'd knob must fail here, not at production boot. The shipped
+    default must stay OFF on BOTH components: converting blobs to
+    manifests is a rollout decision (agents first, origins after soak
+    -- OPERATIONS.md runbook), never a config-refresh surprise."""
+    from kraken_tpu.store.chunkstore import ChunkStoreConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        cc = load_config(path).get("chunkstore")
+        if cc is None:
+            continue
+        cfg = ChunkStoreConfig.from_dict(cc)  # raises on unknown keys
+        assert cfg.enabled is False, (
+            f"{path}: shipped chunkstore.enabled must stay false"
+        )
+        assert cfg.min_blob_bytes >= 0, path
+        assert cfg.gc_interval_seconds > 0, path
+        assert cfg.gc_bytes_per_second >= 0, path
+        seen += 1
+    assert seen >= 2  # agent + origin register the chunkstore knobs
 
 
 def test_profiling_sections_construct_profiler_config():

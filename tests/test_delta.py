@@ -489,10 +489,13 @@ def test_copy_piece_holes_and_fp_reject(tmp_path):
     the recipe fp."""
     from kraken_tpu.p2p.delta import DeltaPlanner
 
+    from kraken_tpu.store.chunkstore import FlatReader
+
     base = bytes(np.random.default_rng(3).integers(0, 256, 8192, np.uint8))
     path = tmp_path / "base"
     path.write_bytes(base)
-    fd = os.open(str(path), os.O_RDONLY)
+    raw_fd = os.open(str(path), os.O_RDONLY)
+    fd = [FlatReader(raw_fd, len(base))]  # the per-base reader list
     try:
         planner = DeltaPlanner.__new__(DeltaPlanner)  # only _copy_piece
         planner._chunk_rejects = REGISTRY.counter(
@@ -538,4 +541,4 @@ def test_copy_piece_holes_and_fp_reject(tmp_path):
         assert verified == {bad: False}
         assert rejects.value() == r0 + 1
     finally:
-        os.close(fd)
+        os.close(raw_fd)
