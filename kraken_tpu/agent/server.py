@@ -53,12 +53,15 @@ class AgentServer(LameduckMixin):
         r.add_get("/health", self._health)
         r.add_get("/readiness", self._readiness)
         self.add_lameduck_routes(r)
+        self.bind_app(app)
         return app
 
     @property
     def inflight_work(self) -> int:
-        """Drain quiesce signal: downloads that must be allowed to finish."""
-        return self._inflight_downloads
+        """Drain quiesce signal: downloads that must be allowed to
+        finish, plus in-flight debug scrapes (`kraken-tpu status` must
+        never lose a listener mid-read)."""
+        return self._inflight_downloads + self.debug_inflight
 
     def _digest(self, req: web.Request) -> Digest:
         try:
@@ -76,14 +79,30 @@ class AgentServer(LameduckMixin):
                 # serve: they cost one sendfile and finish immediately).
                 raise self.drain_unavailable()
             self._inflight_downloads += 1
+            # Pull SLI (utils/slo.py): success + latency of the swarm
+            # pull behind this endpoint.  User-facing -- the canary
+            # prober records its own pulls with the canary flag.
+            from kraken_tpu.utils.slo import SLO
+
+            t0 = asyncio.get_running_loop().time()
             try:
                 await asyncio.wait_for(
                     self.scheduler.download(ns, d), self.download_timeout
                 )
             except asyncio.TimeoutError:
+                SLO.record(
+                    "pull", False, asyncio.get_running_loop().time() - t0
+                )
                 raise web.HTTPGatewayTimeout(text="download timed out")
             except Exception as e:
+                SLO.record(
+                    "pull", False, asyncio.get_running_loop().time() - t0
+                )
                 raise web.HTTPInternalServerError(text=f"download failed: {e}")
+            else:
+                SLO.record(
+                    "pull", True, asyncio.get_running_loop().time() - t0
+                )
             finally:
                 self._inflight_downloads -= 1
         if self.cleanup is not None:

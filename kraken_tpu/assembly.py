@@ -44,7 +44,9 @@ from kraken_tpu.utils.profiler import (
     LoopLagMonitor,
     ProfilerConfig,
 )
+from kraken_tpu.utils.canary import CanaryConfig, CanaryProber
 from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
+from kraken_tpu.utils.slo import SLO, SLOConfig
 from kraken_tpu.utils.trace import TRACER, TraceConfig
 from kraken_tpu.p2p.delta import DeltaConfig, DeltaPlanner
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
@@ -188,6 +190,29 @@ def _chunkstore_config(chunkstore) -> ChunkStoreConfig:
     if isinstance(chunkstore, ChunkStoreConfig):
         return chunkstore
     return ChunkStoreConfig.from_dict(chunkstore)
+
+
+def _slo_config(slo) -> SLOConfig:
+    """Same normalization for the YAML ``slo:`` section."""
+    if isinstance(slo, SLOConfig):
+        return slo
+    return SLOConfig.from_dict(slo)
+
+
+def _canary_config(canary) -> CanaryConfig:
+    """Same normalization for the YAML ``canary:`` section."""
+    if isinstance(canary, CanaryConfig):
+        return canary
+    return CanaryConfig.from_dict(canary)
+
+
+def _apply_slo(component: str, cfg: SLOConfig) -> None:
+    """Apply a node's ``slo:`` section to the process-global SLO
+    manager (utils/slo.py SLO -- one per process, like the TRACER;
+    in-process herds share it and the last-started node wins).  The
+    evaluator thread follows the enabled flag."""
+    SLO.node = component
+    SLO.apply(cfg)
 
 
 def _sync_chunkstore(node) -> None:
@@ -406,7 +431,8 @@ class TrackerNode:
                  ssl_context=None,
                  rpc: dict | RPCConfig | None = None,
                  trace: dict | TraceConfig | None = None,
-                 profiling: dict | ProfilerConfig | None = None):
+                 profiling: dict | ProfilerConfig | None = None,
+                 slo: dict | SLOConfig | None = None):
         self.host = host
         self.port = port
         self.rpc = _rpc_config(rpc)
@@ -423,6 +449,11 @@ class TrackerNode:
         # Same for profile captures: the sampler + loop-lag monitor run
         # regardless (the /debug/pprof surfaces answer live).
         self.profiling_config = _profiling_config(profiling)
+        # SLO plane (utils/slo.py): burn-rate evaluation + /debug/slo.
+        # A tracker records no SLIs itself, but the surface still
+        # answers (empty burn) so `kraken-tpu status` needs no special
+        # case. YAML `slo:`; SIGHUP live-reloads.
+        self.slo_config = _slo_config(slo)
         self.loop_monitor: Optional[LoopLagMonitor] = None
         # Redis-protocol store: swarm survives tracker restarts and can be
         # shared by several trackers; default in-memory store re-heals via
@@ -456,6 +487,7 @@ class TrackerNode:
         self.profiling_config = _apply_profiling(
             "tracker", self.profiling_config
         )
+        _apply_slo("tracker", self.slo_config)
         _sync_loop_monitor(self, "tracker")
         self._runner, self.port = await _serve(
             self.server.make_app(), self.host, self.port, "tracker",
@@ -495,6 +527,9 @@ class TrackerNode:
                 "tracker", _profiling_config(cfg["profiling"])
             )
             _sync_loop_monitor(self, "tracker")
+        if cfg.get("slo") is not None:
+            self.slo_config = _slo_config(cfg["slo"])
+            _apply_slo("tracker", self.slo_config)
         if cfg.get("rpc") is None:
             return
         self.rpc = _rpc_config(cfg["rpc"])
@@ -571,6 +606,7 @@ class OriginNode:
         delta: dict | DeltaConfig | None = None,
         profiling: dict | ProfilerConfig | None = None,
         chunkstore: dict | ChunkStoreConfig | None = None,
+        slo: dict | SLOConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -675,6 +711,10 @@ class OriginNode:
         # live-reloads. Applied at start() (before the scheduler forks
         # seed-serve workers, which inherit the applied config).
         self.profiling_config = _profiling_config(profiling)
+        # SLO plane (utils/slo.py): upload/heal/replication SLIs feed
+        # the burn-rate evaluators; /debug/slo on the mux. YAML `slo:`;
+        # SIGHUP live-reloads.
+        self.slo_config = _slo_config(slo)
         self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
@@ -742,6 +782,7 @@ class OriginNode:
         self.profiling_config = _apply_profiling(
             "origin", self.profiling_config, self.store.root
         )
+        _apply_slo("origin", self.slo_config)
         _sync_loop_monitor(self, "origin")
         # Startup fsck BEFORE any listener binds: the tree must be
         # reconciled (orphans swept, crash-window blobs verified) before
@@ -931,6 +972,9 @@ class OriginNode:
             self.chunkstore_config = _chunkstore_config(cfg["chunkstore"])
             _sync_chunkstore(self)
             _sync_chunk_gc(self)
+        if cfg.get("slo") is not None:
+            self.slo_config = _slo_config(cfg["slo"])
+            _apply_slo("origin", self.slo_config)
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the
@@ -1258,6 +1302,8 @@ class AgentNode:
         delta: dict | DeltaConfig | None = None,
         profiling: dict | ProfilerConfig | None = None,
         chunkstore: dict | ChunkStoreConfig | None = None,
+        slo: dict | SLOConfig | None = None,
+        canary: dict | CanaryConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -1339,6 +1385,16 @@ class AgentNode:
         # Continuous profiling plane (utils/profiler.py); YAML
         # `profiling:`; SIGHUP live-reloads.
         self.profiling_config = _profiling_config(profiling)
+        # SLO plane (utils/slo.py): pull/announce SLIs feed the
+        # burn-rate evaluators; /debug/slo on the mux. YAML `slo:`.
+        self.slo_config = _slo_config(slo)
+        # Synthetic canary prober (utils/canary.py): periodic seeded
+        # pull through the real stack so the SLO plane stays fed at
+        # zero user traffic. Shipped OFF (needs `canary.origins`);
+        # SIGHUP live-reloads (the prober is always constructed so a
+        # reload can enable it without a restart).
+        self.canary_config = _canary_config(canary)
+        self.canary: Optional[CanaryProber] = None
         self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
@@ -1389,6 +1445,7 @@ class AgentNode:
         self.profiling_config = _apply_profiling(
             "agent", self.profiling_config, self.store.root
         )
+        _apply_slo("agent", self.slo_config)
         _sync_loop_monitor(self, "agent")
         if self.fsck_enabled:
             self.fsck_report = await asyncio.to_thread(
@@ -1457,6 +1514,13 @@ class AgentNode:
             self.scrubber.start()
         self.sentinel = _start_sentinel(self, "agent")
         _sync_chunk_gc(self)
+        # Canary prober: started always (one sleeping task), probing
+        # only while canary.enabled -- so SIGHUP can flip it on live.
+        self.canary = CanaryProber(
+            self.store, self.scheduler, self.canary_config,
+            node=f"agent-{self.host}",
+        )
+        self.canary.start()
         if self.build_index_addr:
             from kraken_tpu.buildindex.server import TagClient
             from kraken_tpu.dockerregistry.registry import RegistryServer
@@ -1522,6 +1586,15 @@ class AgentNode:
             self.chunkstore_config = _chunkstore_config(cfg["chunkstore"])
             _sync_chunkstore(self)
             _sync_chunk_gc(self)
+        if cfg.get("slo") is not None:
+            self.slo_config = _slo_config(cfg["slo"])
+            _apply_slo("agent", self.slo_config)
+        if cfg.get("canary") is not None:
+            # Live enable/disable + knob swap: the prober loop re-reads
+            # its config object every tick.
+            self.canary_config = _canary_config(cfg["canary"])
+            if self.canary is not None:
+                self.canary.config = self.canary_config
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,
@@ -1550,6 +1623,11 @@ class AgentNode:
         if self.chunk_gc:
             self.chunk_gc.stop()
             self.chunk_gc = None
+        if self.canary:
+            # Before the scheduler stops: the reap sweep unseeds its
+            # canary blobs through it.
+            await self.canary.stop()
+            self.canary = None
         if self.scheduler:
             await self.scheduler.stop()
         if self._runner:

@@ -206,6 +206,174 @@ def run_flame_tool(paths: list[str], top: int = 0) -> int:
     return 1 if errors else 0
 
 
+def run_status_tool(nodes: list[str], timeout_seconds: float = 5.0) -> int:
+    """`kraken-tpu status`: the operator's fleet-wide entry point.
+    Scrapes ``/debug/`` (surface index), ``/health``, ``/debug/slo``,
+    ``/debug/healthcheck``, and ``/debug/resources`` from every node in
+    the list and prints one table row per node plus a JSON summary
+    line.  Exit codes are the deploy-gate contract (docs/OPERATIONS.md
+    "SLO & canary"): **0** every node healthy, **1** at least one node
+    burning (a firing burn-rate alert, a latched resource breach, or a
+    draining/unhealthy /health), **2** at least one node unreachable
+    (unreachability dominates: a gate cannot call a fleet it cannot
+    see healthy), **3** usage error.  In-process callable for tests."""
+    from kraken_tpu.utils.httputil import HTTPClient, base_url
+
+    if not nodes:
+        print(json.dumps({
+            "event": "error", "message": "status requires --nodes",
+        }), flush=True)
+        return 3
+
+    async def scrape_node(http: HTTPClient, addr: str) -> dict:
+        row: dict = {"addr": addr, "reachable": True, "burning": []}
+
+        async def get_json(path: str):
+            body = await http.get(
+                f"{base_url(addr)}{path}", retry_5xx=False
+            )
+            return json.loads(body)
+
+        # The index answers "what does this node serve" -- and is the
+        # reachability probe (every instrumented mux has it).
+        try:
+            index = await get_json("/debug/")
+        except Exception as e:
+            row["reachable"] = False
+            row["error"] = repr(e)
+            return row
+        row["component"] = index.get("component", "?")
+        surfaces = set(index.get("surfaces", {}))
+        # /health: 503 = draining (lameduck) or refusing -- burning.
+        # Gated on the index: the proxy's registry app serves no
+        # /health route, and a 404 there is not an unhealthy fleet.
+        if "/health" in surfaces:
+            try:
+                await http.get(f"{base_url(addr)}/health", retry_5xx=False)
+                row["health"] = "ok"
+            except Exception:
+                row["health"] = "unhealthy"
+                row["burning"].append("health")
+        else:
+            row["health"] = "n/a"
+        if "/debug/slo" in surfaces:
+            try:
+                slo = await get_json("/debug/slo")
+                row["slo_firing"] = slo.get("firing", [])
+                for alert in row["slo_firing"]:
+                    row["burning"].append(
+                        f"slo:{alert['sli']}:{alert['severity']}"
+                    )
+                canary = slo.get("canary")
+                if canary:
+                    # A verdict older than a few probe intervals is
+                    # history, not state: a prober disabled right
+                    # after one failure must not gate deploys red
+                    # until the process restarts.  The AGE is computed
+                    # node-side (/debug/slo stamps it on its own
+                    # clock), so status-machine clock skew cannot
+                    # flip fresh verdicts stale or vice versa.
+                    age = canary.get("age_seconds", 0.0)
+                    stale = age > 3 * canary.get(
+                        "interval_seconds", 60.0
+                    ) + 60.0
+                    row["canary"] = {
+                        "result": canary.get("result"),
+                        "seq": canary.get("seq"),
+                        "stale": stale,
+                    }
+                    if (
+                        canary.get("result") not in (None, "ok")
+                        and not stale
+                    ):
+                        row["burning"].append(
+                            f"canary:{canary['result']}"
+                        )
+                # Budget exhaustion is burning even between alert
+                # windows: a negative budget means the objective is
+                # already broken for this compliance window.
+                for sli, doc in (
+                    slo.get("last_eval", {}).get("slis", {})
+                ).items():
+                    if doc.get("budget_remaining", 1.0) < 0.0:
+                        row["burning"].append(f"budget:{sli}")
+            except Exception as e:
+                row["burning"].append("slo_unreadable")
+                row["slo_error"] = repr(e)
+        if "/debug/resources" in surfaces:
+            try:
+                res = await get_json("/debug/resources")
+                latched = [
+                    name
+                    for name, snap in res.get("sentinels", {}).items()
+                    if snap.get("breach_latched")
+                ]
+                if latched:
+                    row["burning"].append("resources")
+                    row["resource_breaches"] = latched
+            except Exception:
+                row["burning"].append("resources_unreadable")
+        if "/debug/healthcheck" in surfaces:
+            try:
+                hc = await get_json("/debug/healthcheck")
+                unhealthy = sorted({
+                    host
+                    for snap in hc.values()
+                    for host, h in (snap.get("hosts") or {}).items()
+                    if h.get("state") == "open" or h.get("browned_out")
+                })
+                if unhealthy:
+                    # A tripped breaker on a DOWNSTREAM is context, not
+                    # this node's burn -- report, don't gate.
+                    row["downstream_unhealthy"] = unhealthy
+            except Exception:
+                pass
+        return row
+
+    async def main() -> list[dict]:
+        http = HTTPClient(retries=0, timeout_seconds=timeout_seconds)
+        try:
+            return list(await asyncio.gather(*(
+                scrape_node(http, a) for a in nodes
+            )))
+        finally:
+            await http.close()
+
+    rows = asyncio.run(main())
+    header = f"{'NODE':<24} {'COMPONENT':<12} {'HEALTH':<10} STATUS"
+    print(header)
+    for row in rows:
+        if not row["reachable"]:
+            print(f"{row['addr']:<24} {'?':<12} {'UNREACHABLE':<10} "
+                  f"{row.get('error', '')}")
+            continue
+        status = ",".join(row["burning"]) or "healthy"
+        extra = ""
+        if row.get("downstream_unhealthy"):
+            extra = (
+                "  downstream_unhealthy="
+                + ",".join(row["downstream_unhealthy"])
+            )
+        canary = row.get("canary")
+        if canary:
+            extra += f"  canary={canary['result']}#{canary['seq']}"
+        print(
+            f"{row['addr']:<24} {row.get('component', '?'):<12} "
+            f"{row['health']:<10} {status}{extra}"
+        )
+    unreachable = [r["addr"] for r in rows if not r["reachable"]]
+    burning = [r["addr"] for r in rows if r.get("burning")]
+    code = 2 if unreachable else (1 if burning else 0)
+    print(json.dumps({
+        "event": "status_done",
+        "nodes": len(rows),
+        "unreachable": unreachable,
+        "burning": burning,
+        "exit_code": code,
+    }), flush=True)
+    return code
+
+
 def _common(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("--host", default=None)
@@ -371,6 +539,33 @@ def main(argv: list[str] | None = None) -> None:
     p_flame.add_argument("--top", type=int, default=0,
                          help="print only the N hottest stacks")
 
+    p_status = sub.add_parser(
+        "status", help="fleet-wide SLO/health aggregator: scrape"
+        " /debug/, /debug/slo, /debug/healthcheck, /debug/resources"
+        " and /health across a node list into one table; exit 0 every"
+        " node healthy / 1 at least one burning (firing burn-rate"
+        " alert, latched resource breach, failing health) / 2 at least"
+        " one unreachable / 3 usage -- deploy gates run it before and"
+        " after a rollout step"
+    )
+    # NOT argparse-required: a missing --nodes must exit 3 (usage),
+    # never argparse's default 2 -- the deploy-gate contract reserves
+    # 2 for "unreachable" (retryable infra, not a script bug).
+    p_status.add_argument("--nodes", default="",
+                          help="comma-separated host:port list (every"
+                               " component type; the /debug/ index"
+                               " tells the tool what each node serves)")
+    p_status.add_argument("--timeout", type=float, default=5.0,
+                          help="per-request scrape timeout in seconds")
+
+    p_promgen = sub.add_parser(
+        "promgen", help="regenerate deploy/prometheus/ (scrape config +"
+        " burn-rate alert rules) from the shipped SLO defaults; CI"
+        " gates the committed files against a fresh generation"
+    )
+    p_promgen.add_argument("--out", default="deploy/prometheus",
+                           help="output directory")
+
     p_locate = sub.add_parser(
         "locate", help="print a digest's ring placement offline"
     )
@@ -521,6 +716,20 @@ def main(argv: list[str] | None = None) -> None:
         import sys
 
         sys.exit(run_flame_tool(args.dumps, top=args.top))
+
+    if args.component == "status":
+        import sys
+
+        nodes = [a.strip() for a in (args.nodes or "").split(",") if a.strip()]
+        sys.exit(run_status_tool(nodes, timeout_seconds=args.timeout))
+
+    if args.component == "promgen":
+        from kraken_tpu.utils.promgen import write_files
+
+        for path in write_files(args.out):
+            print(json.dumps({"event": "generated", "path": path}),
+                  flush=True)
+        return
 
     if args.component == "locate":
         # Where does the ring place a digest? The operator's "which
@@ -710,6 +919,9 @@ def main(argv: list[str] | None = None) -> None:
             # YAML: profiling: {enabled, hz, loop-lag knobs...} -- the
             # continuous-profiling plane (docs/OPERATIONS.md).
             profiling=cfg.get("profiling"),
+            # YAML: slo: {objectives, fast, slow, ...} -- the burn-rate
+            # SLO plane (docs/OPERATIONS.md "SLO & canary").
+            slo=cfg.get("slo"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "tracker"}, args.config)
@@ -816,6 +1028,8 @@ def main(argv: list[str] | None = None) -> None:
             # content-addressed chunk tier (docs/OPERATIONS.md "Chunk
             # store"). Shipped off; origins opt in AFTER the agent soak.
             chunkstore=cfg.get("chunkstore"),
+            # YAML: slo: -- the burn-rate SLO plane ("SLO & canary").
+            slo=cfg.get("slo"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)
@@ -866,6 +1080,12 @@ def main(argv: list[str] | None = None) -> None:
             # YAML: chunkstore: -- the content-addressed chunk tier
             # (agents are the first rollout ring; shipped off).
             chunkstore=cfg.get("chunkstore"),
+            # YAML: slo: -- the burn-rate SLO plane ("SLO & canary").
+            slo=cfg.get("slo"),
+            # YAML: canary: {enabled, interval_seconds, origins, ...}
+            # -- the synthetic prober that keeps the SLO plane fed at
+            # zero user traffic. Shipped off (needs origins).
+            canary=cfg.get("canary"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -310,8 +310,16 @@ class OriginServer(LameduckMixin):
             _log,
         )
         if retry is not None:
-            retry.register(REPLICATE_KIND, self._execute_replication)
-            retry.register(HEAL_KIND, self._execute_heal)
+            # SLI-wrapped (utils/slo.py): heal/replication lag burning
+            # means durability is degrading while every read still
+            # works -- the slow-burn ticket window is built for it.
+            retry.register(
+                REPLICATE_KIND,
+                self._with_slo("replication", self._execute_replication),
+            )
+            retry.register(
+                HEAL_KIND, self._with_slo("heal", self._execute_heal)
+            )
             # Earlier builds keyed tasks '{addr}:{ns}:{hex}'; rewrite any
             # such persisted rows so the digest-first prefix scan in
             # _maybe_unpin sees them (a missed row releases the eviction
@@ -320,6 +328,30 @@ class OriginServer(LameduckMixin):
                 REPLICATE_KIND,
                 lambda p: f"{p['digest']}:{p['namespace']}:{p['addr']}",
             )
+
+    @staticmethod
+    def _with_slo(sli: str, fn):
+        """Wrap a persistedretry executor so every run records the SLI:
+        a retried task burns the budget once per failed attempt (lag IS
+        repeated failure), and the eventual success records how long
+        one successful execution takes."""
+
+        async def run(task) -> None:
+            import time
+
+            from kraken_tpu.utils.slo import SLO
+
+            t0 = time.monotonic()
+            try:
+                await fn(task)
+            except asyncio.CancelledError:
+                raise  # teardown, not a service failure
+            except Exception:
+                SLO.record(sli, False, time.monotonic() - t0)
+                raise
+            SLO.record(sli, True, time.monotonic() - t0)
+
+        return run
 
     # -- app ---------------------------------------------------------------
 
@@ -339,6 +371,7 @@ class OriginServer(LameduckMixin):
         r.add_delete("/namespace/{ns}/blobs/{d}", self._delete)
         r.add_get("/health", self._health)
         self.add_lameduck_routes(r)
+        self.bind_app(app)
         return app
 
     def _digest(self, req: web.Request) -> Digest:
@@ -351,9 +384,11 @@ class OriginServer(LameduckMixin):
 
     @property
     def inflight_work(self) -> int:
-        """Upload PATCH/commit bodies currently streaming -- the drain
-        loop lets these finish before the hard stop."""
-        return self._inflight_writes
+        """Upload PATCH/commit bodies currently streaming, plus
+        in-flight debug scrapes (`kraken-tpu status` / the canary plane
+        must never lose a listener mid-read) -- the drain loop lets
+        these finish before the hard stop."""
+        return self._inflight_writes + self.debug_inflight
 
     async def _brownout_gate(self) -> None:
         """Failpoint ``rpc.brownout.slow`` (and the addr-targeted
@@ -484,9 +519,37 @@ class OriginServer(LameduckMixin):
         return web.Response(status=204)
 
     async def _commit(self, req: web.Request) -> web.Response:
+        from kraken_tpu.utils.slo import CANARY_NAMESPACE, SLO
+
         self._inflight_writes += 1
+        # Upload SLI (utils/slo.py): the commit is where an upload
+        # becomes visible (verify + metainfo gen + seed), so its
+        # latency/outcome is the push path's service level.  4xx is the
+        # CLIENT's error, not budget burn.
+        t0 = asyncio.get_running_loop().time()
+        ns = urllib.parse.unquote(req.match_info.get("ns", ""))
+        canary = ns == CANARY_NAMESPACE
         try:
-            return await self._commit_inner(req)
+            resp = await self._commit_inner(req)
+        except web.HTTPException as e:
+            if e.status >= 500:
+                SLO.record(
+                    "upload", False,
+                    asyncio.get_running_loop().time() - t0, canary=canary,
+                )
+            raise
+        except Exception:
+            SLO.record(
+                "upload", False,
+                asyncio.get_running_loop().time() - t0, canary=canary,
+            )
+            raise
+        else:
+            SLO.record(
+                "upload", resp.status < 500,
+                asyncio.get_running_loop().time() - t0, canary=canary,
+            )
+            return resp
         finally:
             self._inflight_writes -= 1
 
@@ -554,6 +617,15 @@ class OriginServer(LameduckMixin):
             metainfo = await self.generator.generate(d)
         if self.scheduler is not None:
             self.scheduler.seed(metainfo, ns)
+        # Canary probes (utils/canary.py) are EPHEMERAL by contract:
+        # TTL-reaped minutes later, never durable.  Writeback would
+        # accumulate ~360 MB/day/agent of permanent backend residue,
+        # and ring replicas would hold copies the reap's single-origin
+        # DELETE never reaches.  Seeding above is all a probe needs.
+        from kraken_tpu.utils.slo import CANARY_NAMESPACE
+
+        if ns == CANARY_NAMESPACE:
+            return
         if self.writeback is not None:
             self.writeback.enqueue(ns, d)
         self._enqueue_replication(ns, d)

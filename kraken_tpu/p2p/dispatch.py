@@ -470,6 +470,11 @@ class Dispatcher:
         else:
             await self._request_more(peer)
 
+    def stage_split(self) -> dict:
+        """Public read of the per-pull stage walls (the scheduler's
+        ``stage_walls`` helper serves it to the canary prober)."""
+        return self._stage_split()
+
     def _stage_split(self) -> dict:
         """The per-pull stage walls (seconds): plan/dial from the
         scheduler, piece-wait from the request->payload gaps here,

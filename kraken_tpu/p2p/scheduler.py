@@ -48,6 +48,7 @@ from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.bufpool import BufferPool
 from kraken_tpu.utils.dedup import RequestCoalescer
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
+from kraken_tpu.utils.slo import CANARY_NAMESPACE, SLO
 
 _log = logging.getLogger("kraken.p2p")
 
@@ -486,6 +487,20 @@ class Scheduler:
         self._remove_control(h)
         return True
 
+    def stage_walls(self, d: Digest) -> dict | None:
+        """The PR-8 per-pull stage split (plan/dial/piece_wait/verify/
+        write walls) of blob ``d``'s live torrent, or None once the
+        control is gone.  The canary prober (utils/canary.py) reads it
+        right after a probe pull to attribute where a slow canary spent
+        its time."""
+        h = self._digest_to_hash.get(d)
+        if h is None:
+            return None
+        ctl = self._controls.get(h)
+        if ctl is None:
+            return None
+        return ctl.dispatcher.stage_split()
+
     # -- torrent control ---------------------------------------------------
 
     def _get_or_create_control(
@@ -566,6 +581,7 @@ class Scheduler:
             if complete
             else self.config.announce_interval
         )
+        announce_t0 = asyncio.get_running_loop().time()
         try:
             # Child of the download's root span (the announce pump task
             # itself carries no context); seeders' re-announces become
@@ -577,15 +593,31 @@ class Scheduler:
                 peers, interval_r = await self.announce_client.announce(
                     ctl.torrent.digest, h, ctl.namespace, complete
                 )
+            announce_wall = asyncio.get_running_loop().time() - announce_t0
             ctl.announce_backoff = 0.0  # healthy again: next failure is fresh
             if not complete and interval_r:
                 interval = interval_r
             self.events.emit("announce", h.hex, returned=len(peers))
             for peer in peers:
                 self._maybe_dial(ctl, peer)
+            # Announce SLI (utils/slo.py): client-side latency covers
+            # the whole fleet walk -- failovers and breaker shedding
+            # included -- which is what an agent actually experiences.
+            # Recorded LAST in the try: an emit/dial failure must take
+            # the except's bad-record path, never count the same
+            # announce as both good and bad.
+            SLO.record(
+                "announce", True, announce_wall,
+                canary=ctl.namespace == CANARY_NAMESPACE,
+            )
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            SLO.record(
+                "announce", False,
+                asyncio.get_running_loop().time() - announce_t0,
+                canary=ctl.namespace == CANARY_NAMESPACE,
+            )
             # Tracker hiccup: retry with per-torrent decorrelated-jitter
             # backoff, capped at the announce interval -- METERED (a
             # dead tracker must be visible on /metrics), and NEVER on a

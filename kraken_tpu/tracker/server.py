@@ -140,7 +140,10 @@ class TrackerServer(LameduckMixin):
 
     @property
     def inflight_work(self) -> int:
-        return self._inflight
+        # debug_inflight: /debug/slo + /debug/ scrapes (`kraken-tpu
+        # status`, canary tooling) gate the drain quiesce exactly like
+        # the /recipe proxy reads below (the round-12 lesson).
+        return self._inflight + self.debug_inflight
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -150,6 +153,7 @@ class TrackerServer(LameduckMixin):
         app.router.add_get("/namespace/{ns}/blobs/{d}/similar", self._similar)
         app.router.add_get("/health", self._health)
         self.add_lameduck_routes(app.router)
+        self.bind_app(app)
         return app
 
     async def close(self) -> None:

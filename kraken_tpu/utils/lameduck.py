@@ -12,6 +12,7 @@ cannot diverge between components.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 
 from aiohttp import web
@@ -22,6 +23,13 @@ _log = logging.getLogger("kraken.lameduck")
 # the hint, not a promise (the pod is likely gone by then).
 RETRY_AFTER_SECONDS = "5"
 
+# aiohttp app key under which a component server registers itself so
+# the shared debug handlers (utils/metrics.py instrument_app) can count
+# their scrapes into the drain quiesce via track_debug_scrape().
+APP_KEY: "web.AppKey[LameduckMixin]" = web.AppKey(
+    "kraken_lameduck_server", object
+)
+
 
 class LameduckMixin:
     """Mix into a component server that owns a ``scheduler`` attribute
@@ -31,6 +39,23 @@ class LameduckMixin:
 
     lameduck = False
     lameduck_component = "node"
+    # In-flight debug/observability scrapes (/debug/slo, /debug/ index
+    # -- the surfaces `kraken-tpu status` and the canary plane read).
+    # Hosts ADD this into their :attr:`inflight_work` so a lameduck
+    # drain cannot quiesce -- and tear the listener down -- under an
+    # in-flight status scrape (the round-12 /recipe proxy lesson,
+    # applied to the observability surfaces).
+    debug_inflight = 0
+
+    @contextlib.contextmanager
+    def track_debug_scrape(self):
+        """Wrap a debug-surface handler body: counts into
+        :attr:`debug_inflight` for the drain quiesce."""
+        self.debug_inflight += 1
+        try:
+            yield
+        finally:
+            self.debug_inflight -= 1
 
     def enter_lameduck(self) -> None:
         """Idempotent drain entry: stop advertising, refuse new work,
@@ -61,6 +86,12 @@ class LameduckMixin:
     def add_lameduck_routes(self, router) -> None:
         router.add_post("/debug/lameduck", self._lameduck)
         router.add_get("/debug/lameduck", self._lameduck_state)
+
+    def bind_app(self, app) -> None:
+        """Register this server on its aiohttp app so the shared debug
+        handlers (instrument_app) count scrapes into the drain
+        quiesce.  Every component ``make_app`` calls it."""
+        app[APP_KEY] = self
 
     async def _lameduck(self, req: web.Request) -> web.Response:
         """Operator drain entry (runbook: docs/OPERATIONS.md). The node

@@ -609,18 +609,85 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
         # creation site, bufpool leases, conns, store debris -- plus
         # every node sentinel's budgets and breach state
         # (utils/resources.py; docs/OPERATIONS.md "Resource budgets").
+        # Scrape-guarded: `kraken-tpu status` reads this surface too,
+        # so it gates the drain quiesce like /debug/slo.
         from kraken_tpu.utils.resources import debug_snapshot as resources_snap
 
-        return web.json_response(resources_snap())
+        return await _guarded_json(request, resources_snap)
 
     async def healthcheck_endpoint(request):
         # "Why is this replica being skipped": every live health filter
         # and breaker in the process, with per-host state, consecutive
         # fails, remaining open time, probe occupancy, and the latency
         # EWMA driving brown-out shedding (placement/healthcheck.py).
+        # Scrape-guarded like /debug/resources above.
         from kraken_tpu.placement.healthcheck import debug_snapshot
 
-        return web.json_response(debug_snapshot())
+        return await _guarded_json(request, debug_snapshot)
+
+    async def _guarded_json(request, build_doc):
+        # Debug scrapes gate the lameduck drain quiesce: `kraken-tpu
+        # status` reading /debug/slo mid-drain must not have the
+        # listener torn down under it (the round-12 /recipe lesson).
+        # The guard must span the awaited response WRITE, not just the
+        # synchronous snapshot: the drain poller shares this event
+        # loop, so an await-free hold is invisible to it, and the
+        # vulnerable window is aiohttp streaming the body to a slow
+        # status client.  prepare()+write_eof() put that transmission
+        # INSIDE the guard.  Servers opt in via LameduckMixin.bind_app;
+        # bare test apps without a bound server scrape unguarded.
+        import contextlib
+
+        from kraken_tpu.utils.lameduck import APP_KEY
+
+        server = request.app.get(APP_KEY)
+        guard = (
+            server.track_debug_scrape() if server is not None
+            else contextlib.nullcontext()
+        )
+        with guard:
+            resp = web.json_response(build_doc())
+            await resp.prepare(request)
+            await resp.write_eof()
+            return resp
+
+    async def slo_endpoint(request):
+        # The black-box plane (utils/slo.py): per-SLI burn rates over
+        # the paired fast/slow windows, error budget remaining, firing
+        # alerts, and the last canary probe -- the document
+        # `kraken-tpu status` aggregates fleet-wide.
+        from kraken_tpu.utils.slo import SLO
+
+        return await _guarded_json(request, SLO.debug_snapshot)
+
+    async def debug_index_endpoint(request):
+        # "Which endpoints does this node have": a JSON index of every
+        # registered debug surface plus the core probes, enumerated
+        # from the live router so it can never drift from what is
+        # actually served.  Operators and `kraken-tpu status` stop
+        # guessing.
+        def build():
+            surfaces: dict[str, list[str]] = {}
+            for resource in request.app.router.resources():
+                canonical = resource.canonical
+                if not (
+                    canonical.startswith("/debug")
+                    or canonical in ("/metrics", "/health", "/readiness")
+                ):
+                    continue
+                methods = sorted({
+                    route.method for route in resource
+                    if route.method not in ("HEAD", "OPTIONS", "*")
+                })
+                if methods:
+                    cur = surfaces.setdefault(canonical, [])
+                    cur.extend(m for m in methods if m not in cur)
+            return {
+                "component": component,
+                "surfaces": {k: surfaces[k] for k in sorted(surfaces)},
+            }
+
+        return await _guarded_json(request, build)
 
     async def failpoints_get(request):
         # Chaos runbook surface (docs/OPERATIONS.md): list armed sites
@@ -672,6 +739,9 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
 
     app.middlewares.append(middleware)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/debug", debug_index_endpoint)
+    app.router.add_get("/debug/", debug_index_endpoint)
+    app.router.add_get("/debug/slo", slo_endpoint)
     app.router.add_get("/debug/trace", trace_endpoint)
     app.router.add_get("/debug/healthcheck", healthcheck_endpoint)
     app.router.add_get("/debug/resources", resources_endpoint)

@@ -10,10 +10,87 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 
 _RESERVED = frozenset(logging.LogRecord(
     "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
+class StormFilter(logging.Filter):
+    """Per-(logger, template) rate limit for WARN+ lines.
+
+    A flapping peer or a crash-looping dependency can emit the same
+    WARN thousands of times a second, drowning exactly the
+    postmortem-relevant lines the SLO trace dumps point at.  This
+    filter lets the first ``burst`` records of each (logger name,
+    unformatted template) key through per ``window_seconds``, drops the
+    rest, and attaches ``suppressed_similar: N`` to the FIRST record of
+    the next window -- the periodic "suppressed N similar" summary,
+    riding a real record so no re-entrant emit is needed (the
+    JSONFormatter serializes any extra attribute automatically).
+
+    Keyed on the TEMPLATE (``record.msg``), not the formatted message:
+    "announce %s failed" is one storm regardless of which of 10k
+    torrents is flapping.  INFO and below pass untouched -- operators
+    rate-limit noise at the level knob, not here.  Suppressions count
+    on ``log_suppressed_total`` so a muted storm is still visible on
+    /metrics."""
+
+    def __init__(self, burst: int = 5, window_seconds: float = 60.0,
+                 clock=time.monotonic):
+        super().__init__()
+        self.burst = burst
+        self.window_seconds = window_seconds
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> [window_start, passed_in_window, suppressed_in_window]
+        self._state: dict[tuple[str, str], list[float]] = {}
+        self._counter = None  # lazy: metrics imports must stay optional
+
+    def _count_suppressed(self, n: int) -> None:
+        try:
+            if self._counter is None:
+                from kraken_tpu.utils.metrics import REGISTRY
+
+                self._counter = REGISTRY.counter(
+                    "log_suppressed_total",
+                    "WARN/ERROR lines dropped by the log-storm filter",
+                )
+            self._counter.inc(n)
+        except Exception:  # pragma: no cover - never fail a log call
+            pass
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno < logging.WARNING:
+            return True
+        key = (record.name, str(record.msg))
+        now = self._clock()
+        with self._lock:
+            state = self._state.get(key)
+            if state is None or now - state[0] >= self.window_seconds:
+                suppressed = int(state[2]) if state else 0
+                self._state[key] = [now, 1.0, 0.0]
+                # Bound the key table: one flush sweep per new window of
+                # any key is enough to keep dead keys from accumulating
+                # under template churn (exception reprs vary, keys do
+                # not -- but be safe).
+                if len(self._state) > 4096:
+                    floor = now - self.window_seconds
+                    for k in [k for k, s in self._state.items()
+                              if s[0] < floor]:
+                        del self._state[k]
+                if suppressed:
+                    # The summary line: the first record of the new
+                    # window carries what the last window swallowed.
+                    record.suppressed_similar = suppressed
+                return True
+            if state[1] < self.burst:
+                state[1] += 1
+                return True
+            state[2] += 1
+            self._count_suppressed(1)
+            return False
 
 
 def _trace_ids():
@@ -60,9 +137,12 @@ class JSONFormatter(logging.Formatter):
 def setup_json_logging(
     component: str = "", level: int = logging.INFO
 ) -> None:
-    """Route the root logger to one JSON line per record on stderr."""
+    """Route the root logger to one JSON line per record on stderr,
+    with WARN+ storms rate-limited per (logger, template) -- the
+    summary line carries ``suppressed_similar``."""
     handler = logging.StreamHandler()
     handler.setFormatter(JSONFormatter(component))
+    handler.addFilter(StormFilter())
     root = logging.getLogger()
     root.handlers = [handler]
     root.setLevel(level)

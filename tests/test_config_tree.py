@@ -27,7 +27,7 @@ CLI_KEYS = {
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
-    "profiling", "fleet", "chunkstore",
+    "profiling", "fleet", "chunkstore", "slo", "canary",
 }
 
 
@@ -272,6 +272,62 @@ def test_profiling_sections_construct_profiler_config():
         assert cfg.dump_dir == "", path
         seen += 1
     assert seen >= 3  # agent + origin + tracker ship the profiling knobs
+
+
+def test_slo_sections_construct_slo_config():
+    """Every shipped `slo:` section must map onto SLOConfig through the
+    same from_dict the CLI/assembly use -- a typo'd objective or window
+    knob must fail here, not at production boot (where it would
+    silently disable the paging plane)."""
+    from kraken_tpu.utils.slo import SLOConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        sc = load_config(path).get("slo")
+        if not sc:
+            continue
+        cfg = SLOConfig.from_dict(sc)  # raises on unknown keys
+        assert cfg.enabled is True, path
+        assert cfg.eval_interval_seconds > 0, path
+        assert cfg.bucket_seconds > 0, path
+        # The shipped window pairs must stay the SRE-workbook shape:
+        # page strictly faster + hotter than ticket, AND-conditions
+        # well-formed (short <= long).
+        assert cfg.fast.short_seconds <= cfg.fast.long_seconds, path
+        assert cfg.slow.short_seconds <= cfg.slow.long_seconds, path
+        assert cfg.fast.burn_rate > cfg.slow.burn_rate, path
+        for sli, obj in cfg.objective_map.items():
+            assert 0.0 < obj.target < 1.0, (path, sli)
+        seen += 1
+    assert seen >= 3  # agent + origin + tracker ship the slo knobs
+
+
+def test_canary_sections_construct_canary_config():
+    """Every shipped `canary:` section must map onto CanaryConfig
+    through the same from_dict the CLI/assembly use. The shipped
+    default must stay OFF: probing needs `origins` pointed at the
+    cluster and is a rollout decision, never a config-refresh
+    surprise."""
+    from kraken_tpu.utils.canary import CanaryConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        cc = load_config(path).get("canary")
+        if cc is None:
+            continue
+        cfg = CanaryConfig.from_dict(cc)  # raises on unknown keys
+        assert cfg.enabled is False, (
+            f"{path}: shipped canary.enabled must stay false"
+        )
+        assert cfg.interval_seconds >= 10.0, (
+            f"{path}: shipped canary cadence must stay modest (the"
+            " data-plane bands are measured without canary load)"
+        )
+        assert 0 < cfg.blob_bytes <= 4 * 1024 * 1024, path
+        assert cfg.pull_timeout_seconds > 0, path
+        assert cfg.ttl_seconds > cfg.interval_seconds, path
+        seen += 1
+    assert seen >= 1  # the agent registers the canary knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -397,3 +397,63 @@ def test_debug_jax_profile_endpoint(tmp_path):
             await tracker.stop()
 
     asyncio.run(main())
+
+
+def test_log_storm_filter_suppresses_and_summarizes():
+    """utils/structlog.StormFilter: a repeated WARN template passes
+    `burst` lines per window, drops the rest (counted on /metrics),
+    and the first line of the next window carries `suppressed_similar`
+    -- so a flapping peer cannot drown the postmortem-relevant lines
+    the SLO dumps point at."""
+    from kraken_tpu.utils.structlog import StormFilter
+
+    t = [0.0]
+    filt = StormFilter(burst=3, window_seconds=60.0, clock=lambda: t[0])
+
+    def rec(msg, *args, level=logging.WARNING, name="kraken.p2p"):
+        return logging.LogRecord(name, level, __file__, 1, msg, args, None)
+
+    # Template-keyed: 100 instances of one storm, 3 pass.
+    passed = [r for r in (
+        rec("announce %s failed", i) for i in range(100)
+    ) if filt.filter(r)]
+    assert len(passed) == 3
+    # A DIFFERENT template is its own key and passes fresh.
+    assert filt.filter(rec("conn %s reset", 1))
+    # INFO and below are never storm-limited.
+    assert all(
+        filt.filter(rec("announce %s failed", i, level=logging.INFO))
+        for i in range(10)
+    )
+    # Next window: the first record passes AND carries the summary.
+    t[0] += 61
+    summary = rec("announce %s failed", 101)
+    assert filt.filter(summary)
+    assert summary.suppressed_similar == 97
+    # The summary serializes into the JSON line (the formatter emits
+    # every non-reserved attribute).
+    line = json.loads(JSONFormatter("agent").format(summary))
+    assert line["suppressed_similar"] == 97
+    # A second record in the new window has no summary to carry.
+    follow = rec("announce %s failed", 102)
+    assert filt.filter(follow)
+    assert not hasattr(follow, "suppressed_similar")
+    # Suppressions are visible on /metrics even while muted.
+    assert REGISTRY.counter("log_suppressed_total").value() >= 97
+
+
+def test_log_storm_filter_is_wired_into_setup(monkeypatch):
+    """setup_json_logging installs the storm filter on its handler --
+    the production path, not just the class."""
+    from kraken_tpu.utils.structlog import StormFilter, setup_json_logging
+
+    root = logging.getLogger()
+    handlers0, level0 = root.handlers[:], root.level
+    try:
+        setup_json_logging("agent")
+        assert any(
+            isinstance(f, StormFilter)
+            for h in root.handlers for f in h.filters
+        )
+    finally:
+        root.handlers, root.level = handlers0, level0

@@ -1,0 +1,80 @@
+"""Generated Prometheus deployment (utils/promgen.py, `kraken-tpu
+promgen`).
+
+Two CI gates:
+
+- the committed ``deploy/prometheus/`` files must match a fresh
+  generation byte for byte (edit the generator, not the output);
+- every metric the alert rules reference must be a name the
+  docs/OPERATIONS.md metric-catalog lint knows -- an alert expression
+  over a renamed or never-registered metric silently never fires,
+  which is the worst failure mode an alert can have.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from kraken_tpu.utils.promgen import (
+    generate_alert_rules,
+    generate_prometheus_config,
+    referenced_metric_names,
+    write_files,
+)
+from kraken_tpu.utils.slo import DEFAULT_FAST, DEFAULT_SLOW, format_window
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "deploy", "prometheus")
+
+
+def test_committed_files_match_regeneration(tmp_path):
+    """`python -m kraken_tpu.cli promgen` committed output is current."""
+    paths = write_files(str(tmp_path))
+    for path in paths:
+        name = os.path.basename(path)
+        committed = os.path.join(OUT, name)
+        assert os.path.exists(committed), (
+            f"deploy/prometheus/{name} missing -- run"
+            " `python -m kraken_tpu.cli promgen`"
+        )
+        with open(path) as fresh, open(committed) as repo:
+            assert fresh.read() == repo.read(), (
+                f"deploy/prometheus/{name} drifted -- run"
+                " `python -m kraken_tpu.cli promgen`"
+            )
+
+
+def test_rules_reference_only_cataloged_metrics():
+    rules = generate_alert_rules()
+    names = referenced_metric_names(rules)
+    assert names, "the extractor must find the rule metrics"
+    assert "slo_burn_rate" in names  # sanity: the headline rule is seen
+    with open(os.path.join(REPO, "docs", "OPERATIONS.md")) as f:
+        docs = f.read()
+    missing = sorted(n for n in names if f"`{n}" not in docs)
+    assert not missing, (
+        "alert rules reference metrics the OPERATIONS.md catalog does"
+        f" not know (rename drift -- these alerts would never fire):"
+        f" {missing}"
+    )
+
+
+def test_burn_rule_windows_match_the_shipped_evaluator():
+    """The window labels in the generated expressions must be the exact
+    strings the in-process evaluator exports on `slo_burn_rate{window}`
+    -- promgen and utils/slo.py share one source of truth."""
+    rules = generate_alert_rules()
+    for pair in (DEFAULT_FAST, DEFAULT_SLOW):
+        for seconds in (pair.short_seconds, pair.long_seconds):
+            assert f'window="{format_window(seconds)}"' in rules
+        assert f"> {pair.burn_rate}" in rules
+
+
+def test_scrape_config_covers_every_component():
+    cfg = generate_prometheus_config()
+    for component in ("agent", "tracker", "origin", "build-index", "proxy"):
+        assert f"job_name: kraken-{component}" in cfg
+    # The rule file is wired in, and every target is a real port.
+    assert "kraken-alerts.yml" in cfg
+    assert re.search(r"targets: \['localhost:\d+'\]", cfg)

@@ -1,0 +1,690 @@
+"""SLO & canary plane (utils/slo.py, utils/canary.py, `kraken-tpu
+status`).
+
+What must hold, per docs/OPERATIONS.md "SLO & canary":
+
+- the sliding-window burn-rate math is exact and deterministic: budget
+  exhaustion reads negative, a page needs BOTH windows of its pair hot
+  (the AND-condition), and recovery clears on the short window alone
+  (hysteresis) while the long window is still hot;
+- objectives and windows live-reload (SIGHUP) without losing history;
+- a firing page ships its own postmortem: the PR-8 flight-recorder
+  dump plus the PR-10 profile capture;
+- the canary prober drives a real upload + swarm pull under the
+  reserved namespace, records canary-labeled SLI samples and the PR-8
+  stage split, forces trace sampling (one joined trace per probe), and
+  TTL-reaps its blobs from both sides;
+- `GET /debug/` indexes the node's debug surfaces; `GET /debug/slo`
+  serves the evaluator document; both scrapes count into the lameduck
+  drain quiesce (the round-12 /recipe lesson);
+- `kraken-tpu status` aggregates a node list and exits 0 healthy /
+  1 burning / 2 unreachable;
+- THE acceptance chain: zero user traffic + an injected origin
+  failpoint -> canary probes fail -> `slo_burn_rate{sli="pull"}` over
+  the fast-burn threshold -> /debug/slo reports the firing page ->
+  trace dump + profile capture land on disk -> `kraken-tpu status`
+  exits non-zero against the herd.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import os
+import time
+
+import pytest
+
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.slo import (
+    CANARY_NAMESPACE,
+    SLO,
+    SLIRecorder,
+    SLOConfig,
+    format_window,
+)
+from kraken_tpu.utils.trace import TRACER, TraceConfig
+
+NS = "library/slo-test"
+
+
+@pytest.fixture(autouse=True)
+def _slo_isolation():
+    """The SLO manager is process-global (like the TRACER): stop its
+    evaluator thread, snapshot config/node/clock, and clear recorders +
+    alert latches around every test so burn state never leaks between
+    suites."""
+    SLO.stop()
+    cfg0, node0, clock0 = SLO.config, SLO.node, SLO._clock
+    canary0 = SLO.canary_status
+    SLO._recorders.clear()
+    SLO._alerts.clear()
+    SLO._last_eval = {}
+    SLO.canary_status = None
+    yield
+    SLO.stop()
+    SLO.config, SLO.node, SLO._clock = cfg0, node0, clock0
+    SLO.canary_status = canary0
+    SLO._recorders.clear()
+    SLO._alerts.clear()
+    SLO._last_eval = {}
+
+
+@pytest.fixture(autouse=True)
+def _tracer_isolation():
+    # The PROFILER's per-trigger capture throttle is process-global
+    # too: earlier suites' failed pulls now fire slo_fast_burn pages
+    # of their own, and a stamp within 30 s would mute THIS suite's
+    # capture assertions (by-design throttling in production, cross-
+    # suite leakage here).
+    from kraken_tpu.utils.profiler import PROFILER
+
+    cfg0, node0 = TRACER.config, TRACER.node
+    TRACER.recorder.clear()
+    TRACER._last_dump.clear()
+    PROFILER._last_dump.clear()
+    yield
+    TRACER.config, TRACER.node = cfg0, node0
+    TRACER.recorder.clear()
+    TRACER._last_dump.clear()
+    PROFILER._last_dump.clear()
+
+
+@pytest.fixture(autouse=True)
+def _failpoints_clean():
+    failpoints.FAILPOINTS.disarm_all()
+    yield
+    failpoints.FAILPOINTS.disarm_all()
+
+
+def _fake_clock(start: float = 1000.0):
+    t = [start]
+    SLO._clock = lambda: t[0]
+    return t
+
+
+_TEST_CFG = {
+    "bucket_seconds": 1.0,
+    "eval_interval_seconds": 1.0,
+    "objectives": {"pull": {"target": 0.9}},
+    # budget 0.1 => max possible burn is 10x; thresholds sit below it.
+    "fast": {"short_seconds": 10, "long_seconds": 60, "burn_rate": 6.0},
+    "slow": {"short_seconds": 30, "long_seconds": 120, "burn_rate": 2.0},
+}
+
+
+def _set_config(**over) -> SLOConfig:
+    cfg = SLOConfig.from_dict({**_TEST_CFG, **over})
+    SLO.config = cfg  # direct: unit tests never want the eval thread
+    return cfg
+
+
+# -- burn-rate math ---------------------------------------------------------
+
+
+def test_window_counts_and_burn_rates_are_exact():
+    t = _fake_clock()
+    _set_config()
+    # 40 good spread over [t, t+50); then 8 good + 2 bad in the last
+    # 10 s.  Short window err = 0.2 -> burn 2.0; the long window holds
+    # everything: err = 2/50 = 0.04 -> burn 0.4.
+    for _ in range(40):
+        SLO.record("pull", True)
+    t[0] += 50
+    for _ in range(8):
+        SLO.record("pull", True)
+    for _ in range(2):
+        SLO.record("pull", False)
+    doc = SLO.evaluate()
+    w = doc["pull"]["windows"]
+    assert w["10s"]["burn_rate"] == pytest.approx(2.0)
+    assert w["10s"]["good"] == 8 and w["10s"]["bad"] == 2
+    assert w["1m"]["burn_rate"] == pytest.approx(0.4)
+    assert doc["pull"]["budget_remaining"] == pytest.approx(
+        1 - 0.04 / 0.1, abs=1e-6
+    )
+
+
+def test_budget_exhaustion_reads_negative():
+    _fake_clock()
+    _set_config()
+    for _ in range(10):
+        SLO.record("pull", False)
+    doc = SLO.evaluate()
+    # 100% errors against a 10% budget: 10x overdrawn.
+    assert doc["pull"]["budget_remaining"] == pytest.approx(-9.0)
+    assert SLO._g_budget.value(sli="pull") == pytest.approx(-9.0)
+
+
+def test_page_fires_only_when_both_windows_burn():
+    t = _fake_clock()
+    _set_config()
+    # A long healthy history, then a hot 10 s: the short window burns
+    # (err 1.0 -> 10x) but the long window is diluted below threshold.
+    for _ in range(200):
+        SLO.record("pull", True)
+    t[0] += 55
+    for _ in range(5):
+        SLO.record("pull", False)
+    doc = SLO.evaluate()
+    w = doc["pull"]["windows"]
+    assert w["10s"]["burn_rate"] > 6.0
+    assert w["1m"]["burn_rate"] < 6.0
+    assert doc["pull"]["alerts"]["page"]["firing"] is False, (
+        "short-window-only burn must NOT page (the AND-condition)"
+    )
+    # The healthy history ages out of the long window while the errors
+    # persist: now both windows burn and the page fires.
+    t[0] += 15
+    for _ in range(5):
+        SLO.record("pull", False)
+    doc = SLO.evaluate()
+    w = doc["pull"]["windows"]
+    assert w["10s"]["burn_rate"] > 6.0 and w["1m"]["burn_rate"] > 6.0
+    assert doc["pull"]["alerts"]["page"]["firing"] is True
+    assert SLO.firing()[0]["sli"] == "pull"
+    assert SLO._g_firing.value(sli="pull", severity="page") == 1.0
+
+
+def test_recovery_hysteresis_clears_on_short_window_alone():
+    t = _fake_clock()
+    _set_config()
+    for _ in range(10):
+        SLO.record("pull", False)
+    doc = SLO.evaluate()
+    assert doc["pull"]["alerts"]["page"]["firing"] is True
+    # Errors stop; 5 s later the short window still holds them -> the
+    # alert must KEEP firing (no flap on the first quiet evaluation).
+    t[0] += 5
+    doc = SLO.evaluate()
+    assert doc["pull"]["alerts"]["page"]["firing"] is True
+    # 15 s after the last error the short window is clean -> clears,
+    # even though the long window still burns well above threshold
+    # (clearing on the AND of both would page for the long window's
+    # whole span after recovery).
+    t[0] += 10
+    doc = SLO.evaluate()
+    assert doc["pull"]["windows"]["1m"]["burn_rate"] > 6.0
+    assert doc["pull"]["alerts"]["page"]["firing"] is False
+    assert SLO._g_firing.value(sli="pull", severity="page") == 0.0
+
+
+def test_slow_success_counts_against_the_budget():
+    _fake_clock()
+    _set_config(objectives={
+        "pull": {"target": 0.9, "latency_threshold_seconds": 1.0},
+    })
+    SLO.record("pull", True, latency_s=0.5)
+    SLO.record("pull", True, latency_s=5.0)  # success, but too slow
+    doc = SLO.evaluate()
+    assert doc["pull"]["windows"]["10s"]["good"] == 1
+    assert doc["pull"]["windows"]["10s"]["bad"] == 1
+
+
+def test_canary_samples_are_counted_and_broken_out():
+    _fake_clock()
+    _set_config()
+    c0 = SLO._c_events.value(sli="pull", result="bad", canary="1")
+    SLO.record("pull", True)
+    SLO.record("pull", False, canary=True)
+    doc = SLO.evaluate()
+    w = doc["pull"]["windows"]["10s"]
+    # Canary is IN the burn math (black-box) and separately visible.
+    assert w["good"] == 1 and w["bad"] == 1
+    assert w["canary_bad"] == 1 and w["canary_good"] == 0
+    assert SLO._c_events.value(
+        sli="pull", result="bad", canary="1"
+    ) == c0 + 1
+
+
+def test_live_reload_of_objectives_keeps_history():
+    t = _fake_clock()
+    _set_config()
+    for _ in range(4):
+        SLO.record("pull", False)
+    assert SLO.evaluate()["pull"]["windows"]["10s"]["bad"] == 4
+    # Reload with a looser target: same events, new budget math --
+    # history must survive (the window IS the state).
+    SLO.apply({**_TEST_CFG, "enabled": False,
+               "objectives": {"pull": {"target": 0.5}}})
+    doc = SLO.evaluate()
+    assert doc["pull"]["windows"]["10s"]["bad"] == 4
+    assert doc["pull"]["windows"]["10s"]["burn_rate"] == pytest.approx(2.0)
+    # Changing the bucket geometry is the one reload that resets
+    # recorders (old buckets are unreadable at the new granularity).
+    SLO.apply({**_TEST_CFG, "enabled": False, "bucket_seconds": 2.0})
+    assert SLO.evaluate()["pull"]["windows"]["10s"]["bad"] == 0
+    del t
+
+
+def test_apply_follows_the_enabled_flag():
+    _set_config()
+    SLO.apply({**_TEST_CFG, "enabled": True})
+    assert SLO._thread is not None and SLO._thread.is_alive()
+    SLO.apply({**_TEST_CFG, "enabled": False})
+    assert SLO._thread is None
+    # Disabled: record() is a no-op (no recorder growth).
+    SLO.record("pull", False)
+    assert "pull" not in SLO._recorders
+
+
+def test_config_validation_rejects_typos_and_bad_values():
+    with pytest.raises(ValueError, match="unknown slo config keys"):
+        SLOConfig.from_dict({"windowz": {}})
+    with pytest.raises(ValueError, match="target must be in"):
+        SLOConfig.from_dict({"objectives": {"pull": {"target": 1.5}}})
+    with pytest.raises(ValueError, match="unknown keys in slo objective"):
+        SLOConfig.from_dict({"objectives": {"pull": {"targt": 0.9}}})
+    with pytest.raises(ValueError, match="short <= long"):
+        SLOConfig.from_dict(
+            {"fast": {"short_seconds": 60, "long_seconds": 5}}
+        )
+    with pytest.raises(ValueError, match="burn_rate"):
+        SLOConfig.from_dict({"slow": {"burn_rate": 0}})
+    from kraken_tpu.utils.canary import CanaryConfig
+
+    with pytest.raises(ValueError, match="unknown canary config keys"):
+        CanaryConfig.from_dict({"intervall_seconds": 5})
+    with pytest.raises(ValueError, match="blob_bytes"):
+        CanaryConfig.from_dict({"blob_bytes": 0})
+
+
+def test_format_window_labels():
+    assert format_window(300) == "5m"
+    assert format_window(3600) == "1h"
+    assert format_window(21600) == "6h"
+    assert format_window(90) == "90s"
+
+
+def test_recorder_prunes_past_horizon():
+    t = [0.0]
+    rec = SLIRecorder(1.0, 10.0, clock=lambda: t[0])
+    for _ in range(5):
+        rec.record(False)
+    t[0] += 100
+    rec.record(True)  # triggers the prune
+    assert len(rec._buckets) == 1
+    assert rec.counts(10.0)["bad"] == 0
+
+
+# -- the page ships its own postmortem --------------------------------------
+
+
+def test_fast_burn_page_writes_flight_recorder_dump(tmp_path):
+    _fake_clock()
+    _set_config()
+    TRACER.apply(TraceConfig(sample_rate=1.0, dump_dir=str(tmp_path)))
+    captured: list[tuple[str, str]] = []
+    TRACER.on_trigger = lambda trig, detail: captured.append((trig, detail))
+    try:
+        from kraken_tpu.utils import trace
+
+        with trace.span("slo.test.pull"):
+            pass  # the ring must hold something to dump
+        for _ in range(10):
+            SLO.record("pull", False)
+        SLO.evaluate()  # sync context: the dump write is synchronous
+        dumps = glob.glob(str(tmp_path / "trace-slo_fast_burn-*.jsonl"))
+        assert len(dumps) == 1, "a firing page must persist the ring"
+        header = json.loads(open(dumps[0]).read().splitlines()[0])
+        assert header["dump"] == "slo_fast_burn"
+        assert "pull" in header["detail"]
+        # The profiler capture hook (PR 10) fired through on_trigger.
+        assert captured and captured[0][0] == "slo_fast_burn"
+        # Still firing on the next evaluation: no second dump (the
+        # trigger fires on the TRANSITION, not every tick).
+        SLO.evaluate()
+        assert len(
+            glob.glob(str(tmp_path / "trace-slo_fast_burn-*.jsonl"))
+        ) == 1
+    finally:
+        TRACER.on_trigger = None
+
+
+# -- canary unit ------------------------------------------------------------
+
+
+def test_canary_blob_deterministic_and_unique():
+    from kraken_tpu.utils.canary import canary_blob
+
+    a1 = canary_blob("agent-x", 1, 4096)
+    a2 = canary_blob("agent-x", 1, 4096)
+    b = canary_blob("agent-x", 2, 4096)
+    c = canary_blob("agent-y", 1, 4096)
+    assert a1 == a2 and len(a1) == 4096
+    assert a1 != b and a1 != c
+    # The boot epoch is part of the derivation: a restarted agent must
+    # never regenerate its previous run's digests (a warm-cache probe
+    # is a no-op probe).
+    assert canary_blob("agent-x", 1, 4096, epoch=7) != a1
+    assert canary_blob("agent-x", 1, 4096, epoch=7) == canary_blob(
+        "agent-x", 1, 4096, epoch=7
+    )
+
+
+# -- surfaces + status tool -------------------------------------------------
+
+
+def _herd_slo_cfg() -> dict:
+    # Tight windows so a herd test fires within seconds: target 0.9
+    # (max burn 10x), page on >3x over 6s AND 12s, ticket >1.5x over
+    # 10s AND 30s.
+    return {
+        "eval_interval_seconds": 0.2,
+        "bucket_seconds": 1.0,
+        "objectives": {"pull": {"target": 0.9}},
+        "fast": {"short_seconds": 6, "long_seconds": 12, "burn_rate": 3.0},
+        "slow": {"short_seconds": 10, "long_seconds": 30, "burn_rate": 1.5},
+    }
+
+
+def test_debug_index_and_slo_surface_and_drain_inflight(monkeypatch):
+    """/debug/ lists what the node serves; /debug/slo answers; both
+    scrapes count into inflight_work so a drain cannot quiesce under
+    them (the round-12 /recipe lesson applied to the new surfaces)."""
+    from kraken_tpu.assembly import TrackerNode
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    async def main():
+        tracker = TrackerNode(slo={**_herd_slo_cfg(), "enabled": False})
+        await tracker.start()
+        http = HTTPClient()
+        try:
+            for path in ("/debug/", "/debug"):
+                idx = json.loads(
+                    await http.get(f"http://{tracker.addr}{path}")
+                )
+                assert idx["component"] == "tracker"
+                surfaces = idx["surfaces"]
+                for expected in (
+                    "/metrics", "/health", "/debug/slo", "/debug/trace",
+                    "/debug/healthcheck", "/debug/resources",
+                    "/debug/failpoints", "/debug/lameduck",
+                    "/debug/pprof/profile",
+                ):
+                    assert expected in surfaces, (expected, surfaces)
+                assert "GET" in surfaces["/debug/slo"]
+                assert "POST" in surfaces["/debug/lameduck"]
+
+            # The drain-quiesce fix: while the slo handler runs, the
+            # server's inflight_work must be > 0 -- observed from
+            # INSIDE the scrape by the patched snapshot provider.
+            seen: list[int] = []
+            real = SLO.debug_snapshot
+
+            def spying_snapshot():
+                seen.append(tracker.server.inflight_work)
+                return real()
+
+            monkeypatch.setattr(SLO, "debug_snapshot", spying_snapshot)
+            doc = json.loads(
+                await http.get(f"http://{tracker.addr}/debug/slo")
+            )
+            assert doc["enabled"] is False
+            assert seen == [1], (
+                "a /debug/slo scrape must gate the drain quiesce"
+            )
+            assert tracker.server.inflight_work == 0
+        finally:
+            await http.close()
+            await tracker.stop()
+
+    asyncio.run(main())
+
+
+def test_status_tool_exit_codes_against_live_node():
+    from kraken_tpu.assembly import TrackerNode
+    from kraken_tpu.cli import run_status_tool
+
+    async def main():
+        tracker = TrackerNode(slo=_herd_slo_cfg())
+        await tracker.start()
+        try:
+            # Healthy: nothing recorded, nothing burns.
+            rc = await asyncio.to_thread(run_status_tool, [tracker.addr])
+            assert rc == 0
+            # Burn the budget (target 0.9, every event bad) and force
+            # an evaluation: the node's own /debug/slo now reports the
+            # firing page and status gates on it.
+            for _ in range(10):
+                SLO.record("pull", False)
+            SLO.evaluate()
+            assert SLO.firing()
+            rc = await asyncio.to_thread(run_status_tool, [tracker.addr])
+            assert rc == 1
+            # An unreachable node dominates: the gate cannot call a
+            # fleet it cannot see healthy.
+            rc = await asyncio.to_thread(
+                run_status_tool, [tracker.addr, "127.0.0.1:1"], 2.0
+            )
+            assert rc == 2
+        finally:
+            await tracker.stop()
+        assert await asyncio.to_thread(run_status_tool, []) == 3
+
+    asyncio.run(main())
+
+
+# -- the herd: canary through the real stack --------------------------------
+
+
+async def _start_herd(tmp_path, canary_overrides: dict | None = None):
+    from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
+    from kraken_tpu.origin.client import ClusterClient
+    from kraken_tpu.placement import HostList, Ring
+
+    # sample_rate 0: whatever the canary traces, IT sampled.
+    tcfg = {"sample_rate": 0.0, "keep_spans": 8192}
+    tracker = TrackerNode(
+        announce_interval_seconds=0.1, peer_ttl_seconds=5.0, trace=tcfg,
+    )
+    await tracker.start()
+    origin = OriginNode(
+        store_root=str(tmp_path / "origin"), tracker_addr=tracker.addr,
+        trace=tcfg, slo=_herd_slo_cfg(),
+    )
+    await origin.start()
+    ring = Ring(HostList(static=[origin.addr]), max_replica=2)
+    cluster = ClusterClient(ring)
+    tracker.server.origin_cluster = cluster
+    origin.ring = ring
+    if origin.server:
+        origin.server.ring = ring
+    agent = AgentNode(
+        store_root=str(tmp_path / "agent"), tracker_addr=tracker.addr,
+        trace=tcfg, slo=_herd_slo_cfg(),
+        canary={
+            "enabled": True, "interval_seconds": 0.3, "blob_bytes": 32768,
+            "origins": origin.addr, "pull_timeout_seconds": 1.0,
+            "ttl_seconds": 60.0,
+            **(canary_overrides or {}),
+        },
+    )
+    await agent.start()
+    return tracker, origin, cluster, agent
+
+
+async def _stop_herd(tracker, origin, cluster, agent):
+    await agent.stop()
+    await origin.stop()
+    await cluster.close()
+    await tracker.stop()
+
+
+def test_canary_ttl_reap_removes_blobs_both_sides(tmp_path):
+    from kraken_tpu.core.digest import Digest
+
+    async def main():
+        tracker, origin, cluster, agent = await _start_herd(
+            tmp_path, {"enabled": False, "ttl_seconds": 0.05}
+        )
+        try:
+            # Canary blobs are EPHEMERAL: the origin's commit pipeline
+            # must not ring-replicate them (copies on peer origins the
+            # reap's DELETE never reaches) nor write them back to a
+            # backend -- spy on the enqueue to prove the gate.
+            repl_calls: list[str] = []
+            real_enq = origin.server._enqueue_replication
+            origin.server._enqueue_replication = (
+                lambda ns, d: repl_calls.append(ns)
+            )
+            try:
+                doc = await agent.canary.probe([origin.addr])
+            finally:
+                origin.server._enqueue_replication = real_enq
+            assert doc["result"] == "ok"
+            assert repl_calls == [], (
+                "canary commits must skip replication/writeback"
+            )
+            d = Digest.from_hex(doc["digest"])
+            assert agent.store.in_cache(d) and origin.store.in_cache(d)
+            await asyncio.sleep(0.1)
+            await agent.canary._reap()
+            assert not agent.store.in_cache(d), "agent copy must reap"
+            assert not origin.store.in_cache(d), "origin copy must reap"
+            assert agent.canary._live == {}
+
+            # Crash-restart contract: a SECOND probe's blob, then a
+            # FRESH prober over the same store (simulating the agent
+            # restarting after a crash) must load the persisted reap
+            # state and clean the orphan the dead prober left on the
+            # origin -- and must derive NEW digests (fresh epoch).
+            from kraken_tpu.utils.canary import CanaryProber
+
+            doc2 = await agent.canary.probe([origin.addr])
+            d2 = Digest.from_hex(doc2["digest"])
+            assert origin.store.in_cache(d2)
+            reborn = CanaryProber(
+                agent.store, agent.scheduler, agent.canary.config,
+                node=agent.canary.node,
+            )
+            reborn._epoch = agent.canary._epoch + 1  # a later boot
+            assert d2.hex in {v[0].hex for v in reborn._live.values()}
+            await asyncio.sleep(0.1)
+            await reborn._reap()
+            assert not origin.store.in_cache(d2), (
+                "a restarted prober must reap its predecessor's blobs"
+            )
+            from kraken_tpu.utils.canary import canary_blob
+
+            assert canary_blob(
+                reborn.node, doc2["seq"], 64, reborn._epoch
+            ) != canary_blob(
+                agent.canary.node, doc2["seq"], 64, agent.canary._epoch
+            )
+        finally:
+            await _stop_herd(tracker, origin, cluster, agent)
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_acceptance_canary_burn_fires_dumps_and_status_gates(tmp_path):
+    """THE acceptance chain (ISSUE 14): with ZERO user traffic and an
+    injected origin failpoint, the canary prober drives
+    `slo_burn_rate{sli="pull"}` over the fast-burn threshold,
+    /debug/slo reports the firing page, a trace dump AND a profile
+    capture land on disk, and `kraken-tpu status` exits non-zero
+    against the herd.  The healthy half first: one probe = one joined
+    trace + canary-labeled SLI samples + the PR-8 stage split."""
+    from kraken_tpu.cli import run_status_tool
+    from kraken_tpu.utils.httputil import HTTPClient
+
+    async def main():
+        tracker, origin, cluster, agent = await _start_herd(tmp_path)
+        http = HTTPClient()
+        try:
+            # -- healthy probe: the canary pull works the real stack --
+            doc = await agent.canary.probe([origin.addr])
+            assert doc["result"] == "ok", doc
+            # The PR-8 stage split of the probe's own pull.
+            for stage in ("upload_s", "pull_s", "plan_s", "dial_s",
+                          "piece_wait_s", "verify_s", "write_s"):
+                assert stage in doc["stages"], doc["stages"]
+            # One joined trace, forced-sampled by the probe (the herd
+            # runs sample_rate 0, so every kept span here is canary's).
+            spans = [
+                s for s in TRACER.recorder.snapshot()
+                if s["trace_id"] == doc["trace_id"]
+            ]
+            names = {s["name"] for s in spans}
+            assert {"canary.probe", "p2p.download", "p2p.announce"} <= names, (
+                names
+            )
+            # Canary-labeled SLI samples are in the recorders.
+            SLO.evaluate()
+            counts = SLO._recorders["pull"].counts(300)
+            assert counts["canary_good"] >= 1 and counts["bad"] == 0
+            # No alert burns on a healthy canary.
+            assert SLO.firing() == []
+            rc = await asyncio.to_thread(
+                run_status_tool,
+                [agent.addr, origin.addr, tracker.addr],
+            )
+            assert rc == 0
+
+            # -- inject the origin failpoint: reads stall 3 s, every
+            # canary pull (1 s budget) now fails; the background
+            # prober (0.3 s cadence) burns the budget on its own. --
+            # Clear both postmortem throttles first: a slo_fast_burn
+            # dump from ANOTHER suite's page within the last 30 s must
+            # not mute the captures this test asserts on.
+            from kraken_tpu.utils.profiler import PROFILER
+
+            TRACER._last_dump.clear()
+            PROFILER._last_dump.clear()
+            failpoints.FAILPOINTS.arm(
+                f"rpc.brownout.slow@{origin.addr}", "always+delay:3000"
+            )
+            deadline = time.monotonic() + 30
+            firing: list = []
+            while time.monotonic() < deadline:
+                slo = json.loads(
+                    await http.get(f"http://{agent.addr}/debug/slo")
+                )
+                firing = slo.get("firing", [])
+                if any(
+                    f["sli"] == "pull" and f["severity"] == "page"
+                    for f in firing
+                ):
+                    break
+                await asyncio.sleep(0.2)
+            assert any(
+                f["sli"] == "pull" and f["severity"] == "page"
+                for f in firing
+            ), f"fast-burn page never fired: {firing}"
+            # The gauges the alert rules scrape.
+            assert SLO._g_burn.value(sli="pull", window="6s") > 3.0
+            assert SLO._g_firing.value(sli="pull", severity="page") == 1.0
+
+            # -- the page shipped its own postmortem: trace dump +
+            # profile capture beside the agent's store. --
+            dump_dir = str(tmp_path / "agent" / "traces")
+            deadline = time.monotonic() + 10
+            trace_dumps = profile_dumps = []
+            while time.monotonic() < deadline:
+                trace_dumps = glob.glob(
+                    os.path.join(dump_dir, "trace-slo_fast_burn-*.jsonl")
+                )
+                profile_dumps = glob.glob(
+                    os.path.join(dump_dir, "profile-slo_fast_burn-*.jsonl")
+                )
+                if trace_dumps and profile_dumps:
+                    break
+                await asyncio.sleep(0.2)
+            assert trace_dumps, "firing page must write a trace dump"
+            assert profile_dumps, "firing page must capture a profile"
+
+            # -- the operator entry point gates on the herd. --
+            rc = await asyncio.to_thread(
+                run_status_tool,
+                [agent.addr, origin.addr, tracker.addr],
+            )
+            assert rc == 1
+        finally:
+            failpoints.FAILPOINTS.disarm_all()
+            await http.close()
+            await _stop_herd(tracker, origin, cluster, agent)
+
+    asyncio.run(main())
