@@ -1145,9 +1145,12 @@ class OriginNode:
             await self._health_http.close()
         if self.server:
             await self.server.close_heal_cluster()
-        # After the listeners are down: no handler can enqueue anymore,
-        # so the retry store's sqlite handle can be released (leak found
-        # by the soak harness's fd audit).
+        # After the listeners are down: no handler can enqueue anymore.
+        # Reap the cancelled poll task BEFORE releasing the sqlite
+        # handle -- cancellation lands at its next await, and a close
+        # under a still-running run_once strands the task (the soak
+        # tripwire caught exactly this race).
+        await self.retry.reap()
         self.retry.close()
         # LAST: the clean-shutdown stamp bounds the next boot's fsck
         # crash-window verify to blobs written after this instant.
@@ -1212,6 +1215,7 @@ class BuildIndexNode:
         self.retry.stop()
         if self._runner:
             await self._runner.cleanup()
+        await self.retry.reap()
         self.retry.close()
 
 

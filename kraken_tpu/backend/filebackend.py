@@ -7,7 +7,9 @@ store exists.
 
 from __future__ import annotations
 
+import asyncio
 import os
+import uuid
 
 from kraken_tpu.backend.base import (
     BackendClient,
@@ -43,9 +45,15 @@ class FileBackend(BackendClient):
             import errno
 
             raise OSError(errno.EIO, "failpoint backend.file.download", name)
-        try:
+        def _read() -> bytes:
             with open(self._path(name), "rb") as f:
                 return f.read()
+
+        try:
+            # Whole-blob disk read off the event loop: backends serve
+            # read-through misses mid-pull, and a multi-MB sync read
+            # here parks every conn pump in the process.
+            return await asyncio.to_thread(_read)
         except FileNotFoundError:
             raise BlobNotFoundError(name) from None
 
@@ -55,11 +63,25 @@ class FileBackend(BackendClient):
 
             raise OSError(errno.ENOSPC, "failpoint backend.file.upload", name)
         path = self._path(name)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+
+        def _write() -> None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # Unique tmp per call: now that writes run off-loop they can
+            # interleave, and two same-name uploads sharing one ".tmp"
+            # would race replace() into a spurious FileNotFoundError.
+            tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        await asyncio.to_thread(_write)
 
     async def list(self, prefix: str) -> list[str]:
         out = []

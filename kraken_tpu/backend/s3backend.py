@@ -19,6 +19,7 @@ import asyncio
 import datetime
 import hashlib
 import hmac
+import logging
 import os
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -31,6 +32,8 @@ from kraken_tpu.backend.base import (
 )
 from kraken_tpu.backend.namepath import get_pather
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError
+
+_log = logging.getLogger("kraken.backend.s3")
 
 _EMPTY_SHA = hashlib.sha256(b"").hexdigest()
 
@@ -201,7 +204,9 @@ class S3Backend(BackendClient):
         try:
             etags: list[str] = []
             part_num = 0
-            with open(path, "rb") as f:
+            # open() off-loop too: on a cold NFS/network mount the open
+            # alone can stall the loop for the full mount timeout.
+            with await asyncio.to_thread(open, path, "rb") as f:
                 while True:
                     chunk = await asyncio.to_thread(
                         f.read, self.multipart_part_size
@@ -258,7 +263,10 @@ class S3Backend(BackendClient):
                     ok=(200, 204),
                 )
             except Exception:
-                pass
+                _log.warning(
+                    "multipart abort failed; billed orphan parts may"
+                    " remain in the bucket", exc_info=True,
+                )
             raise
 
     async def download_to_file(

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 from urllib.parse import quote, unquote
 
 from aiohttp import web
@@ -163,7 +164,13 @@ class TagServer:
                 try:
                     await self.origin_cluster.stat(ns, dep)
                 except Exception:
-                    pass  # best-effort preheat
+                    # Best-effort preheat: the repair path covers a cold
+                    # dep, but a persistently failing cluster should be
+                    # visible in the logs, not silent.
+                    logging.getLogger("kraken.buildindex").debug(
+                        "dependency preheat failed for %s", dep,
+                        exc_info=True,
+                    )
         # Two clusters minting the same tag differently is a config
         # error; refusing (409) keeps it visible in the source's retry
         # queue instead of letting last-writer-wins corrupt either side.

@@ -327,7 +327,9 @@ def run_status_tool(nodes: list[str], timeout_seconds: float = 5.0) -> int:
                     # this node's burn -- report, don't gate.
                     row["downstream_unhealthy"] = unhealthy
             except Exception:
-                pass
+                # Context-only surface: unreadable must not gate, but
+                # the operator should see WHY the column is absent.
+                row["healthcheck_unreadable"] = True
         return row
 
     async def main() -> list[dict]:
@@ -354,6 +356,10 @@ def run_status_tool(nodes: list[str], timeout_seconds: float = 5.0) -> int:
                 "  downstream_unhealthy="
                 + ",".join(row["downstream_unhealthy"])
             )
+        if row.get("healthcheck_unreadable"):
+            # Context-only (never gates), but the operator must see WHY
+            # the downstream column is absent for this node.
+            extra += "  healthcheck=unreadable"
         canary = row.get("canary")
         if canary:
             extra += f"  canary={canary['result']}#{canary['seq']}"
@@ -558,6 +564,24 @@ def main(argv: list[str] | None = None) -> None:
     p_status.add_argument("--timeout", type=float, default=5.0,
                           help="per-request scrape timeout in seconds")
 
+    p_lint = sub.add_parser(
+        "lint", help="project-invariant static analysis: AST rules for"
+        " the defect classes this repo keeps re-fixing (blocking IO in"
+        " async frames, dropped asyncio tasks, thread locks across"
+        " awaits, silent excepts, local-import shadowing, wall-clock in"
+        " sim code, metric-catalog drift, failpoint-name typos); exit 0"
+        " clean / 1 findings / 3 usage -- tier-1 gates the whole tree"
+        " at zero (docs/TESTING.md 'Static analysis tier')"
+    )
+    # nargs="*" NOT "+": zero paths must reach run_lint_tool and exit 3
+    # (the documented usage code), never argparse's 2.
+    p_lint.add_argument("paths", nargs="*",
+                        help="files or directories to analyze (the gate"
+                             " runs `lint kraken_tpu/ tests/`)")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable findings document instead"
+                             " of one line per finding")
+
     p_promgen = sub.add_parser(
         "promgen", help="regenerate deploy/prometheus/ (scrape config +"
         " burn-rate alert rules) from the shipped SLO defaults; CI"
@@ -723,6 +747,13 @@ def main(argv: list[str] | None = None) -> None:
         nodes = [a.strip() for a in (args.nodes or "").split(",") if a.strip()]
         sys.exit(run_status_tool(nodes, timeout_seconds=args.timeout))
 
+    if args.component == "lint":
+        import sys
+
+        from kraken_tpu.lint import run_lint_tool
+
+        sys.exit(run_lint_tool(args.paths, json_output=args.json))
+
     if args.component == "promgen":
         from kraken_tpu.utils.promgen import write_files
 
@@ -774,7 +805,11 @@ def main(argv: list[str] | None = None) -> None:
                 " accident".format(sorted(fp_cfg))
             )
         for fp_name, fp_spec in fp_cfg.items():
-            _failpoints.FAILPOINTS.arm(str(fp_name), str(fp_spec))
+            # source="yaml": undeclared names (KNOWN_FAILPOINTS) are
+            # rejected here and again by assembly's assert_safe.
+            _failpoints.FAILPOINTS.arm(
+                str(fp_name), str(fp_spec), source="yaml"
+            )
         _failpoints.allow()
 
     def pick(flag, key, default=None):

@@ -56,6 +56,12 @@ _MANIFEST_TYPES = (
 )
 
 
+def _create_empty(path: str) -> None:
+    """Truncate-create an upload spool file (runs via to_thread: even a
+    bare open can stall the loop on a slow/remote spool volume)."""
+    open(path, "wb").close()
+
+
 def _accepts(req: web.Request, media: str) -> bool:
     """RFC 7231-shaped Accept check, scoped to what registries need: no
     header and wildcards (``*/*``, ``application/*``) accept anything;
@@ -359,8 +365,10 @@ class RegistryServer:
                 **headers, "Content-Length": str(end - start + 1),
             })
             await resp.prepare(req)
-            with open(path, "rb") as f:
-                f.seek(start)
+            # open/seek off-loop: a cold page-cache seek on a busy disk
+            # stalls every other streaming response on this loop.
+            with await asyncio.to_thread(open, path, "rb") as f:
+                await asyncio.to_thread(f.seek, start)
                 remaining = end - start + 1
                 while remaining:
                     chunk = await asyncio.to_thread(
@@ -415,8 +423,7 @@ class RegistryServer:
                     },
                 )
         uid = uuidlib.uuid4().hex
-        with open(self._upload_path(uid), "wb"):
-            pass
+        await asyncio.to_thread(_create_empty, self._upload_path(uid))
         self._uploads[uid] = time.time()
         return web.Response(
             status=202,
@@ -434,7 +441,7 @@ class RegistryServer:
         resurrect a session the TTL purge removed mid-stream."""
         path = self._upload_path(uid)
         self._uploads[uid] = time.time()
-        with open(path, "ab") as f:
+        with await asyncio.to_thread(open, path, "ab") as f:
             i = 0
             async for chunk in req.content.iter_chunked(1 << 20):
                 await asyncio.to_thread(f.write, chunk)

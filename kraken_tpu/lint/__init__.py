@@ -1,0 +1,33 @@
+"""kraken-lint: project-invariant static analysis.
+
+The defect classes this repo keeps hand-fixing PR after PR -- blocking
+IO on the event loop, stranded asyncio tasks, locks held across awaits,
+bare excepts swallowing errors, local-import shadowing, wall-clock reads
+in sim-time code, metric-catalog drift, failpoint-name typos -- are all
+*machine-checkable*. This package encodes each as an AST (or cross-file
+"project") rule and gates the whole tree at zero findings in tier-1
+(tests/test_lint.py), so the invariants hold on every PR instead of
+being rediscovered by soak harnesses after they ship.
+
+Entry points:
+
+- ``python -m kraken_tpu.cli lint kraken_tpu/ tests/ [--json]`` -- the
+  operator/CI surface (exit 0 clean / 1 findings / 3 usage).
+- :func:`kraken_tpu.lint.engine.lint_paths` -- the in-process API the
+  tier-1 gate test calls.
+
+Suppressions are inline pragmas that REQUIRE a reason::
+
+    risky_call()  # kt-lint: disable=<rule>  # <why this one is safe>
+
+A pragma without a reason does not suppress anything and is itself a
+finding (docs/TESTING.md "Static analysis tier").
+"""
+
+from kraken_tpu.lint.engine import (  # noqa: F401
+    Finding,
+    LintUsageError,
+    lint_paths,
+    run_lint_tool,
+)
+from kraken_tpu.lint.rules import RULE_IDS  # noqa: F401

@@ -147,7 +147,7 @@ class BlobClient:
         (replication and proxy pushes of arbitrarily large blobs)."""
         uid = await self._start_upload(namespace, d)
         off = 0
-        with open(path, "rb") as f:
+        with await asyncio.to_thread(open, path, "rb") as f:
             while True:
                 chunk = await asyncio.to_thread(f.read, chunk_size)
                 if not chunk and off > 0:

@@ -9,10 +9,14 @@ path, unverified; SURVEY.md SS5.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import IO, Optional
 
 from kraken_tpu.utils import trace
+
+_log = logging.getLogger("kraken.networkevent")
+_sink_failures = None  # lazy FailureMeter: metrics import cycles at module load
 
 
 class Name:
@@ -65,8 +69,20 @@ class Producer:
                 self._sink.write(
                     json.dumps(event, separators=(",", ":")) + "\n"
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                # ...but a full disk / closed sink must still be SEEN:
+                # counted + one throttled WARN, never a per-event flood.
+                global _sink_failures
+                if _sink_failures is None:
+                    from kraken_tpu.utils.metrics import FailureMeter
+
+                    _sink_failures = FailureMeter(
+                        "network_event_sink_errors_total",
+                        "Network-event JSONL writes that raised (full"
+                        " disk / closed sink); events were dropped",
+                        _log,
+                    )
+                _sink_failures.record("network event sink write", e)
         else:
             self._events.append(event)
             if len(self._events) > self._keep:

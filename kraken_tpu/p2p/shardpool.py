@@ -821,8 +821,8 @@ class ShardPool:
                 pass
             try:
                 w.proc.close()  # releases the mp sentinel fd
-            except Exception:  # pragma: no cover
-                pass
+            except (OSError, ValueError):  # pragma: no cover
+                pass  # close() raises ValueError while still alive
         for cid, (shard, desc) in list(self._conns.items()):
             self._conns.pop(cid, None)
             self._safe_conn_closed(desc, "pool_stop", False)
@@ -994,8 +994,8 @@ class ShardPool:
         await asyncio.to_thread(_join)
         try:
             w.proc.close()
-        except Exception:  # pragma: no cover
-            pass
+        except (OSError, ValueError):  # pragma: no cover
+            pass  # close() raises ValueError while still alive
         # Respawn on crash, but ALSO when a retiring shard exits while
         # the target has grown back over it (shrink-then-grow race: the
         # grow saw the old shard still in the table and spawned nothing,

@@ -62,7 +62,7 @@ def _resolve_pjit() -> tuple[Callable[..., Any] | None, str]:
         from jax.experimental.pjit import pjit as exp_pjit
 
         return exp_pjit, "jax.experimental.pjit"
-    except Exception:
+    except Exception:  # kt-lint: disable=bare-except  # version probe: ANY failure (ImportError, jax init) means "symbol unavailable"; the resolver chain falls through to jax.jit/shard_map
         pass
     fn = getattr(jax, "jit", None)
     if fn is not None and "out_shardings" in inspect.signature(fn).parameters:

@@ -273,6 +273,26 @@ class Manager:
         if self._task:
             self._task.cancel()
 
+    async def reap(self) -> None:
+        """Await the cancelled poll task (after :meth:`stop`, before
+        :meth:`close`). cancel() only SCHEDULES the CancelledError --
+        it lands at the task's next await -- so closing the sqlite
+        store while run_once is still in flight turns shutdown into
+        "Cannot operate on a closed database" poll noise and strands
+        the task past the test body (the asyncio-task tripwire and the
+        `fire-and-forget-task` lint rule exist for exactly this class).
+        Idempotent; cancels too if stop() was skipped."""
+        if self._task is None:
+            return
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            _log.debug("retry poll task raised at shutdown", exc_info=True)
+        self._task = None
+
     def close(self) -> None:
         """Release the task store's sqlite handle. Call AFTER stop()
         and after the node's listeners are down: a request handler

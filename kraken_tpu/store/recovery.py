@@ -287,8 +287,8 @@ def run_fsck(
                 continue  # not a blob (unknown debris: leave for humans)
             try:
                 d = Digest.from_hex(name)
-            except Exception:
-                continue
+            except ValueError:
+                continue  # 64 chars but not hex: debris, not a blob
 
             # 2d. orphan data: committed bytes with no namespace sidecar
             # are invisible to the repair/writeback planes. Re-adopt

@@ -197,8 +197,12 @@ class CanaryProber:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                # The loop already meters probe failures; a throw on the
+                # way OUT is prober plumbing -- log it, don't lose it.
+                _log.debug("canary loop raised at stop", exc_info=True)
             self._task = None
         # Best-effort, BOUNDED residue sweep: deletes run concurrently
         # (below) and the whole pass is capped so a dead origin cannot
@@ -206,8 +210,10 @@ class CanaryProber:
         # persists in the state sidecar and reaps on the next boot.
         try:
             await asyncio.wait_for(self._reap(now=float("inf")), 10.0)
-        except (asyncio.TimeoutError, Exception):
-            pass
+        except asyncio.TimeoutError:
+            pass  # bounded by design: residue reaps on next boot
+        except Exception:
+            _log.debug("final canary reap failed", exc_info=True)
 
     async def _loop(self) -> None:
         while True:
@@ -422,7 +428,10 @@ class CanaryProber:
                 self.scheduler.unseed(d)
                 await asyncio.to_thread(self.store.delete_cache_file, d)
             except Exception:
-                pass  # local miss: already evicted
+                _log.debug(
+                    "local canary blob %s already evicted", d.hex[:8],
+                    exc_info=True,
+                )
             try:
                 await http.delete(
                     f"{base_url(addr)}/namespace/"

@@ -53,6 +53,57 @@ import threading
 from typing import Optional
 
 
+# The central site-name registry: every ``fire("...")`` site in the
+# tree declares its name here, exactly once (the `failpoint-registry`
+# lint rule gates both directions). Operator surfaces (KRAKEN_FAILPOINTS
+# env, YAML ``failpoints:``) validate against it, so a fat-fingered
+# ``trcker.announce.error=once`` chaos run fails loudly instead of
+# injecting nothing and reporting green. ``name@suffix`` variants (the
+# per-host ``rpc.brownout.slow@host:port`` pattern for single-process
+# herds) validate by their base name.
+KNOWN_FAILPOINTS = frozenset({
+    "backend.file.download",
+    "backend.file.upload",
+    "castore.commit",
+    "castore.write",
+    "httputil.request.conn_reset",
+    "httputil.request.error",
+    "httputil.request.slow",
+    "httputil.request.truncate_body",
+    "origin.patch.close",
+    "origin.patch.write",
+    "origin.recipe.miss",
+    "p2p.conn.disconnect",
+    "p2p.conn.recv.corrupt",
+    "p2p.conn.send.delay",
+    "p2p.delta.base.evict",
+    "p2p.shard.serve.disconnect",
+    "rpc.brownout.slow",
+    "rpc.hedge.lose",
+    "store.fsck.orphan",
+    "store.scrub.bitflip",
+    "tracker.announce.empty",
+    "tracker.announce.error",
+})
+
+
+def is_known(name: str) -> bool:
+    """Is ``name`` (or its pre-``@`` base) a declared site?"""
+    return name.split("@", 1)[0] in KNOWN_FAILPOINTS
+
+
+def assert_known(names) -> None:
+    """Reject undeclared site names from the operator surfaces. Raises
+    ValueError naming every typo (and the registry location)."""
+    unknown = sorted(n for n in names if not is_known(n))
+    if unknown:
+        raise ValueError(
+            f"unknown failpoint name(s) {unknown}: not declared in "
+            "KNOWN_FAILPOINTS (kraken_tpu/utils/failpoints.py) -- a typo "
+            "here would inject nothing and still report green"
+        )
+
+
 class FailpointError(Exception):
     """Generic injected fault (sites that have no better-typed error)."""
 
@@ -80,11 +131,15 @@ class _Armed:
 
     __slots__ = (
         "spec", "mode", "arg", "times", "delay_s", "seed", "rng",
-        "hits", "fired",
+        "hits", "fired", "source",
     )
 
-    def __init__(self, spec: str):
+    def __init__(self, spec: str, source: str = "api"):
         self.spec = spec
+        # Where the arming came from: "api" (tests/admin endpoint) or
+        # the operator surfaces "env"/"yaml" -- assert_safe validates
+        # the latter against KNOWN_FAILPOINTS at boot.
+        self.source = source
         self.mode = "always"
         self.arg = 0.0
         self.times = 0  # 0 = unlimited
@@ -160,13 +215,18 @@ class FailpointRegistry:
 
     # -- arming ------------------------------------------------------------
 
-    def arm(self, name: str, spec: str = "once") -> None:
+    def arm(self, name: str, spec: str = "once", source: str = "api") -> None:
         # Names come from YAML and unauthenticated JSON too: a non-str
         # key would poison snapshot()'s sorted() (int < str TypeError)
         # and kill the admin surface mid-chaos-run.
         if not isinstance(name, str) or not name:
             raise ValueError(f"failpoint name must be a non-empty str: {name!r}")
-        armed = _Armed(spec)  # parse (and reject) outside the lock
+        # Operator surfaces (env/YAML) must use declared names; tests
+        # and the admin endpoint may arm ad-hoc (registry unit tests,
+        # per-host @variants).
+        if source in ("env", "yaml"):
+            assert_known([name])
+        armed = _Armed(spec, source=source)  # parse/reject outside the lock
         with self._lock:
             self._armed[name] = armed
             self._any = True
@@ -237,6 +297,20 @@ class FailpointRegistry:
                     "kraken_tpu.utils.failpoints.allow() (tests), set "
                     "KRAKEN_FAILPOINTS[_ALLOW] (cli), or disarm them."
                 )
+            # Operator-sourced arms must name declared sites: a typo'd
+            # KRAKEN_FAILPOINTS / YAML entry would otherwise boot an
+            # injecting-nothing node that reports its chaos run green.
+            unknown = sorted(
+                n for n, a in self._armed.items()
+                if a.source in ("env", "yaml") and not is_known(n)
+            )
+            if unknown:
+                raise FailpointConfigError(
+                    f"{component or 'node'}: failpoints armed from "
+                    f"env/YAML with undeclared name(s) {unknown} -- not in "
+                    "KNOWN_FAILPOINTS (kraken_tpu/utils/failpoints.py); "
+                    "fix the typo or declare the site"
+                )
 
 
 FAILPOINTS = FailpointRegistry()
@@ -256,7 +330,8 @@ def load_from_env(environ=None) -> int:
     """Arm failpoints from ``KRAKEN_FAILPOINTS`` (``name=spec,...``).
     Setting the variable IS the operator's acknowledgement, so this also
     calls :func:`allow`. Returns the number armed. Raises ValueError on a
-    malformed entry -- a typo'd chaos run must not silently run clean."""
+    malformed entry OR an undeclared site name (KNOWN_FAILPOINTS) -- a
+    typo'd chaos run must not silently run clean."""
     raw = (environ or os.environ).get("KRAKEN_FAILPOINTS", "")
     count = 0
     for entry in raw.split(","):
@@ -266,7 +341,7 @@ def load_from_env(environ=None) -> int:
         name, sep, spec = entry.partition("=")
         if not sep or not name.strip():
             raise ValueError(f"malformed KRAKEN_FAILPOINTS entry {entry!r}")
-        FAILPOINTS.arm(name.strip(), spec.strip() or "once")
+        FAILPOINTS.arm(name.strip(), spec.strip() or "once", source="env")
         count += 1
     if count:
         allow()

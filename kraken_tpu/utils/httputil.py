@@ -387,7 +387,9 @@ class HTTPClient:
                                 last_err = err
                             else:
                                 size = 0
-                                with open(tmp, "wb") as f:
+                                with await asyncio.to_thread(
+                                    open, tmp, "wb"
+                                ) as f:
                                     async for chunk in (
                                         resp.content.iter_chunked(chunk_size)
                                     ):

@@ -451,7 +451,6 @@ def instrument_app(app, component: str, registry: Registry = REGISTRY):
         # WITHOUT restarting it. Text, greppable, no state mutated.
         import asyncio
         import sys
-        import threading
         import traceback
 
         from kraken_tpu.utils.resources import task_census

@@ -130,6 +130,25 @@ def classify_plane(frames: Iterable[str]) -> str:
     return "other"
 
 
+def fold_stack(frame, max_depth: int = 64) -> list[str]:
+    """One thread's live stack as ``file.py:func`` frames, root-first --
+    the blame-stack capture shared by the sampler (:class:`SamplingProfiler`),
+    the loop-lag monitor's WARN line, and the KT_SANITIZE stall watchdog
+    (utils/sanitize.py): every surface that answers "what was this
+    thread doing" must fold frames the same way."""
+    out: list[str] = []
+    depth = max_depth
+    while frame is not None and depth > 0:
+        code = frame.f_code
+        out.append(
+            f"{os.path.basename(code.co_filename)}:{code.co_name}"
+        )
+        frame = frame.f_back
+        depth -= 1
+    out.reverse()
+    return out
+
+
 def plane_pct_busy(planes: dict) -> dict:
     """Plane sample counts -> percent of BUSY samples (idle excluded).
     The one shared formula behind /debug/pprof/profile, the flame CLI
@@ -346,17 +365,7 @@ class SamplingProfiler:
 
     def _fold(self, frame) -> list[str]:
         """One thread's stack as ``file.py:func`` frames, root-first."""
-        out: list[str] = []
-        depth = self.config.max_stack_depth
-        while frame is not None and depth > 0:
-            code = frame.f_code
-            out.append(
-                f"{os.path.basename(code.co_filename)}:{code.co_name}"
-            )
-            frame = frame.f_back
-            depth -= 1
-        out.reverse()
-        return out
+        return fold_stack(frame, self.config.max_stack_depth)
 
     def _sample_once(self) -> None:
         now = time.monotonic()
@@ -629,7 +638,9 @@ class SamplingProfiler:
                         "Profile JSONL postmortems written, by trigger",
                     ).inc(trigger=trigger)
             except Exception:
-                pass  # best-effort postmortem
+                # Best-effort postmortem -- but a profile capture that
+                # never lands should show up in the logs, not vanish.
+                _log.warning("profile dump write failed", exc_info=True)
 
         try:
             asyncio.get_running_loop()

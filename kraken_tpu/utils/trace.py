@@ -37,12 +37,15 @@ import asyncio
 import contextvars
 import dataclasses
 import json
+import logging
 import os
 import random
 import threading
 import time
 from collections import deque
 from typing import Iterable, Optional
+
+_log = logging.getLogger("kraken.trace")
 
 _TRACEPARENT_VERSION = "00"
 
@@ -392,7 +395,9 @@ class Tracer:
             try:
                 self.on_record(d)
             except Exception:
-                pass  # span shipping is best-effort observability
+                # Best-effort shipping, visibly so: dropped spans that
+                # never log are a propagation break nobody can debug.
+                _log.debug("on_record span hook failed", exc_info=True)
         from kraken_tpu.utils.metrics import REGISTRY
 
         REGISTRY.counter(
@@ -437,7 +442,9 @@ class Tracer:
             try:
                 hook(trigger, detail)
             except Exception:
-                pass  # a profile-capture failure must not mute the dump
+                # Must not mute the dump -- but must not vanish either.
+                _log.warning("on_trigger profile hook failed",
+                             exc_info=True)
         if not cfg.dump_dir:
             return None
         now = time.monotonic()
@@ -485,7 +492,9 @@ class Tracer:
                     "Flight-recorder JSONL postmortems written, by trigger",
                 ).inc(trigger=trigger)
             except Exception:
-                pass  # best-effort postmortem; never compound the event
+                # Never compound the degradation event -- but a
+                # postmortem that failed to land must be findable.
+                _log.warning("trace dump write failed", exc_info=True)
 
         # The triggers fire ON the event loop (breaker trip, deadline,
         # sentinel) at exactly the moment the node is degrading -- a

@@ -40,6 +40,48 @@ import pytest  # noqa: E402
 _TASK_LEAK_MODULES = {"test_chaos", "test_degradation", "test_soak"}
 
 
+# Suites running under the KT_SANITIZE asyncio sanitizer in tier-1:
+# asyncio debug mode + the slow-sync-callback watchdog
+# (kraken_tpu/utils/sanitize.py) that FAILS a test on any on-loop stall
+# past the threshold, blaming the stack via the profiler's fold. The
+# chaos + degradation suites are the loop's torture tier -- exactly
+# where a blocking call regression would hide behind injected faults.
+# KT_SANITIZE=1 extends it to every suite; KT_SANITIZE=0 force-disables
+# (rig escape hatch); KT_SANITIZE_THRESHOLD tunes the stall bar.
+_SANITIZE_MODULES = {"test_chaos", "test_degradation"}
+
+
+@pytest.fixture(autouse=True)
+def kt_sanitize(request, monkeypatch):
+    import asyncio
+
+    mode = os.environ.get("KT_SANITIZE", "")
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    enabled = mode == "1" or (mode != "0" and mod in _SANITIZE_MODULES)
+    if not enabled:
+        yield
+        return
+
+    from kraken_tpu.utils.sanitize import sanitized_run
+
+    threshold = float(os.environ.get("KT_SANITIZE_THRESHOLD", "1.0"))
+    violations: list = []
+    orig_run = asyncio.run
+
+    def sanitizing_run(coro, **kw):
+        return sanitized_run(
+            coro, threshold_seconds=threshold, violations=violations,
+            _run=orig_run, **kw,
+        )
+
+    monkeypatch.setattr(asyncio, "run", sanitizing_run)
+    yield
+    assert not violations, (
+        "KT_SANITIZE caught on-loop stalls (sync work on the event"
+        " loop):\n" + "\n".join(v.render() for v in violations)
+    )
+
+
 @pytest.fixture(autouse=True)
 def no_leaked_asyncio_tasks(request, monkeypatch):
     import asyncio

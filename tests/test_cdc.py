@@ -5,7 +5,14 @@ bounds, determinism, and shift-resistance. SURVEY.md SS4 tier 5."""
 import numpy as np
 import pytest
 
-from kraken_tpu.ops.cdc import CDCParams, chunk, chunk_reference, chunk_spans
+from kraken_tpu.ops.cdc import (
+    CDCParams,
+    _WINDOW,
+    _gear_candidates,
+    chunk,
+    chunk_reference,
+    chunk_spans,
+)
 
 # Small sizes keep the pure-Python reference fast.
 P = CDCParams(min_size=64, avg_size=256, max_size=1024)
@@ -109,7 +116,6 @@ def test_pallas_candidates_match_xla_path():
     produce bit-identical candidate positions to the XLA path -- run here
     in interpret mode on a buffer spanning segment boundaries, ragged
     tail included."""
-    from kraken_tpu.ops.cdc import CDCParams, _WINDOW, _gear_candidates
     from kraken_tpu.ops.cdc_pallas import _SEG, candidate_indices_pallas
 
     import jax.numpy as jnp

@@ -177,8 +177,8 @@ def test_writeback_unpins_flat_and_chunked(tmp_path):
 
     class _Client:
         async def upload_file(self, ns, hex_, path):
-            with open(path, "rb") as f:
-                uploaded[hex_] = f.read()
+            with await asyncio.to_thread(open, path, "rb") as f:
+                uploaded[hex_] = await asyncio.to_thread(f.read)
 
     class _Backends:
         def get_client(self, ns):
@@ -228,7 +228,6 @@ def test_empty_manifest_sidecar_reads_as_unhealthy_not_crash(tmp_path):
     would abort fsck/scrub wholesale). With no flat file the blob is
     quarantined unhealable; WITH a flat file only the bad sidecar is
     dropped (the flat bytes are authoritative)."""
-    from kraken_tpu.store.metadata import ChunkManifestMetadata
 
     with pytest.raises(ValueError):
         ChunkManifestMetadata.deserialize(b"")

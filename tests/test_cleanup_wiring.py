@@ -10,10 +10,10 @@ the dedup index.
 import asyncio
 import os
 
-from kraken_tpu.assembly import AgentNode, OriginNode
+from kraken_tpu.assembly import AgentNode, OriginNode, TrackerNode
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.client import BlobClient
-from kraken_tpu.store.cleanup import CleanupConfig
+from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 from kraken_tpu.store.metadata import PersistMetadata
 
 
@@ -130,13 +130,7 @@ def test_delete_and_eviction_unseed(tmp_path):
     """A deleted or evicted blob leaves the swarm: the scheduler stops
     announcing and drops the torrent control (a seeder must not advertise
     bytes it can no longer serve)."""
-    import asyncio
-    import os
 
-    from kraken_tpu.assembly import OriginNode, TrackerNode
-    from kraken_tpu.core.digest import Digest
-    from kraken_tpu.origin.client import BlobClient
-    from kraken_tpu.store.cleanup import CleanupConfig
 
     async def main():
         tracker = TrackerNode(announce_interval_seconds=0.1)
@@ -181,11 +175,9 @@ def test_abandoned_upload_spool_ages_out(tmp_path):
     """An upload whose client died before commit leaves a spool file; the
     sweep removes it after upload_ttl_seconds while sparing fresh (live)
     uploads. Commit/abort files are untouched (already gone)."""
-    import os
     import time
 
     from kraken_tpu.store import CAStore
-    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 
     store = CAStore(str(tmp_path / "s"))
     dead = store.create_upload()
@@ -213,7 +205,6 @@ def test_simulated_now_cannot_unlink_live_uploads(tmp_path):
     import time
 
     from kraken_tpu.store import CAStore
-    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
 
     store = CAStore(str(tmp_path / "s"))
     live = store.create_upload()

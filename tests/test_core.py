@@ -231,10 +231,7 @@ def test_metainfo_deserialize_fuzz_only_metainfoerror():
     KeyError/AttributeError escaping to the scheduler."""
     import json
 
-    import numpy as np
 
-    from kraken_tpu.core.digest import Digest
-    from kraken_tpu.core.metainfo import MetaInfo, MetaInfoError
 
     rng = np.random.default_rng(5)
     blob = b"x" * 1000

@@ -116,8 +116,6 @@ def test_trace_on_overhead_band():
     pairwise ratio past 1.05 means span creation or the contextvar
     probes leaked into the unsampled data path -- look at dispatch.py's
     sampled-only gates before re-pinning."""
-    import asyncio
-    import tempfile
 
     from bench_pair import run_pair
     from kraken_tpu.configutil import load_config
@@ -167,8 +165,6 @@ def test_profiler_on_overhead_band():
     ratio past 1.05 means per-sample work grew (stack depth, plane
     rules, lock hold) or something leaked onto the data path; look at
     utils/profiler.py _sample_once before re-pinning."""
-    import asyncio
-    import tempfile
 
     from bench_pair import run_pair
     from kraken_tpu.configutil import load_config

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from kraken_tpu.core.digest import Digest
-from kraken_tpu.ops.cdc import CDCParams
+from kraken_tpu.ops.cdc import CDCParams, chunk_spans
 from kraken_tpu.origin.dedup import ChunkSketchMetadata, DedupIndex
 from kraken_tpu.store import CAStore
 
@@ -221,9 +221,7 @@ def test_chunk_router_host_and_device_paths_agree(tmp_path):
     and device spans are bit-identical, small blobs skip calibration, and
     on a CPU-only rig the decision is 'host' without touching jax
     transfer paths."""
-    import numpy as np
 
-    from kraken_tpu.ops.cdc import CDCParams, chunk_spans
     from kraken_tpu.origin.dedup import ChunkRouter
 
     params = CDCParams()
@@ -247,8 +245,6 @@ def test_chunk_router_host_and_device_paths_agree(tmp_path):
 def test_low_j_bands_config_reaches_both_indexes(tmp_path):
     """The dedup_low_j_bands knob flows OriginNode -> DedupIndex -> index
     implementation; 0 disables the tier."""
-    from kraken_tpu.origin.dedup import DedupIndex
-    from kraken_tpu.store import CAStore
 
     store = CAStore(str(tmp_path / "s"))
     on = DedupIndex(store)

@@ -715,8 +715,10 @@ def test_agent_lameduck_serves_cache_refuses_new_pulls(tmp_path):
         blob = os.urandom(1024)
         d = Digest.from_bytes(blob)
         uid = agent.store.create_upload()
-        with open(agent.store.upload_path(uid), "wb") as f:
-            f.write(blob)
+        with await asyncio.to_thread(
+            open, agent.store.upload_path(uid), "wb"
+        ) as f:
+            await asyncio.to_thread(f.write, blob)
         agent.store.commit_upload(uid, d)
         base = f"http://{agent.addr}"
         async with aiohttp.ClientSession() as sess:

@@ -415,7 +415,7 @@ async def _delta_corrupt_base(tmp_path):
         # 24 KiB so shared (have) chunks are guaranteed to be hit, not
         # just the per-build unique headers.
         path = herd.agent.store.cache_path(d1)
-        with open(path, "r+b") as f:
+        with await asyncio.to_thread(open, path, "r+b") as f:
             for off in range(8192, len(v1), 24576):
                 f.seek(off)
                 f.write(b"\xde\xad\xbe\xef")

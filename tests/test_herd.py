@@ -305,7 +305,9 @@ def test_origin_restart_skips_corrupt_blob(tmp_path):
                 http_port=old_port,
             )
             reborn.store.delete_metadata(d, TorrentMetaMetadata)
-            with open(reborn.store.cache_path(d), "r+b") as f:
+            with await asyncio.to_thread(
+                open, reborn.store.cache_path(d), "r+b"
+            ) as f:
                 f.seek(1000)
                 f.write(b"\x00" * 64)  # corrupt in place
             # Model true bit-rot: damage without an mtime bump. (A fresh

@@ -398,7 +398,7 @@ def test_testfs_process_serves_origin_backend(tmp_path):
                     try:
                         if await be.download("ns", d.hex) == blob:
                             break
-                    except Exception:
+                    except Exception:  # kt-lint: disable=bare-except  # poll-until-written: not-found / conn errors ARE the waiting state; the loop times out loudly below
                         pass
                     await aio.sleep(0.05)
                 else:

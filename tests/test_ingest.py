@@ -166,7 +166,6 @@ def test_patch_failure_invalidates_tracker(tmp_path):
 
         D.from_reader = classmethod(counting_reader)
         try:
-            from aiohttp import ClientSession
 
             base = f"http://{node.addr}/namespace/ns/blobs/{d}"
             async with ClientSession() as http:

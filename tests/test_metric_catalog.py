@@ -1,8 +1,15 @@
-"""Metric-catalog lint: the docs/OPERATIONS.md catalog cannot drift.
+"""Metric-catalog lint, runtime half: dynamic names cannot drift either.
 
-Every metric a live agent+origin pair actually registers must appear
-(backtick-quoted) in docs/OPERATIONS.md -- the catalog is the operator's
-only index into the registry, and until now it was maintained by hand.
+The static two-way rule lives in the analyzer now (`metric-catalog`,
+kraken_tpu/lint/project.py -- every literal register site must be
+cataloged AND every catalog row must name a register site; the tree
+gate in tests/test_lint.py runs it). What statics cannot see is a
+metric whose name is computed at runtime, so this test keeps the live
+half: boot a real agent+origin pair, drive one upload + one pull, and
+check every name the REGISTRY actually minted against the SAME
+containment contract the static rule uses (`is_cataloged` -- one
+shared predicate, so the two halves can never disagree about what
+"cataloged" means).
 
 Runs the pair in a SUBPROCESS: the test session's process-global
 REGISTRY accumulates names from every suite that ran before this one,
@@ -17,6 +24,8 @@ import json
 import os
 import subprocess
 import sys
+
+from kraken_tpu.lint.project import is_cataloged
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -88,10 +97,7 @@ def test_every_live_metric_is_in_the_operations_catalog():
 
     with open(os.path.join(REPO, "docs", "OPERATIONS.md")) as f:
         docs = f.read()
-    # A metric is "cataloged" when its exact name appears backtick-quoted
-    # anywhere in OPERATIONS.md (the catalog tables quote every name;
-    # prose mentions count too -- the operator can grep either way).
-    missing = [n for n in names if f"`{n}" not in docs]
+    missing = [n for n in names if not is_cataloged(n, docs)]
     assert not missing, (
         "live metrics missing from the docs/OPERATIONS.md catalog "
         f"(add a row per name): {missing}"

@@ -5,6 +5,9 @@ import numpy as np
 import pytest
 
 from kraken_tpu.ops.minhash import (
+    _SCORE_DEVICE_MIN,
+    BudgetExceeded,
+    CompactLSHIndex,
     LSHIndex,
     MinHasher,
     estimate_jaccard,
@@ -142,8 +145,6 @@ def test_compact_index_matches_dict_index():
     """CompactLSHIndex is a storage change, not a semantics change: same
     candidates and same query results as LSHIndex on identical input,
     before and after flush()."""
-    from kraken_tpu.ops.minhash import CompactLSHIndex
-
     rng = np.random.default_rng(11)
     mh = MinHasher(num_hashes=64)
     a, b = LSHIndex(mh, num_bands=16), CompactLSHIndex(mh, num_bands=16)
@@ -163,8 +164,6 @@ def test_compact_index_matches_dict_index():
 
 
 def test_compact_index_remove_and_readd():
-    from kraken_tpu.ops.minhash import CompactLSHIndex
-
     rng = np.random.default_rng(12)
     mh = MinHasher(num_hashes=64)
     idx = CompactLSHIndex(mh, num_bands=16)
@@ -186,8 +185,6 @@ def test_compact_index_remove_and_readd():
 
 
 def test_compact_index_budget_evicts_oldest():
-    from kraken_tpu.ops.minhash import BudgetExceeded, CompactLSHIndex
-
     rng = np.random.default_rng(13)
     mh = MinHasher(num_hashes=64)
     sk = mh.sketch_batch([make_set(rng, 64) for _ in range(2000)])
@@ -213,7 +210,6 @@ def test_query_brute_device_topk_matches_host():
     """Above _SCORE_DEVICE_MIN the brute scan runs on device with an
     on-device top-k (only 2k scalars leave the chip). Results must equal
     the host argsort ordering, tombstones and padded rows excluded."""
-    from kraken_tpu.ops.minhash import _SCORE_DEVICE_MIN, LSHIndex, MinHasher
 
     rng = np.random.default_rng(3)
     hasher = MinHasher(num_hashes=16, seed=1)
@@ -249,9 +245,6 @@ def test_low_j_tier_lifts_below_knee_retrieval():
     made J=0.3 planted retrieval ~0.27. The low-J 2-row tier must lift
     below-knee retrieval without hurting above-knee behavior -- verified
     on both index implementations against the same planted corpus."""
-    from kraken_tpu.ops.minhash import (
-        CompactLSHIndex, LSHIndex, MinHasher,
-    )
 
     rng = np.random.default_rng(11)
     hasher = MinHasher(num_hashes=128, seed=3)
@@ -302,7 +295,6 @@ def test_low_j_tier_lifts_below_knee_retrieval():
 def test_negative_low_j_bands_rejected():
     """A negative tier size must fail at construction, not silently drop
     primary bands (dict index) or crash on first ingest (compact)."""
-    from kraken_tpu.ops.minhash import CompactLSHIndex, LSHIndex, MinHasher
 
     h = MinHasher(num_hashes=128)
     with pytest.raises(ValueError):

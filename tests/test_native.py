@@ -76,7 +76,6 @@ def test_scalar_and_simd_paths_agree():
 def test_native_cdc_chunker_matches_reference():
     """The C chunker and the NumPy fallback both produce chunk_reference's
     exact cuts -- boundaries are a persistent on-disk contract."""
-    import numpy as np
 
     import kraken_tpu.native as nat
     from kraken_tpu.ops.cdc import CDCParams, chunk_host, chunk_reference

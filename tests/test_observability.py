@@ -124,8 +124,6 @@ def test_network_events_cover_piece_flow(tmp_path):
     """The swarm tracing plane records the full reference event set during
     a real transfer: torrent add, conn lifecycle, per-piece request and
     receive, completion (SURVEY SS5 offline swarm reconstruction)."""
-    import asyncio
-    import os
 
     from kraken_tpu.p2p.networkevent import Producer
     from test_swarm import FakeTracker, make_metainfo, make_peer, NS
@@ -162,7 +160,6 @@ def test_network_events_cover_piece_flow(tmp_path):
 def test_failure_meter_counts_and_throttles(caplog):
     """Every failure increments the counter; the WARN is throttled to one
     per window with a suppressed-count on the next emit."""
-    import logging
 
     from kraken_tpu.utils.metrics import FailureMeter
 
@@ -195,7 +192,6 @@ def test_announce_failures_metered_when_tracker_dies(tmp_path):
 
         from kraken_tpu.core.digest import Digest
         from kraken_tpu.origin.client import BlobClient
-        from kraken_tpu.utils.metrics import REGISTRY
 
         counter = REGISTRY.counter("announce_failures_total")
         tracker, origins, agents, cluster = await build_herd(

@@ -20,7 +20,7 @@ from kraken_tpu.p2p.storage import (
     PieceError,
 )
 from kraken_tpu.p2p.wire import Message, MsgType, WireError, recv_message, send_message
-from kraken_tpu.store import CAStore
+from kraken_tpu.store import CAStore, PieceStatusMetadata
 
 
 def make_metainfo(blob: bytes, piece_length: int = 1024) -> MetaInfo:
@@ -301,8 +301,6 @@ def test_agent_torrent_lifecycle(tmp_path):
         assert done and t.complete()
         assert store.read_cache_file(mi.digest) == blob
         # bitfield metadata cleaned up on completion
-        from kraken_tpu.store import PieceStatusMetadata
-
         assert store.get_metadata(mi.digest, PieceStatusMetadata) is None
         # re-creating yields a complete seeding torrent
         t2 = archive.create_torrent(mi)
@@ -328,9 +326,7 @@ def test_origin_archive_requires_blob(tmp_path):
 def test_scheduler_config_from_dict_and_reload():
     """YAML `scheduler:` section builds a config (nested conn_state,
     unknown keys rejected); Scheduler.reload applies limits live."""
-    import pytest
 
-    from kraken_tpu.p2p.connstate import ConnState
     from kraken_tpu.p2p.scheduler import SchedulerConfig
 
     cfg = SchedulerConfig.from_dict({
@@ -667,8 +663,6 @@ def test_p2p_bandwidth_cap_shapes_transfer(tmp_path):
 def test_piece_status_ignores_padding_bits():
     """A corrupt sidecar with stray padding bits in the last byte must not
     make complete() lie: only bits < num_pieces count."""
-    from kraken_tpu.store import PieceStatusMetadata
-
     # 9 pieces -> 2 bytes; pieces 0-7 set plus a stray padding bit (bit 7
     # of byte 1, piece index 15 which does not exist).
     raw = PieceStatusMetadata(9)
@@ -684,12 +678,6 @@ def test_torrent_close_refuses_new_io_and_is_idempotent(tmp_path):
     EBADF/fd-reuse corruption) and close() can run again safely."""
     import numpy as np
 
-    from kraken_tpu.core.hasher import get_hasher
-    from kraken_tpu.core.metainfo import MetaInfo
-    from kraken_tpu.p2p.storage import (
-        BatchedVerifier, OriginTorrentArchive, PieceError,
-    )
-    from kraken_tpu.store import CAStore
 
     blob = bytes(np.random.default_rng(0).integers(0, 256, 8192, np.uint8))
     d = Digest.from_bytes(blob)
@@ -713,10 +701,6 @@ def test_torrent_close_flushes_bitfield_off_loop(tmp_path):
     synchronously."""
     import threading
 
-    from kraken_tpu.core.hasher import get_hasher
-    from kraken_tpu.core.metainfo import MetaInfo
-    from kraken_tpu.p2p.storage import AgentTorrentArchive, BatchedVerifier
-    from kraken_tpu.store import CAStore, PieceStatusMetadata
 
     blob = os.urandom(8192)
     d = Digest.from_bytes(blob)

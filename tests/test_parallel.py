@@ -113,9 +113,7 @@ def test_tpu_sharded_hasher_resolvable_by_name(tmp_path):
     """`hasher: tpu-sharded` in component YAML resolves through the
     registry (deferred hashplane import) and hashes correctly -- the
     production multi-chip path, end to end through a node."""
-    import hashlib
 
-    from kraken_tpu.core.hasher import get_hasher
     from kraken_tpu.origin.metainfogen import Generator
     from kraken_tpu.store import CAStore
     from kraken_tpu.core.digest import Digest

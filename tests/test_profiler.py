@@ -484,8 +484,10 @@ def test_worker_samples_aggregate_through_2worker_pull(tmp_path):
                 await asyncio.wait_for(agent.download(NS, mi.digest), 60)
             finally:
                 await agent.stop()
-            with open(astore.cache_path(mi.digest), "rb") as f:
-                assert f.read() == blob
+            with await asyncio.to_thread(
+                open, astore.cache_path(mi.digest), "rb"
+            ) as f:
+                assert await asyncio.to_thread(f.read) == blob
             # Shards ship on the 0.25 s stats tick; wait for samples to
             # come home (their idle loop samples too, so this converges
             # even when the serves themselves were fast).

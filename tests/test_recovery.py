@@ -611,13 +611,12 @@ def test_heal_never_trusts_an_unverified_cached_copy(tmp_path):
     from kraken_tpu.backend import BlobNotFoundError
     from kraken_tpu.origin.metainfogen import Generator
     from kraken_tpu.origin.server import OriginServer, _heal_task
-    from kraken_tpu.utils.metrics import REGISTRY
 
     async def main():
         s = _store(tmp_path)
         blob = os.urandom(9_000)
         d = _put(s, blob, ns="healns")
-        with open(s.cache_path(d), "r+b") as f:
+        with await asyncio.to_thread(open, s.cache_path(d), "r+b") as f:
             f.seek(50)
             f.write(b"\x13\x37")
         retry = Manager(TaskStore(":memory:"))

@@ -19,7 +19,7 @@ from kraken_tpu.assembly import (
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.placement import HostList, Ring
-from kraken_tpu.utils.httputil import HTTPClient
+from kraken_tpu.utils.httputil import HTTPClient, HTTPError
 
 
 def make_image(nlayers=2, layer_size=50_000):
@@ -427,7 +427,6 @@ def test_immutable_tags(tmp_path):
         import json as _json
 
         from kraken_tpu.buildindex.server import TagClient
-        from kraken_tpu.utils.httputil import HTTPClient, HTTPError
 
         origin = OriginNode(store_root=str(tmp_path / "o"), dedup=False)
         await origin.start()

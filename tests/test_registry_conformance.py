@@ -46,8 +46,8 @@ class FakeTransferer:
         raise KeyError(str(d))
 
     async def upload_file(self, namespace, d, path):
-        with open(path, "rb") as f:
-            self.blobs[str(d)] = f.read()
+        with await asyncio.to_thread(open, path, "rb") as f:
+            self.blobs[str(d)] = await asyncio.to_thread(f.read)
 
     async def mount(self, source, target, d):
         return str(d) in self.blobs

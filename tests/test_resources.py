@@ -339,7 +339,7 @@ def test_sentinel_samples_node_planes(tmp_path):
                 "namespace",
             )
             os.makedirs(os.path.dirname(ghost), exist_ok=True)
-            with open(ghost, "wb"):
+            with await asyncio.to_thread(open, ghost, "wb"):
                 pass
             _backdate(ghost, 10)
             s = await agent.sentinel.sample()

@@ -158,8 +158,9 @@ def test_worker_shard_serves_bit_identical_pull(tmp_path):
                 await asyncio.wait_for(agent.download(NS, d), 60)
             finally:
                 await agent.stop()
-            with open(astore.cache_path(d), "rb") as f:
-                assert f.read() == blob, "worker-served pull not bit-identical"
+            with await asyncio.to_thread(open, astore.cache_path(d), "rb") as f:
+                got = await asyncio.to_thread(f.read)
+            assert got == blob, "worker-served pull not bit-identical"
             # The serve really went through a shard, not the main loop.
             assert _shard_counter("data_plane_handoffs_total") > handoffs0
             info = origin._shardpool.worker_info()
@@ -204,8 +205,8 @@ def test_mid_serve_disconnect_failpoint_recovers(tmp_path):
                 await asyncio.wait_for(agent.download(NS, d), 60)
             finally:
                 await agent.stop()
-            with open(astore.cache_path(d), "rb") as f:
-                assert f.read() == blob
+            with await asyncio.to_thread(open, astore.cache_path(d), "rb") as f:
+                assert await asyncio.to_thread(f.read) == blob
         finally:
             await origin.stop()
             failpoints.FAILPOINTS.disarm("p2p.shard.serve.disconnect")
@@ -262,8 +263,8 @@ def test_eviction_while_serving_requeues_to_healthy_peer(tmp_path):
             finally:
                 await leech.stop()
                 await seeder.stop()
-            with open(lstore.cache_path(d), "rb") as f:
-                assert f.read() == blob
+            with await asyncio.to_thread(open, lstore.cache_path(d), "rb") as f:
+                assert await asyncio.to_thread(f.read) == blob
         finally:
             await origin.stop()
 

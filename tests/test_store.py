@@ -9,7 +9,7 @@ from kraken_tpu.core.digest import Digest
 from kraken_tpu.store import CAStore, FileExistsInCacheError, PieceStatusMetadata
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
 from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
-from kraken_tpu.store.metadata import PersistMetadata, TTIMetadata
+from kraken_tpu.store.metadata import PersistMetadata, TTIMetadata, pin, unpin
 
 
 @pytest.fixture
@@ -191,7 +191,6 @@ def test_cleanup_respects_persist(store):
 def test_persist_pins_are_independent(tmp_path):
     """Two subsystems pin the same blob; one unpin must not release the
     other's (writeback landing while replication still retries)."""
-    from kraken_tpu.store.metadata import PersistMetadata, pin, unpin
 
     store = CAStore(str(tmp_path))
     data = b"pinned blob"
@@ -226,8 +225,6 @@ def test_pending_replication_pins_until_done(tmp_path):
     from kraken_tpu.assembly import OriginNode
     from kraken_tpu.origin.client import BlobClient
     from kraken_tpu.placement import HostList, Ring
-    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
-    from kraken_tpu.store.metadata import PersistMetadata
 
     async def main():
         import socket

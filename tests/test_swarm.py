@@ -193,7 +193,7 @@ def test_resume_from_partial(tmp_path):
         lstore.allocate_partial_file(mi.digest, mi.length)
         status = PieceStatusMetadata(mi.num_pieces)
         path = lstore.partial_path(mi.digest)
-        with open(path, "r+b") as f:
+        with await asyncio.to_thread(open, path, "r+b") as f:
             for i in range(0, mi.num_pieces, 2):
                 f.seek(i * mi.piece_length)
                 f.write(blob[i * mi.piece_length : (i + 1) * mi.piece_length])

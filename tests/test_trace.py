@@ -621,7 +621,10 @@ def test_pair_pull_is_one_trace_across_nodes_and_workers(tmp_path):
             # orphans = no hop dropped the context.
             agent_dump = str(tmp_path / "agent-dump.jsonl")
             origin_dump = str(tmp_path / "origin-dump.jsonl")
-            with open(agent_dump, "w") as fa, open(origin_dump, "w") as fo:
+            with (
+                await asyncio.to_thread(open, agent_dump, "w") as fa,
+                await asyncio.to_thread(open, origin_dump, "w") as fo,
+            ):
                 for s in spans:
                     node = s.get("node", "")
                     f = fo if node.startswith("origin") else fa
