@@ -271,6 +271,15 @@ def data_plane_extras() -> dict:
                     run_ingest(blob, root, "cpu", "rename", 0)
                 )["ingest_gbps"])
         out["origin_ingest_gbps"] = max(rates)
+        rates = []
+        for _ in range(2):
+            with tempfile.TemporaryDirectory(dir=".") as root:
+                rates.append(asyncio.run(run_ingest(
+                    blob, root, "cpu", "rename", 0,
+                    ingest={"window_bytes": 64 * 1024 * 1024,
+                            "windows_in_flight": 2},
+                ))["ingest_gbps"])
+        out["origin_ingest_pipelined_gbps"] = max(rates)
     except Exception as e:  # pragma: no cover - diagnostics only
         out["origin_ingest_error"] = repr(e)[:200]
     return out

@@ -54,7 +54,7 @@ def make_blob(size_mb: int) -> bytes:
 
 async def run_ingest(
     blob: bytes, root: str, hasher: str, durability: str, chunk_mb: int,
-    hash_workers: int = 1,
+    hash_workers: int = 1, ingest: dict | None = None,
 ) -> dict:
     import aiohttp
 
@@ -62,7 +62,7 @@ async def run_ingest(
 
     node = OriginNode(
         store_root=root, hasher=hasher, dedup=False, durability=durability,
-        hash_workers=hash_workers,
+        hash_workers=hash_workers, ingest=ingest,
     )
     await node.start()
     d = Digest(SHA256, hashlib.sha256(blob).hexdigest())
@@ -103,15 +103,25 @@ async def run_ingest(
                 assert r.status == 200, r.status
                 metainfo_body = await r.read()
             timings["metainfo_s"] = time.perf_counter() - t0
+
+            overlap = None
+            if ingest is not None:
+                # The pipelined plane publishes its own overlap gauge --
+                # scrape it so the e2e row carries the overlap evidence.
+                async with http.get(f"http://{node.addr}/metrics") as r:
+                    for ln in (await r.text()).splitlines():
+                        if ln.startswith("ingest_last_overlap_ratio"):
+                            overlap = float(ln.rsplit(" ", 1)[1])
     finally:
         await node.stop()
 
     total = sum(timings.values())
-    return {
+    row = {
         "hasher": hasher,
         "hash_workers": hash_workers,
         "durability": durability,
         "blob_mb": len(blob) // MB,
+        "pipelined": ingest is not None,
         **{k: round(v, 3) for k, v in timings.items()},
         "total_s": round(total, 3),
         "ingest_gbps": round(len(blob) / total / 1e9, 3),
@@ -119,6 +129,9 @@ async def run_ingest(
         # metainfo bytes as the serial path (compared in main()).
         "metainfo_sha256": hashlib.sha256(metainfo_body).hexdigest(),
     }
+    if overlap is not None:
+        row["overlap_ratio"] = round(overlap, 3)
+    return row
 
 
 def measure_piece_pass(blob: bytes, workers_list: list[int],
@@ -221,6 +234,186 @@ def measure_thread_envelope(blob: bytes, repeats: int = 5) -> dict:
     }
 
 
+def measure_pipelined_session(blob: bytes, wif_list: list[int],
+                              window_mb: int, repeats: int) -> list[dict]:
+    """The staged ingest session (core/ingest.py) against the serial
+    piece pass, SAME hasher object, no HTTP: isolates what the
+    read/hash overlap itself buys. Rounds interleave serial with every
+    windows-in-flight config (same drift rationale as the piece pass),
+    every run is digest-gated against the serial oracle, and each
+    pipelined row carries the session's own overlap ratio and per-stage
+    walls -- overlap_ratio > 1 is the direct proof that two stages ran
+    concurrently."""
+    import statistics
+
+    from kraken_tpu.core.hasher import CPUPieceHasher
+    from kraken_tpu.core.ingest import IngestConfig, IngestPipeline
+    from kraken_tpu.origin.metainfogen import PieceLengthConfig
+
+    plen = PieceLengthConfig().piece_length(len(blob))
+    hasher = CPUPieceHasher(workers=0)
+    oracle = hashlib.sha256(
+        hasher.hash_pieces(blob, plen).tobytes()
+    ).hexdigest()
+    pipes = {
+        wif: IngestPipeline(hasher, IngestConfig(
+            window_bytes=window_mb * MB, windows_in_flight=wif,
+        ))
+        for wif in wif_list
+    }
+
+    def run_pipelined(wif: int):
+        ses = pipes[wif].session(plen)
+        off = 0
+        t0 = time.perf_counter()
+        while off < len(blob):
+            buf = ses.begin_window()
+            n = min(len(buf), len(blob) - off)
+            buf[:n] = blob[off : off + n]
+            ses.submit(n)
+            off += n
+        digests = ses.finish()
+        wall = time.perf_counter() - t0
+        got = hashlib.sha256(digests.tobytes()).hexdigest()
+        assert got == oracle, f"pipelined session diverged (wif={wif})"
+        return wall, ses
+
+    walls: dict = {"serial": [], **{w: [] for w in wif_list}}
+    last_ses: dict = {}
+    for wif in wif_list:  # warm: executor spawn + bufpool mmap off the clock
+        run_pipelined(wif)
+    keys = ["serial", *wif_list]
+    for r in range(repeats):
+        for k in keys[r % len(keys):] + keys[: r % len(keys)]:
+            if k == "serial":
+                t0 = time.perf_counter()
+                hasher.hash_pieces(blob, plen)
+                walls["serial"].append(time.perf_counter() - t0)
+            else:
+                wall, ses = run_pipelined(k)
+                walls[k].append(wall)
+                last_ses[k] = ses
+    s = statistics.median(walls["serial"])
+    rows = [{
+        "ingest_path": "serial",
+        "median_s": round(s, 3),
+        "gbps": round(len(blob) / s / 1e9, 3),
+        "median_of": repeats,
+    }]
+    for wif in wif_list:
+        m = statistics.median(walls[wif])
+        ses = last_ses[wif]
+        rows.append({
+            "ingest_path": "pipelined",
+            "windows_in_flight": wif,
+            "window_mb": window_mb,
+            "windows": ses.windows,
+            "median_s": round(m, 3),
+            "gbps": round(len(blob) / m / 1e9, 3),
+            "overlap_ratio": round(ses.overlap_ratio(), 3),
+            "stage_s": {k: round(v, 3) for k, v in ses.stage_seconds.items()},
+            "vs_serial": round(s / m, 2),
+            "median_of": repeats,
+        })
+    return rows
+
+
+def measure_pack_scaling(size_mb: int, workers_list: list[int],
+                         repeats: int) -> list[dict]:
+    """Host-pack worker scaling: one window packed to the kernel's
+    [G, nb, 16, 8, 128] tile layout through pack_tiles_pooled with 1..N
+    pool workers (each worker's stripe runs GIL-free in hostpack.c).
+    This is the multi-core lever the device-feed path rides; the pin
+    test (test_native.py) asserts the >= 1.3x band, this row prints the
+    measured number."""
+    import statistics
+
+    from kraken_tpu import native
+    from kraken_tpu.core.hasher import HashPool
+
+    if not native.have_native_packer():
+        return [{"pack_scaling": "skipped",
+                 "reason": "native packer unavailable on this rig"}]
+    plen = 4096
+    m = max(1024, (size_mb * MB) // plen // 1024 * 1024)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(m, plen), dtype=np.uint8)
+    nb = plen // 64
+    ref = native.pack_tiles(data, nb, threads=1)
+    pools = {w: HashPool(w, name=f"benchpack{w}") for w in workers_list}
+    for w in workers_list:  # warm + bit-identity gate per pool width
+        assert np.array_equal(native.pack_tiles_pooled(data, nb, pools[w]),
+                              ref), f"pooled pack diverged (workers={w})"
+    walls: dict[int, list[float]] = {w: [] for w in workers_list}
+    for r in range(repeats):
+        order = workers_list[r % len(workers_list):] + \
+            workers_list[: r % len(workers_list)]
+        for w in order:
+            t0 = time.perf_counter()
+            native.pack_tiles_pooled(data, nb, pools[w])
+            walls[w].append(time.perf_counter() - t0)
+    rows = []
+    base = statistics.median(walls[workers_list[0]])
+    for w in workers_list:
+        med = statistics.median(walls[w])
+        rows.append({
+            "pack_workers": w,
+            "window_mb": data.nbytes // MB,
+            "median_s": round(med, 4),
+            "pack_gbps": round(data.nbytes / med / 1e9, 3),
+            "vs_first": round(base / med, 2),
+            "median_of": repeats,
+        })
+    return rows
+
+
+def run_chained_e2e(blob: bytes, args, ingest_cfg: dict,
+                    hash_workers: int, rounds: int) -> dict:
+    """Chained e2e: round k's blob embeds round k-1's served-metainfo
+    sha256, so no cache tier, spool reuse, or compiler memoization can
+    shortcut any round -- each is a full cold ingest whose input depends
+    on the previous OUTPUT (the same chaining discipline the TPU kernel
+    benches use, PERF.md). Every round's served metainfo is gated
+    against a fresh serial oracle for that round's bytes."""
+    import statistics
+
+    from kraken_tpu.core.hasher import CPUPieceHasher
+    from kraken_tpu.core.metainfo import MetaInfo
+    from kraken_tpu.origin.metainfogen import PieceLengthConfig
+
+    oracle = CPUPieceHasher(workers=0)
+    plen = PieceLengthConfig().piece_length(len(blob))
+    ba = bytearray(blob)
+    prev = b"\0" * 32
+    vals = []
+    for i in range(rounds):
+        ba[64:96] = prev
+        chained = bytes(ba)
+        with tempfile.TemporaryDirectory(dir=".") as root:
+            r = asyncio.run(run_ingest(
+                chained, root, args.hasher, args.durability, args.chunk_mb,
+                hash_workers=hash_workers, ingest=ingest_cfg,
+            ))
+        d = Digest(SHA256, hashlib.sha256(chained).hexdigest())
+        want = hashlib.sha256(MetaInfo(
+            d, len(chained), plen,
+            oracle.hash_pieces(chained, plen).tobytes(),
+        ).serialize()).hexdigest()
+        assert r["metainfo_sha256"] == want, (
+            f"chained round {i} diverged from its serial oracle"
+        )
+        prev = bytes.fromhex(r["metainfo_sha256"])
+        print(json.dumps({"chained_round": i, **r}))
+        vals.append(r["ingest_gbps"])
+    return {
+        "metric": "origin_ingest_gbps_chained",
+        "value": round(statistics.median(vals), 3),
+        "unit": "GB/s",
+        "rounds": rounds,
+        "ingest": ingest_cfg,
+    }
+
+
 class _NoopHasher:
     """Service-floor probe: pieces 'hash' to zeros instantly."""
 
@@ -243,6 +436,12 @@ def main() -> None:
     ap.add_argument("--no-hash", action="store_true",
                     help="knock out both hash passes (service floor)")
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--window-mb", type=int, default=64,
+                    help="pipelined ingest staging window size")
+    ap.add_argument("--skip-pipelined", action="store_true",
+                    help="skip the pipelined-ingest rows (serial bench only)")
+    ap.add_argument("--chained-rounds", type=int, default=3,
+                    help="chained e2e rounds (0 disables)")
     args = ap.parse_args()
 
     blob = make_blob(args.blob_mb)
@@ -295,14 +494,47 @@ def main() -> None:
     else:
         sweep = [args.hash_workers if args.hash_workers is not None else 1]
 
+    pipelined_on = (
+        not args.skip_pipelined and not args.no_hash and args.hasher == "cpu"
+    )
+    if pipelined_on:
+        # Direct session rows: the overlap win in isolation, with the
+        # session's own overlap ratio + per-stage walls. Then the host
+        # pack-worker scaling row (device-feed lever).
+        for row in measure_pipelined_session(
+            blob, [1, 2, 4], args.window_mb, args.repeats
+        ):
+            print(json.dumps(row))
+        for row in measure_pack_scaling(64, [1, 2], args.repeats):
+            print(json.dumps(row))
+
+    # E2E configs, round-robin interleaved (same drift rationale as the
+    # piece pass): the serial hash_workers sweep plus -- unless skipped --
+    # the pipelined ingest plane at 1 and 2 windows in flight.
+    e2e_cfgs = [
+        {"label": f"serial/hw{w}", "hash_workers": w, "ingest": None}
+        for w in sweep
+    ]
+    if pipelined_on:
+        for wif in (1, 2):
+            e2e_cfgs.append({
+                "label": f"pipelined/wif{wif}",
+                "hash_workers": sweep[0],
+                "ingest": {"window_bytes": args.window_mb * MB,
+                           "windows_in_flight": wif},
+            })
+
     results = []
-    for workers in sweep:
-        for _ in range(args.repeats):
+    for rep in range(args.repeats):
+        order = e2e_cfgs[rep % len(e2e_cfgs):] + \
+            e2e_cfgs[: rep % len(e2e_cfgs)]
+        for cfg in order:
             with tempfile.TemporaryDirectory(dir=".") as root:
                 r = asyncio.run(run_ingest(
                     blob, root, args.hasher, args.durability, args.chunk_mb,
-                    hash_workers=workers,
+                    hash_workers=cfg["hash_workers"], ingest=cfg["ingest"],
                 ))
+                r["config"] = cfg["label"]
                 if expected_metainfo_sha is not None:
                     r["metainfo_matches_serial"] = (
                         r["metainfo_sha256"] == expected_metainfo_sha
@@ -313,19 +545,20 @@ def main() -> None:
                     "served metainfo diverged from the serial oracle!"
                 )
 
-    # Median WITHIN each workers config (cancels run noise -- best-of was
-    # the bench_pair cherry-picking this round removes), best config BY
+    # Median WITHIN each config (cancels run noise -- best-of was the
+    # bench_pair cherry-picking this round removes), best config BY
     # median across the sweep (config comparison is the point).
     import statistics
 
     per_config = []
-    for workers in sweep:
+    for cfg in e2e_cfgs:
         vals = sorted(
-            r["ingest_gbps"] for r in results if r["hash_workers"] == workers
+            r["ingest_gbps"] for r in results if r["config"] == cfg["label"]
         )
         med = statistics.median(vals)
         per_config.append({
-            "hash_workers": workers,
+            "config": cfg["label"],
+            "hash_workers": cfg["hash_workers"],
             "median_gbps": round(med, 3),
             "median_of": len(vals),
             "min": vals[0],
@@ -340,6 +573,16 @@ def main() -> None:
         "vs_baseline": None,
         "detail": {"per_config": per_config, "best_config": best},
     }))
+
+    if pipelined_on and args.chained_rounds > 0:
+        # Chained e2e through the pipelined plane: each round's input
+        # depends on the previous round's served metainfo, so every
+        # round is a provably cold full ingest.
+        print(json.dumps(run_chained_e2e(
+            blob, args,
+            {"window_bytes": args.window_mb * MB, "windows_in_flight": 2},
+            sweep[0], args.chained_rounds,
+        )))
 
 
 if __name__ == "__main__":

@@ -25,6 +25,7 @@ from kraken_tpu.backend import Manager as BackendManager
 from kraken_tpu.agent.server import AgentServer
 from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.core.hasher import get_hasher
+from kraken_tpu.core.ingest import IngestConfig, IngestPipeline
 from kraken_tpu.core.peer import PeerIDFactory
 from kraken_tpu.origin.blobrefresh import Refresher
 from kraken_tpu.origin.client import ClusterClient
@@ -197,6 +198,41 @@ def _slo_config(slo) -> SLOConfig:
     if isinstance(slo, SLOConfig):
         return slo
     return SLOConfig.from_dict(slo)
+
+
+def _ingest_config(ingest) -> IngestConfig:
+    """Same normalization for the YAML ``ingest:`` section."""
+    if isinstance(ingest, IngestConfig):
+        return ingest
+    return IngestConfig.from_dict(ingest)
+
+
+def _sync_ingest(node) -> None:
+    """Attach or retune the pipelined ingest plane from
+    ``node.ingest_config``. First call with a config builds the pipeline
+    and threads it through the generator and (if started) the blobserver
+    -- so enabling ingest on a running origin is a SIGHUP, not a restart.
+    Subsequent calls live-apply knob changes; disabling requires a
+    restart (in-flight sessions would dangle)."""
+    if node.ingest_config is None:
+        return
+    if node.ingest_pipeline is None:
+        node.ingest_pipeline = IngestPipeline(
+            node.generator.hasher, node.ingest_config
+        )
+        node.generator.pipeline = node.ingest_pipeline
+        if node.server is not None:
+            node.server._ingest_pipeline = node.ingest_pipeline
+            # Stream-time piece hashing turns on with the pipeline even
+            # on device-hasher origins; the pipeline schedules its own
+            # workers, so the legacy stream pool steps aside.
+            if node.server._stream_piece_length == 0:
+                node.server._stream_piece_length = (
+                    node.generator.piece_lengths.piece_length(0)
+                )
+            node.server._stream_hash_pool = None
+    else:
+        node.ingest_pipeline.apply(node.ingest_config)
 
 
 def _canary_config(canary) -> CanaryConfig:
@@ -607,6 +643,7 @@ class OriginNode:
         profiling: dict | ProfilerConfig | None = None,
         chunkstore: dict | ChunkStoreConfig | None = None,
         slo: dict | SLOConfig | None = None,
+        ingest: dict | IngestConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -630,11 +667,24 @@ class OriginNode:
         # blob digest at stream time; raise toward the core count on
         # multi-core origins (docs/OPERATIONS.md). 0 = strictly serial.
         self.hash_workers = hash_workers
+        hasher_obj = get_hasher(hasher, workers=hash_workers)
+        # Pipelined ingest plane (core/ingest.py): YAML `ingest:` turns
+        # the upload spool -> piece-hash path into an overlapped window
+        # stream (read || pack || transfer || hash). None = the serial
+        # legacy path. SIGHUP live-reloads knobs (and live-ENABLES the
+        # plane on a running origin).
+        self.ingest_config = None if ingest is None else _ingest_config(ingest)
+        self.ingest_pipeline = (
+            IngestPipeline(hasher_obj, self.ingest_config)
+            if self.ingest_config is not None
+            else None
+        )
         self.generator = Generator(
             self.store,
-            hasher=get_hasher(hasher, workers=hash_workers),
+            hasher=hasher_obj,
             piece_lengths=piece_lengths,
             window_bytes=hash_window_bytes,
+            pipeline=self.ingest_pipeline,
         )
         self.dedup = (
             DedupIndex(
@@ -845,6 +895,7 @@ class OriginNode:
             stream_piece_hash=self.hasher_name == "cpu",
             rpc=self.rpc,
             delta=self.delta_config,
+            ingest_pipeline=self.ingest_pipeline,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -975,6 +1026,12 @@ class OriginNode:
         if cfg.get("slo") is not None:
             self.slo_config = _slo_config(cfg["slo"])
             _apply_slo("origin", self.slo_config)
+        if cfg.get("ingest") is not None:
+            # Live knob retune -- and live ENABLE: an origin started
+            # without `ingest:` grows the pipeline on SIGHUP (rollout
+            # step; docs/OPERATIONS.md runbook). Disable needs a restart.
+            self.ingest_config = _ingest_config(cfg["ingest"])
+            _sync_ingest(self)
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the

@@ -1,0 +1,415 @@
+"""Pipelined zero-copy ingest plane: upload spool -> device hash.
+
+The bench trajectory (PERF.md, BENCH_r04-r05) left the chip ~200x faster
+than the pipe feeding it: the packed SHA-256 kernel runs at ~81 GB/s/chip
+while e2e origin ingest measured 0.365 GB/s, because the feed path was
+serial -- read the whole window, then hash it, then read the next. This
+module turns that into a multi-window stream:
+
+    read -> pack -> transfer -> hash        (per window)
+
+with ``windows_in_flight`` windows overlapped: while window k hashes on
+the device (or the host pool), window k+1 is being read into its own
+staging buffer. Staging buffers are bufpool-backed (``utils/bufpool``)
+and reused across windows -- the read lands bytes DIRECTLY in the buffer
+the pack/transfer consumes (``readinto`` / stream-chunk copy), which is
+the only host copy the window ever takes.
+
+Stage semantics per window:
+
+- **read**: filling the staging buffer (spool ``readinto`` on the
+  re-generate path; request-body chunk copy on the stream path).
+- **pack**: producing the device layout. ``pack: host`` is a zero-copy
+  reshape (the natural-layout kernel relayouts in VMEM); ``pack:
+  native`` runs the C packer cooperatively over ``pack_workers``
+  HashPool threads (ctypes drops the GIL per call); ``pack: device``
+  relays out on-chip (ops/sha256_pallas.pack_tiles_device).
+- **transfer**: ``jax.device_put`` of the window onto the mesh (device
+  hashers only; the buffer is free for reuse as soon as the put returns,
+  which is the donation point of the double-buffer scheme).
+- **hash**: the device dispatch + digest readback, or the CPU HashPool
+  piece pass -- the automatic fallback when no device hasher is
+  configured.
+
+Every window observes ``ingest_stage_seconds{stage}`` and the per-upload
+stage walls land on the ingest trace span (origin/server.py). Digests are
+bit-identical to the serial oracle by construction: pipelining reorders
+WHEN a piece is hashed, never piece boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from kraken_tpu.core.hasher import DIGEST_SIZE, HashPool, PieceHasher
+
+STAGES = ("read", "pack", "transfer", "hash", "commit")
+
+PACK_MODES = ("host", "native", "device")
+
+# Stage walls span ~100 us (a reshape) to ~10 s (a multi-GiB window on a
+# cold page cache): wider-than-default log-spaced buckets.
+_STAGE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def record_stage(stage: str, seconds: float) -> None:
+    """One window's (or commit's) wall for one pipeline stage."""
+    from kraken_tpu.utils.metrics import REGISTRY
+
+    REGISTRY.histogram(
+        "ingest_stage_seconds",
+        "Per-window wall of each ingest pipeline stage",
+        buckets=_STAGE_BUCKETS,
+    ).observe(seconds, stage=stage)
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """The YAML ``ingest:`` section (origin; SIGHUP live-reloads). Knob
+    table + rollout runbook in docs/OPERATIONS.md "Pipelined ingest"."""
+
+    # Bytes per pipeline window (floored to whole pieces at run time; a
+    # window always holds >= 1 piece). Bigger windows amortize dispatch,
+    # smaller windows bound staging RAM: peak staging is roughly
+    # window_bytes * windows_in_flight.
+    window_bytes: int = 64 * 1024 * 1024
+    # Windows concurrently in flight (read overlapping pack/transfer/
+    # hash). 2 = classic double buffering, the shipped default; 1
+    # degenerates to the serial path (useful to price the overlap).
+    windows_in_flight: int = 2
+    # HashPool workers for the ``pack: native`` cooperative pack (the C
+    # packer's 16-piece groups split across them, GIL-free). 0 = pack on
+    # the window worker itself.
+    pack_workers: int = 1
+    # host   -- natural layout; the device kernel relayouts in VMEM
+    #           (shipped default: no host cores spent, mesh-sharded).
+    # native -- AVX-512 host pack to the word-major layout, then the
+    #           pure-rounds packed kernel (~92 vs ~75 GB/s/chip on v5e);
+    #           needs spare feeder cores.
+    # device -- on-chip Pallas relayout kernel feeding the packed
+    #           kernel: packed-kernel rate without host pack cores.
+    # Modes other than host need tile-quantum windows (1024 pieces) and a
+    # single-chip device hasher; non-conforming windows fall back to
+    # host-mode handling, bit-identically.
+    pack_mode: str = "host"
+
+    def __post_init__(self):
+        if self.window_bytes < 1 << 20:
+            raise ValueError(
+                f"ingest.window_bytes must be >= 1 MiB: {self.window_bytes}"
+            )
+        if self.windows_in_flight < 1:
+            raise ValueError(
+                "ingest.windows_in_flight must be >= 1: "
+                f"{self.windows_in_flight}"
+            )
+        if self.pack_workers < 0:
+            raise ValueError(
+                f"ingest.pack_workers must be >= 0: {self.pack_workers}"
+            )
+        if self.pack_mode not in PACK_MODES:
+            raise ValueError(
+                f"ingest.pack_mode must be one of {PACK_MODES}: "
+                f"{self.pack_mode!r}"
+            )
+
+    @classmethod
+    def from_dict(cls, doc: dict | None) -> "IngestConfig":
+        doc = dict(doc or {})
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"unknown ingest config keys: {sorted(unknown)}")
+        return cls(**doc)
+
+
+class IngestPipeline:
+    """Window-stream executor over one PieceHasher.
+
+    Thread-safe; one pipeline per origin process, shared by the stream
+    path (origin/server.py _UploadDigest) and the re-generate path
+    (origin/metainfogen.py). SIGHUP swaps the config via :meth:`apply` --
+    in-flight sessions keep their birth config, new sessions see the new
+    knobs.
+    """
+
+    def __init__(self, hasher: PieceHasher, config: IngestConfig | None = None):
+        from kraken_tpu.utils.bufpool import BufferPool
+
+        self.hasher = hasher
+        self.config = config or IngestConfig()
+        self._lock = threading.Lock()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._executor_width = 0
+        self._pack_pool: Optional[HashPool] = None
+        self._pack_pool_width = 0
+        # Staging buffers: retained budget sized to the steady state
+        # (windows_in_flight leases cycling) so the pool serves every
+        # window after the first lap without allocator traffic.
+        self._bufpool = BufferPool(
+            budget_bytes=self.config.window_bytes
+            * (self.config.windows_in_flight + 1),
+            name="ingest",
+        )
+
+    def apply(self, config: IngestConfig) -> None:
+        """Live config swap (SIGHUP). Cheap when nothing changed."""
+        with self._lock:
+            old, self.config = self.config, config
+            if old == config:
+                return
+            self._bufpool.set_budget(
+                config.window_bytes * (config.windows_in_flight + 1)
+            )
+            if self._executor is not None and (
+                self._executor_width != config.windows_in_flight
+            ):
+                # Old executor drains its queued windows and exits; new
+                # sessions get a fresh one at the new width.
+                self._executor.shutdown(wait=False)
+                self._executor = None
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._executor is None:
+                self._executor_width = self.config.windows_in_flight
+                self._executor = ThreadPoolExecutor(
+                    self._executor_width, thread_name_prefix="ingest"
+                )
+            return self._executor
+
+    def _get_pack_pool(self) -> Optional[HashPool]:
+        with self._lock:
+            want = self.config.pack_workers
+            if want < 1:
+                return None
+            if self._pack_pool is None or self._pack_pool_width != want:
+                self._pack_pool = HashPool(want, name="pack")
+                self._pack_pool_width = want
+            return self._pack_pool
+
+    def session(self, piece_length: int) -> "IngestSession":
+        if piece_length <= 0:
+            raise ValueError(f"piece_length must be positive: {piece_length}")
+        return IngestSession(self, piece_length)
+
+
+class IngestSession:
+    """One blob's window stream through the pipeline.
+
+    Caller protocol (any ONE thread, off-loop):
+
+        ses = pipeline.session(piece_length)
+        while bytes remain:
+            buf = ses.begin_window()     # memoryview to fill
+            n = fill(buf)                # readinto / chunk copies
+            ses.submit(n)                # queues pack/transfer/hash
+        digests = ses.finish()           # [N, 32] uint8, piece order
+
+    ``submit`` blocks once ``windows_in_flight`` windows are queued or
+    running -- that backpressure IS the double-buffer bound. Only the
+    LAST submitted window may be short or ragged.
+    """
+
+    def __init__(self, pipeline: IngestPipeline, piece_length: int):
+        cfg = pipeline.config
+        self.pipeline = pipeline
+        self.piece_length = piece_length
+        pieces = max(1, cfg.window_bytes // piece_length)
+        if cfg.pack_mode != "host" and pieces >= 1024:
+            # Packed layouts move in 1024-piece device tiles; a tile-
+            # quantum window lets every full window take the packed path
+            # instead of falling back on alignment.
+            pieces -= pieces % 1024
+        self.window_bytes = pieces * piece_length
+        self._cfg = cfg
+        self._sem = threading.Semaphore(cfg.windows_in_flight)
+        self._futs: list[Future] = []
+        self._lease = None
+        self._read_t0 = 0.0
+        self._t0: Optional[float] = None
+        self._done = False
+        self.stage_seconds: dict[str, float] = dict.fromkeys(
+            ("read", "pack", "transfer", "hash"), 0.0
+        )
+        self.windows = 0
+        self.wall_seconds = 0.0
+
+    # -- caller side -----------------------------------------------------
+
+    def begin_window(self) -> memoryview:
+        """Lease the next staging buffer. The read wall for the window is
+        measured from here to :meth:`submit`."""
+        if self._lease is not None:
+            raise RuntimeError("previous window was never submitted")
+        # Blocks while windows_in_flight windows are queued/running: the
+        # NEXT read must not race ahead of the staging budget.
+        self._sem.acquire()
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        self._lease = self.pipeline._bufpool.lease(self.window_bytes)
+        self._read_t0 = time.perf_counter()
+        return self._lease.view[: self.window_bytes]
+
+    def submit(self, nbytes: int) -> None:
+        """Queue the filled prefix of the current staging buffer."""
+        if self._lease is None:
+            raise RuntimeError("submit without begin_window")
+        if not 0 <= nbytes <= self.window_bytes:
+            raise ValueError(f"submit: {nbytes} outside window")
+        lease, self._lease = self._lease, None
+        read_s = time.perf_counter() - self._read_t0
+        self.stage_seconds["read"] += read_s
+        record_stage("read", read_s)
+        self.windows += 1
+        if nbytes == 0:
+            lease.release()
+            self._sem.release()
+            return
+        fut = self.pipeline._get_executor().submit(
+            self._process, lease, nbytes
+        )
+        self._futs.append(fut)
+
+    def finish(self) -> np.ndarray:
+        """Wait for every window; concatenated digests in piece order."""
+        if self._lease is not None:  # begin_window with no submit
+            self._lease.release()
+            self._lease = None
+            self._sem.release()
+        try:
+            parts = [f.result() for f in self._futs]
+        finally:
+            self._done = True
+        self.wall_seconds = (
+            time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        )
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "ingest_windows_total",
+            "Windows processed by the pipelined ingest plane",
+        ).inc(self.windows, hasher=self.pipeline.hasher.name)
+        if self.wall_seconds > 0:
+            REGISTRY.gauge(
+                "ingest_last_overlap_ratio",
+                "sum(stage walls) / wall of the last ingest session "
+                "(>1 = stages overlapped)",
+            ).set(self.overlap_ratio(), hasher=self.pipeline.hasher.name)
+        if not parts:
+            return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def abort(self) -> None:
+        """Stop trusting this session: wait out in-flight windows (their
+        leases must return to the pool) and drop the results."""
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+            self._sem.release()
+        for f in self._futs:
+            try:
+                f.result()
+            except Exception:  # kt-lint: disable=bare-except  # aborting: window results AND their failures are discarded by contract -- the caller falls back to the verifying re-read pass
+                pass
+        self._futs = []
+        self._done = True
+
+    def overlap_ratio(self) -> float:
+        """sum-of-stage-walls / session wall. 1.0 = fully serial; toward
+        ``windows_in_flight`` = stages genuinely overlapped."""
+        if self.wall_seconds <= 0:
+            return 1.0
+        return sum(self.stage_seconds.values()) / self.wall_seconds
+
+    # -- worker side -----------------------------------------------------
+
+    def _bill(self, stage: str, seconds: float) -> None:
+        self.stage_seconds[stage] += seconds
+        record_stage(stage, seconds)
+
+    def _process(self, lease, nbytes: int) -> np.ndarray:
+        try:
+            view = lease.view[:nbytes]
+            plen = self.piece_length
+            m, ragged = divmod(nbytes, plen)
+            hasher = self.pipeline.hasher
+            uniform = m > 0 and ragged == 0
+            if uniform:
+                arr = np.frombuffer(view, dtype=np.uint8).reshape(m, plen)
+                if (
+                    self._cfg.pack_mode != "host"
+                    and m % 1024 == 0
+                    and plen % 64 == 0
+                    and hasher.name.startswith("tpu")
+                ):
+                    return self._packed_window(arr, plen)
+                if hasattr(hasher, "stage_window"):
+                    t0 = time.perf_counter()
+                    handle = hasher.stage_window(arr, plen)
+                    self._bill("transfer", time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    out = hasher.hash_staged_window(handle)
+                    self._bill("hash", time.perf_counter() - t0)
+                    return out
+            # Fallback (CPU HashPool path, ragged final window, hashers
+            # without the staged protocol): one batch call, billed to
+            # hash. Bit-identical by definition -- same boundaries.
+            t0 = time.perf_counter()
+            out = hasher.hash_pieces(view, plen)
+            self._bill("hash", time.perf_counter() - t0)
+            return out
+        finally:
+            lease.release()
+            self._sem.release()
+
+    def _packed_window(self, arr: np.ndarray, plen: int) -> np.ndarray:
+        """``pack: native|device`` window: explicit relayout + the
+        pure-rounds packed kernel (single-chip)."""
+        import jax
+
+        from kraken_tpu.ops.sha256 import _digest_bytes
+        from kraken_tpu.ops.sha256_pallas import (
+            pack_tiles_device,
+            packed_nb,
+            sha256_packed_tiles,
+        )
+
+        nb = packed_nb(plen // 64)
+        if self._cfg.pack_mode == "native":
+            from kraken_tpu import native
+
+            t0 = time.perf_counter()
+            packed = native.pack_tiles_pooled(
+                arr, nb, self.pipeline._get_pack_pool()
+            ).reshape(-1, nb, 16, 8, 128)
+            self._bill("pack", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            xdev = jax.device_put(packed)
+            self._bill("transfer", time.perf_counter() - t0)
+        else:  # device: transfer natural bytes, relayout on-chip
+            t0 = time.perf_counter()
+            xdev_nat = jax.device_put(arr)
+            self._bill("transfer", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            xdev = pack_tiles_device(xdev_nat, plen // 64)
+            self._bill("pack", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        out = _digest_bytes(sha256_packed_tiles(xdev, plen // 64))
+        hash_s = time.perf_counter() - t0
+        self._bill("hash", hash_s)
+        from kraken_tpu.core.hasher import record_hash_metrics
+
+        record_hash_metrics(
+            self.pipeline.hasher.name, arr.size, arr.shape[0], hash_s
+        )
+        return out

@@ -71,6 +71,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.kt_pack_tiles_mt.restype = None
+        lib.kt_pack_tiles_range.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+            ctypes.c_size_t,
+        ]
+        lib.kt_pack_tiles_range.restype = None
         lib.kt_cdc_chunk.argtypes = [
             ctypes.c_void_p,
             ctypes.c_size_t,
@@ -141,6 +151,40 @@ def default_pack_threads() -> int:
     return max(1, os.cpu_count() or 1)
 
 
+def _check_pack_args(
+    data: np.ndarray, nb_out: int, out: np.ndarray | None
+) -> tuple[np.ndarray, np.ndarray, int, int, int]:
+    """Contiguity/dtype/size assertions shared by every pack entry point.
+
+    The C packer takes raw pointers: a strided view, a wrong dtype, or an
+    undersized ``out`` (a bufpool lease cut too small, the ingest plane's
+    staging hazard) would silently corrupt memory at AVX store rates.
+    Validated HERE, once, so the GIL-free pack loops stay branch-free."""
+    if data.dtype != np.uint8 or data.ndim != 2:
+        raise ValueError(f"pack: need [M, piece_len] uint8, got "
+                         f"{data.dtype}{list(data.shape)}")
+    m, piece_len = data.shape
+    if m % 1024 or piece_len % 64:
+        raise ValueError("pack: need M % 1024 == 0 and piece_len % 64 == 0")
+    nbd = piece_len // 64
+    if nb_out < nbd:
+        raise ValueError("pack: nb_out < piece blocks")
+    t = m // 1024
+    data = np.ascontiguousarray(data)
+    if out is None:
+        out = np.zeros((t, nb_out, 16, 1024), dtype=np.uint32)
+    else:
+        if out.dtype != np.uint32:
+            raise ValueError(f"pack: out must be uint32, got {out.dtype}")
+        if out.shape != (t, nb_out, 16, 1024):
+            raise ValueError(
+                f"pack: out shape {out.shape} != {(t, nb_out, 16, 1024)}"
+            )
+        if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+            raise ValueError("pack: out must be C-contiguous and writable")
+    return data, out, m, piece_len, t
+
+
 def pack_tiles(
     data: np.ndarray,
     nb_out: int,
@@ -151,16 +195,8 @@ def pack_tiles(
     into the kernel's word-major [T, nb_out, 16, 8*128] big-endian u32
     layout.  Uses the C packer (multi-threaded over 16-piece groups) when
     available, NumPy otherwise."""
-    m, piece_len = data.shape
-    if m % 1024 or piece_len % 64:
-        raise ValueError("pack_tiles: need M % 1024 == 0 and piece_len % 64 == 0")
+    data, out, m, piece_len, t = _check_pack_args(data, nb_out, out)
     nbd = piece_len // 64
-    if nb_out < nbd:
-        raise ValueError("pack_tiles: nb_out < piece blocks")
-    t = m // 1024
-    if out is None:
-        out = np.zeros((t, nb_out, 16, 1024), dtype=np.uint32)
-    data = np.ascontiguousarray(data)
     lib = _load()
     if lib is not None:
         lib.kt_pack_tiles_mt(
@@ -181,4 +217,62 @@ def pack_tiles(
         | w[..., 3].astype(np.uint32)
     )  # [t, 1024, nbd, 16]
     out[:, :nbd] = be.transpose(0, 2, 3, 1)
+    return out
+
+
+def pack_tiles_range(
+    data: np.ndarray,
+    nb_out: int,
+    out: np.ndarray,
+    g_lo: int,
+    g_hi: int,
+) -> None:
+    """Pack ONLY 16-piece groups ``[g_lo, g_hi)`` of ``data`` into ``out``
+    on the calling thread -- the cooperative entry HashPool pack workers
+    use: ctypes releases the GIL for the duration of the C call, so N
+    workers packing disjoint ranges of one window scale with cores.
+    Bounds are clamped to the group count; ``out`` must be the
+    caller-zeroed full destination (ranges only write their own stripes).
+    Requires the native library (callers check :func:`have_native_packer`
+    and fall back to :func:`pack_tiles`)."""
+    data, out, m, piece_len, _ = _check_pack_args(data, nb_out, out)
+    lib = _load()
+    if lib is None or not hasattr(lib, "kt_pack_tiles_range"):
+        raise RuntimeError("pack_tiles_range: native packer unavailable")
+    lib.kt_pack_tiles_range(
+        data.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        m,
+        piece_len,
+        nb_out,
+        max(0, g_lo),
+        max(0, g_hi),
+    )
+
+
+def pack_tiles_pooled(
+    data: np.ndarray, nb_out: int, pool, out: np.ndarray | None = None
+) -> np.ndarray:
+    """Pack one window through ``pool`` (a core.hasher.HashPool): the
+    group range splits across the pool's workers via ``run_sharded``,
+    each worker packing its contiguous stripe GIL-free through
+    :func:`pack_tiles_range`. Falls back to the single-call path when the
+    native library (or a multi-worker pool) is absent."""
+    data, out, m, piece_len, _ = _check_pack_args(data, nb_out, out)
+    if (
+        pool is None
+        or pool.workers < 2
+        or not have_native_packer()
+        or not hasattr(_LIB, "kt_pack_tiles_range")
+    ):
+        return pack_tiles(
+            data, nb_out, out=out,
+            threads=pool.workers if pool is not None else None,
+        )
+    n_groups = m // 16
+
+    def worker(lo: int, hi: int) -> None:
+        pack_tiles_range(data, nb_out, out, lo, hi)
+
+    pool.run_sharded(n_groups, worker)
     return out

@@ -213,6 +213,30 @@ void kt_pack_tiles(const uint8_t *restrict src, uint32_t *restrict dst,
     kt_pack_tiles_mt(src, dst, n_pieces, piece_len, nb_out, 1);
 }
 
+/* Cooperative entry point: pack ONLY 16-piece groups [g_lo, g_hi) of the
+ * same (src, dst) pair, on the calling thread.  This is how HashPool
+ * pack workers parallelize from Python: ctypes drops the GIL for the
+ * duration of every foreign call, so N workers each packing a disjoint
+ * group range scale with cores without the interpreter serializing them
+ * (and without this library owning a thread pool -- scheduling stays
+ * with the shared HashPool, where pack work and hash work are visible
+ * to the same occupancy gauges).  Groups write disjoint 16-lane stripes
+ * of every destination word tile, so ranges never share cache lines
+ * within a 64 B store row.  Out-of-range bounds are clamped: the caller
+ * computes ranges from n_pieces / 16 and a short final shard is legal. */
+void kt_pack_tiles_range(const uint8_t *restrict src, uint32_t *restrict dst,
+                         size_t n_pieces, size_t piece_len, size_t nb_out,
+                         size_t g_lo, size_t g_hi)
+{
+    const size_t n_groups = n_pieces / KT_GRP;
+    if (g_hi > n_groups)
+        g_hi = n_groups;
+    if (g_lo >= g_hi)
+        return;
+    kt_pack_job job = {src, dst, piece_len, nb_out, g_lo, g_hi};
+    pack_range(&job);
+}
+
 /* ---------------------------------------------------------------------
  * FastCDC sequential chunker (host plane).
  *

@@ -290,6 +290,102 @@ def packed_nb(unpadded_blocks: int) -> int:
     return ((unpadded_blocks + _KB - 1) // _KB) * _KB
 
 
+def _make_pack_kernel():
+    """Relayout-only grid step: natural [1, N_TILE, _KB*64] uint8 slab ->
+    packed [1, _KB, 16, _SUB, _LANES] big-endian words. The same in-VMEM
+    u8 transpose + byte-plane recombine the natural hash kernel performs,
+    emitted as data instead of consumed by rounds -- the ``pack: device``
+    alternative to the AVX-512 host packer (kraken_tpu/native)."""
+
+    def kernel(blk_ref, out_ref):
+        t8 = jnp.transpose(blk_ref[0], (1, 0)).reshape(
+            _KB, 16, 4, _SUB, _LANES
+        )
+        for kb in range(_KB):
+            for j in range(16):
+                b0 = t8[kb, j, 0].astype(jnp.uint32)
+                b1 = t8[kb, j, 1].astype(jnp.uint32)
+                b2 = t8[kb, j, 2].astype(jnp.uint32)
+                b3 = t8[kb, j, 3].astype(jnp.uint32)
+                out_ref[0, kb, j, :, :] = (
+                    (b0 << np.uint32(24))
+                    | (b1 << np.uint32(16))
+                    | (b2 << np.uint32(8))
+                    | b3
+                )
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("unpadded_blocks", "interpret"))
+def pack_tiles_device(
+    data_u8: jax.Array,
+    unpadded_blocks: int,
+    interpret: bool | None = None,
+):
+    """On-device pack: natural [M, P] uint8 pieces (M % N_TILE == 0,
+    P = unpadded_blocks * 64) -> the packed word-major
+    [T, NB, 16, _SUB, _LANES] uint32 layout of
+    :func:`kraken_tpu.native.pack_tiles`, with NB = packed_nb(...). Bytes
+    transfer to the device in natural layout; the relayout (and the LE->BE
+    byteswap it implies) happens on-chip, so the host never spends pack
+    cores and the hash pass still runs the pure-rounds packed kernel."""
+    interpret = _resolve_interpret(interpret)
+    m = data_u8.shape[0]
+    t = m // N_TILE
+    nb = unpadded_blocks
+    ngroups = (nb + _KB - 1) // _KB
+
+    slabs = data_u8.reshape(t, N_TILE, nb * 64)
+    if nb % _KB:
+        # Zero-pad the block axis: zero bytes pack to zero words, which
+        # matches the host packer's zero-filled trailing blocks exactly.
+        slabs = jnp.pad(
+            slabs, ((0, 0), (0, 0), (0, (ngroups * _KB - nb) * 64))
+        )
+
+    return pl.pallas_call(
+        _make_pack_kernel(),
+        interpret=interpret,
+        grid=(t, ngroups),
+        in_specs=[
+            pl.BlockSpec(
+                (1, N_TILE, _KB * 64), lambda ti, bi: (ti, 0, bi),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _KB, 16, _SUB, _LANES), lambda ti, bi: (ti, bi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (t, ngroups * _KB, 16, _SUB, _LANES), jnp.uint32
+        ),
+    )(slabs)
+
+
+def hash_pieces_device_packed(
+    data_u8: jax.Array, piece_length: int, interpret: bool | None = None
+) -> jax.Array:
+    """``pack: device`` hash path: on-device relayout
+    (:func:`pack_tiles_device`) feeding the pure-rounds packed kernel.
+    data_u8: [M, piece_length] uint8, any M; returns [M, 8] uint32."""
+    if piece_length % 64:
+        raise ValueError("pallas path requires piece_length % 64 == 0")
+    m = data_u8.shape[0]
+    pad_rows = (-m) % N_TILE
+    if pad_rows:
+        data_u8 = jnp.concatenate(
+            [data_u8, jnp.zeros((pad_rows, piece_length), dtype=jnp.uint8)]
+        )
+    packed = pack_tiles_device(
+        data_u8, piece_length // 64, interpret=interpret
+    )
+    return sha256_packed_tiles(
+        packed, piece_length // 64, interpret=interpret
+    )[:m]
+
+
 def hash_pieces_device(
     data_u8: jax.Array, piece_length: int, interpret: bool | None = None
 ) -> jax.Array:

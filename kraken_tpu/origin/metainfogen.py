@@ -71,6 +71,7 @@ class Generator:
         hasher: PieceHasher | None = None,
         piece_lengths: PieceLengthConfig | None = None,
         window_bytes: int = 256 * 1024 * 1024,
+        pipeline=None,
     ):
         self.store = store
         self.hasher = hasher or get_hasher("cpu")
@@ -82,6 +83,11 @@ class Generator:
         # dispatch occupancy; the default trades ~piece-batch occupancy
         # for a bounded footprint.
         self.window_bytes = window_bytes
+        # core.ingest.IngestPipeline, when the origin runs the pipelined
+        # ingest plane: re-generates stream spool windows through it
+        # (read overlapping pack/transfer/hash) instead of the serial
+        # read-then-hash loop below. None = serial path.
+        self.pipeline = pipeline
 
     def get_cached(self, d: Digest) -> MetaInfo | None:
         md = self.store.get_metadata(d, TorrentMetaMetadata)
@@ -96,6 +102,11 @@ class Generator:
             return cached
         size = self.store.cache_size(d)  # KeyError if absent
         piece_length = self.piece_lengths.piece_length(size)
+        if self.pipeline is not None:
+            hashes = self._generate_pipelined(d, piece_length)
+            metainfo = MetaInfo(d, size, piece_length, hashes.tobytes())
+            self.store.set_metadata(d, TorrentMetaMetadata(metainfo))
+            return metainfo
         # Floor the window at a FEW pieces when a hash pool exists, so a
         # tiny configured window cannot fully serialize the sharded
         # piece pass -- but cap the floor at 4 pieces: window_bytes is
@@ -133,6 +144,26 @@ class Generator:
         metainfo = MetaInfo(d, size, piece_length, hashes.tobytes())
         self.store.set_metadata(d, TorrentMetaMetadata(metainfo))
         return metainfo
+
+    def _generate_pipelined(self, d: Digest, piece_length: int) -> np.ndarray:
+        """Stream the blob through the ingest pipeline: ``readinto`` lands
+        each window's bytes DIRECTLY in the staging buffer the hasher
+        consumes (the zero-copy read stage), and the pipeline overlaps
+        window k+1's read with window k's pack/transfer/hash. Digests are
+        bit-identical to the serial loop -- same piece boundaries."""
+        ses = self.pipeline.session(piece_length)
+        try:
+            with self.store.open_cache_file(d) as f:
+                while True:
+                    buf = ses.begin_window()
+                    n = f.readinto(buf)
+                    ses.submit(n or 0)
+                    if not n or n < len(buf):
+                        break
+            return ses.finish()
+        except BaseException:
+            ses.abort()
+            raise
 
     async def generate(self, d: Digest) -> MetaInfo:
         """Off-loop :meth:`generate_sync` (reads + hashes a whole blob)."""

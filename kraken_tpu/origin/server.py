@@ -70,15 +70,23 @@ class _UploadDigest:
     ingest scaling model -- while piece hashing rides the other cores.
     Piece FRAGMENTS buffer until their piece completes (bounded: at most
     ``2 * workers`` pieces may be in flight before the stream thread
-    blocks on the oldest), and the digests come back in piece order."""
+    blocks on the oldest), and the digests come back in piece order.
+
+    With a ``pipeline`` (core/ingest.py IngestPipeline) arriving bytes
+    copy once into a leased staging window and full windows flow through
+    the pipeline's pack/transfer/hash stages -- the piece pass rides the
+    DEVICE hash plane at stream time (``hasher: tpu-sharded`` origins),
+    overlapped window-by-window with the stream itself. Supersedes the
+    pool path when both are configured."""
 
     __slots__ = (
         "_hash", "_pos", "_active", "_valid", "created", "hash_seconds",
         "_plen", "_piece", "_piece_len", "_piece_digests",
-        "_pool", "_parts", "_futs",
+        "_pool", "_parts", "_futs", "_ses", "_win", "_win_pos",
+        "stage_walls",
     )
 
-    def __init__(self, piece_length: int = 0, pool=None):
+    def __init__(self, piece_length: int = 0, pool=None, pipeline=None):
         import hashlib
         import time
 
@@ -89,14 +97,28 @@ class _UploadDigest:
         self._active = False
         self._valid = True
         self._plen = piece_length
-        self._pool = pool if piece_length else None
+        # A session holds no leases or pipeline slots until its first
+        # begin_window, so creating it per-tracker is free even for
+        # uploads that are started and abandoned.
+        self._ses = pipeline.session(piece_length) if (
+            pipeline is not None and piece_length
+        ) else None
+        self._win: memoryview | None = None  # current staging window
+        self._win_pos = 0
+        self._pool = pool if piece_length and self._ses is None else None
         self._piece = (
-            hashlib.sha256() if piece_length and self._pool is None else None
+            hashlib.sha256()
+            if piece_length and self._pool is None and self._ses is None
+            else None
         )
         self._piece_len = 0
         self._piece_digests: list[bytes] = []
         self._parts: list[memoryview] = []  # current piece's fragments
         self._futs: list = []  # in-order piece-digest futures (pooled)
+        # Per-stage walls of the pipelined piece pass (set by
+        # piece_hashes on pipeline trackers; commit puts them on the
+        # ingest trace span).
+        self.stage_walls: dict | None = None
 
     def begin_patch(self, offset: int) -> bool:
         """False = stop tracking this upload (commit will re-read)."""
@@ -123,6 +145,18 @@ class _UploadDigest:
         # drop the pins now -- its piece hashes can never be used.
         self._parts = []
         self._futs = []
+        if self._ses is not None:
+            # Return the session's staging leases to the pool. abort()
+            # joins in-flight windows (up to a device hash wall), and
+            # invalidate runs ON the event loop from PATCH error paths --
+            # hand the wait to a scrap thread.
+            import threading
+
+            ses, self._ses = self._ses, None
+            self._win = None
+            threading.Thread(
+                target=ses.abort, name="ingest-abort", daemon=True
+            ).start()
 
     @staticmethod
     def _hash_parts(parts: list[memoryview]) -> bytes:
@@ -140,6 +174,27 @@ class _UploadDigest:
         t0 = time.perf_counter()
         self._hash.update(chunk)
         self._pos += len(chunk)
+        if self._ses is not None:
+            # Pipelined stream-time piece pass: ONE copy, straight into
+            # the leased staging window (the pipeline's read stage); a
+            # full window submits to pack/transfer/hash while the next
+            # chunks land in the next window. submit() blocking on
+            # windows_in_flight is the stream's backpressure -- this
+            # runs on the PATCH flush thread, off-loop.
+            self.hash_seconds += time.perf_counter() - t0
+            view = memoryview(chunk)
+            while view:
+                if self._win is None:
+                    self._win = self._ses.begin_window()
+                    self._win_pos = 0
+                take = min(len(view), len(self._win) - self._win_pos)
+                self._win[self._win_pos : self._win_pos + take] = view[:take]
+                self._win_pos += take
+                view = view[take:]
+                if self._win_pos == len(self._win):
+                    self._ses.submit(self._win_pos)
+                    self._win = None
+            return
         if self._plen:
             view = memoryview(chunk)
             while view:
@@ -188,12 +243,34 @@ class _UploadDigest:
     def piece_hashes(self, upload_size: int, piece_length: int) -> bytes | None:
         """Concatenated per-piece digests, or None when unavailable (not
         tracked, wrong piece length for the final size, or empty blob)."""
-        if (
+        usable = not (
             not self._plen
             or piece_length != self._plen
             or upload_size == 0
             or self.result(upload_size) is None
-        ):
+        )
+        if self._ses is not None:
+            # Runs off-loop (commit wraps this call in to_thread), so
+            # joining the session's in-flight windows here is fine.
+            ses, self._ses = self._ses, None
+            if not usable:
+                # Final size landed in a different piece-length tier (or
+                # tracking broke): the stream-time digests are at the
+                # WRONG piece length -- drop them; commit falls back to
+                # the re-generate pass (itself pipelined).
+                ses.abort()
+                return None
+            if self._win is not None:
+                ses.submit(self._win_pos)
+                self._win = None
+            digests = ses.finish()
+            self.stage_walls = {
+                **ses.stage_seconds,
+                "windows": ses.windows,
+                "overlap_ratio": round(ses.overlap_ratio(), 3),
+            }
+            return digests.tobytes()
+        if not usable:
             return None
         if self._pool is not None:
             out = [f.result() for f in self._futs]
@@ -254,6 +331,7 @@ class OriginServer(LameduckMixin):
         stream_piece_hash: bool = True,  # False on TPU-hasher origins
         rpc=None,  # utils.deadline.RPCConfig (optional)
         delta=None,  # p2p.delta.DeltaConfig (optional; gates /recipe)
+        ingest_pipeline=None,  # core.ingest.IngestPipeline (optional)
     ):
         self.store = store
         self.generator = generator
@@ -289,17 +367,23 @@ class OriginServer(LameduckMixin):
         # keyed on FINAL blob size (unknown mid-stream), so stream piece-
         # hashing bets on the smallest tier and falls back to the post-
         # commit windowed pass when a huge blob lands in a bigger tier.
+        # The pipelined ingest plane (core/ingest.py) makes stream-time
+        # piece hashing viable on DEVICE-hasher origins too: the window
+        # stream hashes on the chip while the upload body streams in.
+        self._ingest_pipeline = ingest_pipeline
         self._stream_piece_length = (
             generator.piece_lengths.piece_length(0)
-            if stream_piece_hash and generator is not None
+            if (stream_piece_hash or ingest_pipeline is not None)
+            and generator is not None
             else 0
         )
         # hash_workers origins hand completed stream-time pieces to the
         # hasher's pool; the PATCH thread then pays only the serial blob
-        # digest (core/hasher.py HashPool).
+        # digest (core/hasher.py HashPool). A pipeline supersedes it --
+        # the pipeline schedules its own workers.
         self._stream_hash_pool = (
             getattr(generator.hasher, "pool", None)
-            if self._stream_piece_length
+            if self._stream_piece_length and ingest_pipeline is None
             else None
         )
         # A dedup plane that dies per-blob (sqlite sidecar corruption,
@@ -435,6 +519,7 @@ class OriginServer(LameduckMixin):
             self._upload_digests[uid] = _UploadDigest(
                 piece_length=self._stream_piece_length,
                 pool=self._stream_hash_pool,
+                pipeline=self._ingest_pipeline,
             )
         return web.Response(text=uid)
 
@@ -554,6 +639,10 @@ class OriginServer(LameduckMixin):
             self._inflight_writes -= 1
 
     async def _commit_inner(self, req: web.Request) -> web.Response:
+        import time
+
+        from kraken_tpu.utils import trace
+
         uid = req.match_info["uid"]
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
@@ -561,49 +650,68 @@ class OriginServer(LameduckMixin):
         precomputed: Digest | None = None
         piece_hashes: bytes | None = None
         size = 0
-        if tracker is not None:
+        # Nests under the http.server middleware span; carries the
+        # per-stage walls of the pipelined stream-time piece pass so one
+        # trace answers "where did this upload's time go".
+        with trace.span("origin.ingest.commit", digest=d.hex[:12]) as sp:
+            if tracker is not None:
+                try:
+                    size = self.store.upload_size(uid)
+                except UploadNotFoundError:
+                    raise web.HTTPNotFound(text="unknown upload")
+                precomputed = tracker.result(size)
+                if self.generator is not None:
+                    # Off-loop: on pooled origins piece_hashes() blocks on
+                    # outstanding pool futures and hashes the trailing
+                    # partial piece inline -- tens of ms a stalled loop
+                    # would charge to every other request and conn pump.
+                    piece_hashes = await asyncio.to_thread(
+                        tracker.piece_hashes,
+                        size, self.generator.piece_lengths.piece_length(size),
+                    )
+            t_commit = time.perf_counter()
             try:
-                size = self.store.upload_size(uid)
+                await asyncio.to_thread(
+                    self.store.commit_upload, uid, d, precomputed=precomputed
+                )
             except UploadNotFoundError:
                 raise web.HTTPNotFound(text="unknown upload")
-            precomputed = tracker.result(size)
-            if self.generator is not None:
-                # Off-loop: on pooled origins piece_hashes() blocks on
-                # outstanding pool futures and hashes the trailing
-                # partial piece inline -- tens of ms a stalled loop
-                # would charge to every other request and conn pump.
-                piece_hashes = await asyncio.to_thread(
-                    tracker.piece_hashes,
-                    size, self.generator.piece_lengths.piece_length(size),
+            except DigestMismatchError as e:
+                raise web.HTTPBadRequest(text=str(e))
+            except FileExistsInCacheError:
+                return web.Response(status=409, text="already cached")
+            from kraken_tpu.core.ingest import record_stage
+
+            commit_s = time.perf_counter() - t_commit
+            record_stage("commit", commit_s)
+            sp.set(size=size, commit_s=round(commit_s, 6))
+            if tracker is not None and tracker.stage_walls is not None:
+                sp.set(**{
+                    f"ingest_{k}": round(v, 6) if isinstance(v, float) else v
+                    for k, v in tracker.stage_walls.items()
+                })
+            metainfo = None
+            if piece_hashes is not None:
+                if tracker.stage_walls is None:
+                    # Stream-time piece hashes cover the final size at the
+                    # final piece length: the MetaInfo is free, no re-read
+                    # pass. The north-star hasher gauges still move (the
+                    # stream path IS the piece-hash plane on cpu origins).
+                    # On hash_workers origins hash_seconds counts only the
+                    # stream thread's serial blob digest -- the honest
+                    # wall bound; piece hashing overlapped it on the pool.
+                    # (Pipelined trackers already recorded theirs inside
+                    # the pipeline, labeled by the device hasher.)
+                    record_hash_metrics(
+                        "cpu", size, len(piece_hashes) // 32,
+                        tracker.hash_seconds,
+                    )
+                metainfo = await asyncio.to_thread(
+                    self.generator.adopt, d, size,
+                    self.generator.piece_lengths.piece_length(size),
+                    piece_hashes,
                 )
-        try:
-            await asyncio.to_thread(
-                self.store.commit_upload, uid, d, precomputed=precomputed
-            )
-        except UploadNotFoundError:
-            raise web.HTTPNotFound(text="unknown upload")
-        except DigestMismatchError as e:
-            raise web.HTTPBadRequest(text=str(e))
-        except FileExistsInCacheError:
-            return web.Response(status=409, text="already cached")
-        metainfo = None
-        if piece_hashes is not None:
-            # Stream-time piece hashes cover the final size at the final
-            # piece length: the MetaInfo is free, no re-read pass. The
-            # north-star hasher gauges still move (the stream path IS the
-            # piece-hash plane on cpu origins). On hash_workers origins
-            # hash_seconds counts only the stream thread's serial blob
-            # digest -- the honest wall bound; piece hashing overlapped it
-            # on the pool.
-            record_hash_metrics(
-                "cpu", size, len(piece_hashes) // 32,
-                tracker.hash_seconds,
-            )
-            metainfo = await asyncio.to_thread(
-                self.generator.adopt, d, size,
-                self.generator.piece_lengths.piece_length(size), piece_hashes,
-            )
-        await self._post_commit(ns, d, metainfo=metainfo)
+            await self._post_commit(ns, d, metainfo=metainfo)
         return web.Response(status=201)
 
     async def _post_commit(self, ns: str, d: Digest, metainfo=None) -> None:

@@ -72,6 +72,59 @@ def _sharded_fn(
     return compat.jit_with_sharding(mapped, mesh, out_spec)
 
 
+def stage_sharded_pieces(
+    mesh: Mesh, data_u8: np.ndarray, piece_length: int
+) -> tuple[jax.Array, int]:
+    """TRANSFER stage of the sharded hash: pad [M, piece_length] uint8 to
+    the mesh's device quantum and ``jax.device_put`` it row-sharded over
+    the ``pieces`` axis. Returns ``(staged, m)`` for
+    :func:`hash_sharded_staged`. Split out so the ingest pipeline can
+    overlap window k+1's host->device transfer with window k's hash (and
+    bill each to its own stage wall)."""
+    if piece_length % 64:
+        raise ValueError("sharded path requires piece_length % 64 == 0")
+    n_dev = mesh.devices.size
+    m = data_u8.shape[0]
+    # Equal shards are mandatory under shard_map; pallas additionally pads
+    # each shard to its tile internally, so only the device quantum matters.
+    pad_rows = (-m) % n_dev
+    if pad_rows:
+        data_u8 = np.concatenate(
+            [data_u8, np.zeros((pad_rows, piece_length), dtype=np.uint8)]
+        )
+    x = jax.device_put(data_u8, NamedSharding(mesh, P("pieces", None)))
+    return x, m
+
+
+def hash_sharded_staged(
+    mesh: Mesh,
+    staged: jax.Array,
+    m: int,
+    piece_length: int,
+    *,
+    use_pallas: bool = False,
+    interpret: bool | None = None,
+    replicate: bool = True,
+) -> jax.Array:
+    """HASH stage over an already-staged (device-resident, row-sharded)
+    window from :func:`stage_sharded_pieces`."""
+    if interpret is None:
+        interpret = mesh.devices.flat[0].platform == "cpu"
+    pad_block = jax.device_put(
+        _pad_block_for(piece_length), NamedSharding(mesh, P())
+    )
+    fn = _sharded_fn(
+        mesh, piece_length // 64, use_pallas, bool(interpret), replicate
+    )
+    out = fn(staged, pad_block)
+    if staged.shape[0] != m:
+        # Static-index slice: a dynamic `out[:m]` gather eagerly transfers
+        # its int32 start index to the DEFAULT device -- the round-2 driver
+        # failure, where that device was a version-skewed real TPU.
+        out = jax.lax.slice_in_dim(out, 0, m)
+    return out
+
+
 def sharded_hash_pieces(
     mesh: Mesh,
     data_u8: np.ndarray,
@@ -89,35 +142,11 @@ def sharded_hash_pieces(
     multiple of 64 (the uniform fast path; ragged tails go through the
     single-chip ragged kernel upstream of this call).
     """
-    if piece_length % 64:
-        raise ValueError("sharded path requires piece_length % 64 == 0")
-    n_dev = mesh.devices.size
-    if interpret is None:
-        interpret = mesh.devices.flat[0].platform == "cpu"
-
-    m = data_u8.shape[0]
-    # Equal shards are mandatory under shard_map; pallas additionally pads
-    # each shard to its tile internally, so only the device quantum matters.
-    pad_rows = (-m) % n_dev
-    if pad_rows:
-        data_u8 = np.concatenate(
-            [data_u8, np.zeros((pad_rows, piece_length), dtype=np.uint8)]
-        )
-
-    x = jax.device_put(data_u8, NamedSharding(mesh, P("pieces", None)))
-    pad_block = jax.device_put(
-        _pad_block_for(piece_length), NamedSharding(mesh, P())
+    staged, m = stage_sharded_pieces(mesh, data_u8, piece_length)
+    return hash_sharded_staged(
+        mesh, staged, m, piece_length,
+        use_pallas=use_pallas, interpret=interpret, replicate=replicate,
     )
-    fn = _sharded_fn(
-        mesh, piece_length // 64, use_pallas, bool(interpret), replicate
-    )
-    out = fn(x, pad_block)
-    if pad_rows:
-        # Static-index slice: a dynamic `out[:m]` gather eagerly transfers
-        # its int32 start index to the DEFAULT device -- the round-2 driver
-        # failure, where that device was a version-skewed real TPU.
-        out = jax.lax.slice_in_dim(out, 0, m)
-    return out
 
 
 class ShardedPieceHasher(PieceHasher):
@@ -181,6 +210,35 @@ class ShardedPieceHasher(PieceHasher):
 
     def hash_batch(self, pieces) -> np.ndarray:
         return self._fallback.hash_batch(pieces)
+
+    # -- staged-window protocol (core/ingest.py pipeline) ----------------
+    # stage_window/hash_staged_window split hash_pieces at the host->
+    # device boundary so the pipeline can overlap window k+1's transfer
+    # with window k's hash and attribute each to its own stage wall.
+    # Digests are bit-identical to hash_pieces by construction (the same
+    # sharded fn runs on the same rows).
+
+    def stage_window(self, arr: np.ndarray, piece_length: int):
+        """Transfer one UNIFORM window ([M, piece_length] uint8, every row
+        a full piece) to the mesh. Returns an opaque staged handle."""
+        staged, m = stage_sharded_pieces(self._mesh, arr, piece_length)
+        return (staged, m, piece_length)
+
+    def hash_staged_window(self, handle) -> np.ndarray:
+        """Hash a :meth:`stage_window` handle -> [M, 32] uint8 digests."""
+        staged, m, piece_length = handle
+        start = time.perf_counter()
+        out = _digest_bytes(
+            hash_sharded_staged(
+                self._mesh, staged, m, piece_length,
+                use_pallas=self._use_pallas, replicate=False,
+            )
+        )
+        record_hash_metrics(
+            self.name, m * piece_length, m, time.perf_counter() - start,
+            occupancy=1.0,
+        )
+        return out
 
 
 register_hasher("tpu-sharded", ShardedPieceHasher)

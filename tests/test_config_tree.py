@@ -27,7 +27,7 @@ CLI_KEYS = {
     "tag_cache_ttl", "durability", "dedup_low_j_bands", "hash_workers",
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
-    "profiling", "fleet", "chunkstore", "slo", "canary",
+    "profiling", "fleet", "chunkstore", "slo", "canary", "ingest",
 }
 
 
@@ -328,6 +328,35 @@ def test_canary_sections_construct_canary_config():
         assert cfg.ttl_seconds > cfg.interval_seconds, path
         seen += 1
     assert seen >= 1  # the agent registers the canary knobs
+
+
+def test_ingest_sections_construct_ingest_config():
+    """Every shipped `ingest:` section must map onto IngestConfig
+    through the same from_dict the CLI/assembly use -- a typo'd knob
+    must fail here, not at production boot. The shipped defaults must
+    stay SAFE: host pack mode (no feeder cores claimed, mesh-sharded)
+    and classic double buffering, so a config refresh never silently
+    changes the pack path or balloons staging RAM."""
+    from kraken_tpu.core.ingest import IngestConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        ic = load_config(path).get("ingest")
+        if ic is None:
+            continue
+        cfg = IngestConfig.from_dict(ic)  # raises on unknown keys
+        assert cfg.pack_mode == "host", (
+            f"{path}: shipped pack_mode must stay 'host' (native/device"
+            " are per-rig opt-ins -- PERF.md 'Pipelined ingest plane')"
+        )
+        assert cfg.windows_in_flight == 2, (
+            f"{path}: shipped windows_in_flight must stay 2 (double"
+            " buffering; staging RAM scales with it)"
+        )
+        assert 1 << 20 <= cfg.window_bytes <= 1 << 30, path
+        assert cfg.pack_workers >= 0, path
+        seen += 1
+    assert seen >= 1  # the origin registers the ingest knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -394,3 +394,229 @@ def test_agent_pull_with_fsync_durability(tmp_path):
             await leecher.stop()
 
     asyncio.run(main())
+
+
+# -- pipelined ingest plane (core/ingest.py) -------------------------------
+
+
+def _pipe_node(tmp_path, **kw):
+    """Origin with the pipelined ingest plane on, windows kept small so a
+    few hundred KiB of blob spans several windows."""
+    kw.setdefault("ingest", {"window_bytes": 1 << 20, "windows_in_flight": 2})
+    return _node(tmp_path, **kw)
+
+
+def test_ingest_config_validation():
+    """IngestConfig is the SIGHUP surface: unknown keys and out-of-range
+    knobs must fail loudly at parse time, never half-apply."""
+    from kraken_tpu.core.ingest import IngestConfig
+
+    cfg = IngestConfig.from_dict(None)
+    assert cfg.pack_mode == "host" and cfg.windows_in_flight == 2
+    with pytest.raises(ValueError):
+        IngestConfig.from_dict({"widow_bytes": 1 << 20})  # typo'd key
+    with pytest.raises(ValueError):
+        IngestConfig(windows_in_flight=0)
+    with pytest.raises(ValueError):
+        IngestConfig(pack_mode="avx")
+    with pytest.raises(ValueError):
+        IngestConfig(window_bytes=4096)
+
+
+def test_ingest_session_bit_identity():
+    """The pipeline reorders WHEN pieces hash, never piece boundaries:
+    digests must match the serial oracle for empty, single-window,
+    multi-window, and ragged-tail blobs (the full edge square)."""
+    import numpy as np
+
+    from kraken_tpu.core.ingest import IngestConfig, IngestPipeline
+
+    pipe = IngestPipeline(
+        get_hasher("cpu"),
+        IngestConfig(window_bytes=1 << 20, windows_in_flight=2),
+    )
+    plen = 4096
+    rng = __import__("numpy").random.default_rng(7)
+    for total in (0, plen, 3 * plen + 1, (1 << 20) * 2 + 5 * plen + 99):
+        blob = rng.integers(0, 256, size=total, dtype=np.uint8).tobytes()
+        ses = pipe.session(plen)
+        off = 0
+        while off < len(blob):
+            buf = ses.begin_window()
+            n = min(len(buf), len(blob) - off)
+            buf[:n] = blob[off : off + n]
+            off += n
+            ses.submit(n)
+        got = ses.finish()
+        want = get_hasher("cpu").hash_pieces(blob, plen)
+        assert np.array_equal(got, want), f"total={total}"
+        if total:
+            assert ses.windows >= 1 and ses.wall_seconds > 0
+
+
+def test_pipelined_stream_matches_generate(tmp_path):
+    """Uploads through a pipeline-enabled origin (cpu hasher): the
+    stream-time window pass must produce a MetaInfo bit-identical to the
+    serial oracle, across piece-misaligned chunk boundaries, multiple
+    windows, and a short trailing piece -- and the stage metrics must
+    move (the observability contract of the plane)."""
+
+    async def main():
+        import os
+
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        blob = os.urandom((1 << 20) * 2 + 5 * PIECE + 1234)
+        d = Digest.from_bytes(blob)
+        node = _pipe_node(tmp_path)
+        assert node.ingest_pipeline is not None
+        assert node.generator.pipeline is node.ingest_pipeline
+        windows_before = REGISTRY.counter(
+            "ingest_windows_total", "x"
+        ).value(hasher="cpu")
+        await node.start()
+        try:
+            cuts = [0, PIECE // 3, (1 << 20) + 17, 2 * (1 << 20) - 1, len(blob)]
+            chunks = [blob[a:b] for a, b in zip(cuts, cuts[1:])]
+            status, _ = await _upload(node.addr, d, chunks)
+            assert status == 201
+            stored = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            want = get_hasher("cpu").hash_pieces(blob, PIECE).tobytes()
+            assert stored.serialize() == type(stored)(
+                d, len(blob), PIECE, want
+            ).serialize()
+            assert (
+                REGISTRY.counter("ingest_windows_total", "x").value(
+                    hasher="cpu"
+                )
+                > windows_before
+            )
+            assert "ingest_stage_seconds" in REGISTRY.render()
+            # The re-generate path rides the pipeline too.
+            node.store.delete_metadata(d, TorrentMetaMetadata)
+            regen = node.generator.generate_sync(d)
+            assert regen.serialize() == stored.serialize()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_pipelined_out_of_order_falls_back(tmp_path):
+    """Out-of-order PATCHes on a pipeline origin: the tracker
+    invalidates, the session aborts (leases back to the pool), and
+    commit falls back to the verifying re-read -- which regenerates the
+    same MetaInfo through the pipelined generate path."""
+
+    async def main():
+        import os
+
+        blob = os.urandom((1 << 20) + 3 * PIECE + 7)
+        d = Digest.from_bytes(blob)
+        node = _pipe_node(tmp_path)
+        await node.start()
+        try:
+            half = len(blob) // 2
+            status, _ = await _upload(
+                node.addr, d,
+                [blob[half:], blob[:half]],
+                offsets=[half, 0],  # second PATCH rewinds: invalidates
+            )
+            assert status == 201
+            stored = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            want = get_hasher("cpu").hash_pieces(blob, PIECE).tobytes()
+            assert stored.serialize() == type(stored)(
+                d, len(blob), PIECE, want
+            ).serialize()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_pipelined_tier_mismatch_falls_back(tmp_path):
+    """Pipeline origin whose final size lands in a bigger piece-length
+    tier than the stream-time bet: the streamed digests are at the wrong
+    piece length, the session must be dropped, and the re-generate pass
+    (pipelined, right tier) supplies the MetaInfo."""
+
+    async def main():
+        import os
+
+        table = PieceLengthConfig(table=((0, PIECE), (4 * PIECE, 2 * PIECE)))
+        blob = os.urandom(6 * PIECE)
+        d = Digest.from_bytes(blob)
+        node = _pipe_node(tmp_path, piece_lengths=table)
+        await node.start()
+        try:
+            status, _ = await _upload(node.addr, d, [blob])
+            assert status == 201
+            mi = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            assert mi.piece_length == 2 * PIECE
+            want = get_hasher("cpu").hash_pieces(blob, 2 * PIECE).tobytes()
+            assert mi.serialize() == type(mi)(
+                d, len(blob), 2 * PIECE, want
+            ).serialize()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_pipelined_sharded_hasher_stream(tmp_path):
+    """hasher=tpu-sharded + pipeline: stream-time piece hashing rides the
+    sharded device plane (the virtual 8-device CPU mesh here) window by
+    window; the MetaInfo must be bit-identical to the cpu oracle and the
+    sharded hasher's gauges must move."""
+
+    async def main():
+        import os
+
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        plen = 4096  # small pieces: short hash chains on the interpret mesh
+        table = PieceLengthConfig(table=((0, plen),))
+        blob = os.urandom((1 << 20) * 2 + 37 * plen + 123)
+        d = Digest.from_bytes(blob)
+        node = _pipe_node(tmp_path, hasher="tpu-sharded", piece_lengths=table)
+        sharded_before = REGISTRY.counter(
+            "hasher_bytes_total", "x"
+        ).value(hasher="tpu-sharded")
+        await node.start()
+        try:
+            status, _ = await _upload(node.addr, d, [blob])
+            assert status == 201
+            stored = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            want = get_hasher("cpu").hash_pieces(blob, plen).tobytes()
+            assert stored.serialize() == type(stored)(
+                d, len(blob), plen, want
+            ).serialize()
+            # The device plane did the stream-time piece pass.
+            assert (
+                REGISTRY.counter("hasher_bytes_total", "x").value(
+                    hasher="tpu-sharded"
+                )
+                > sharded_before
+            )
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_ingest_reload_applies_and_live_enables(tmp_path):
+    """SIGHUP semantics: knob changes live-apply to an existing
+    pipeline, and an origin started WITHOUT `ingest:` grows the plane on
+    reload (rollout step 1 of the OPERATIONS.md runbook)."""
+    node = _pipe_node(tmp_path)
+    assert node.ingest_pipeline.config.window_bytes == 1 << 20
+    node.reload({"ingest": {"window_bytes": 2 << 20, "windows_in_flight": 3}})
+    assert node.ingest_pipeline.config.window_bytes == 2 << 20
+    assert node.ingest_pipeline.config.windows_in_flight == 3
+
+    bare = _node(tmp_path / "bare")
+    assert bare.ingest_pipeline is None
+    bare.reload({"ingest": {"window_bytes": 4 << 20}})
+    assert bare.ingest_pipeline is not None
+    assert bare.generator.pipeline is bare.ingest_pipeline
+    assert bare.ingest_pipeline.config.window_bytes == 4 << 20

@@ -215,11 +215,14 @@ def test_agent_tpu_verifier_verifies_real_pull(tmp_path):
 
 
 def test_tpu_sharded_origin_serves_real_pull(tmp_path):
-    """``hasher: tpu-sharded`` assembled through the production CLI on
-    the real chip (a 1-device mesh: shard_map over the local device set,
-    however many that is). Upload -> sharded metainfo-gen -> GET
-    metainfo -> real agent pull, with the sharded plane's own gauges
-    moving on the origin."""
+    """``hasher: tpu-sharded`` THROUGH THE PIPELINED INGEST PLANE,
+    assembled via the production CLI on the real chip (a 1-device mesh:
+    shard_map over the local device set, however many that is). A real
+    upload streams its windows onto the chip at stream time
+    (core/ingest.py), the served metainfo's piece hashes are compared
+    bit-for-bit against an in-process CPU hashlib oracle, a real agent
+    pulls the blob, and the ingest plane's own gauges move on the
+    origin's /metrics."""
     procs = []
     try:
         oport = _free_port()
@@ -227,9 +230,19 @@ def test_tpu_sharded_origin_serves_real_pull(tmp_path):
             ["tracker", "--origins", f"127.0.0.1:{oport}"], tpu=False
         )
         procs.append(tracker)
+        # The `ingest:` section only ships via YAML -- exercise the same
+        # config path production uses.
+        cfg = tmp_path / "origin.yaml"
+        cfg.write_text(
+            "host: 127.0.0.1\n"
+            "ingest:\n"
+            "  window_bytes: 16777216\n"
+            "  windows_in_flight: 2\n"
+            "  pack_mode: host\n"
+        )
         origin, oinfo = _spawn(
             ["origin", "--store", str(tmp_path / "origin"),
-             "--port", str(oport),
+             "--port", str(oport), "--config", str(cfg),
              "--hasher", "tpu-sharded", "--tracker", tinfo["addr"]],
             tpu=True,
         )
@@ -243,6 +256,8 @@ def test_tpu_sharded_origin_serves_real_pull(tmp_path):
 
         async def drive():
             from kraken_tpu.core.digest import Digest
+            from kraken_tpu.core.hasher import get_hasher
+            from kraken_tpu.core.metainfo import MetaInfo
             from kraken_tpu.origin.client import BlobClient
             from kraken_tpu.utils.httputil import HTTPClient
 
@@ -251,6 +266,17 @@ def test_tpu_sharded_origin_serves_real_pull(tmp_path):
             oc = BlobClient(oinfo["addr"], HTTPClient(timeout_seconds=600))
             await oc.upload("ns", d, blob)
             http = HTTPClient(timeout_seconds=600)
+            # The metainfo the chip produced at stream time must be
+            # bit-identical to the CPU oracle -- the pipeline's whole
+            # correctness contract in one assert.
+            raw = await http.get(
+                f"http://{oinfo['addr']}/namespace/ns/blobs/{d.hex}/metainfo"
+            )
+            mi = MetaInfo.deserialize(raw)
+            want = get_hasher("cpu").hash_pieces(
+                blob, mi.piece_length
+            ).tobytes()
+            assert mi.piece_hashes == want, "sharded digests != CPU oracle"
             got = await http.get(
                 f"http://{ainfo['addr']}/namespace/ns/blobs/{d.hex}"
             )
@@ -265,6 +291,9 @@ def test_tpu_sharded_origin_serves_real_pull(tmp_path):
                 f"sharded hasher covered {hashed} bytes, expected >= "
                 f"{len(blob)}:\n{metrics[:2000]}"
             )
+            # The window stream (not the legacy batch path) did the work.
+            assert "ingest_windows_total" in metrics, metrics[:2000]
+            assert "ingest_stage_seconds" in metrics, metrics[:2000]
 
         asyncio.run(drive())
     finally:
