@@ -233,6 +233,12 @@ def _sync_ingest(node) -> None:
             node.server._stream_hash_pool = None
     else:
         node.ingest_pipeline.apply(node.ingest_config)
+    if node.server is not None:
+        # Robustness knobs ride the same SIGHUP: resume journaling and
+        # serve-while-ingest flip live (they gate per-request behavior,
+        # no rebuild needed).
+        node.server.resume_enabled = node.ingest_config.resume
+        node.server.serve_while_ingest = node.ingest_config.serve_while_ingest
 
 
 def _canary_config(canary) -> CanaryConfig:
@@ -847,6 +853,13 @@ class OriginNode:
                     else 6 * 3600
                 ),
                 expect_namespace=True,
+                # Journaled upload sessions are resumable crash state,
+                # not debris -- unless resume is configured off.
+                resume=(
+                    self.ingest_config.resume
+                    if self.ingest_config is not None
+                    else True
+                ),
             )
         # Fixed p2p port -> stable addr_hash identity across restarts (the
         # reference's default); ephemeral port -> random identity.
@@ -896,6 +909,16 @@ class OriginNode:
             rpc=self.rpc,
             delta=self.delta_config,
             ingest_pipeline=self.ingest_pipeline,
+            ingest_resume=(
+                self.ingest_config.resume
+                if self.ingest_config is not None
+                else True
+            ),
+            serve_while_ingest=(
+                self.ingest_config.serve_while_ingest
+                if self.ingest_config is not None
+                else False
+            ),
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -1365,11 +1388,16 @@ class AgentNode:
         chunkstore: dict | ChunkStoreConfig | None = None,
         slo: dict | SLOConfig | None = None,
         canary: dict | CanaryConfig | None = None,
+        ingest: dict | IngestConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
         self.p2p_port = p2p_port
         self.registry_port = registry_port
+        # Agents run no ingest pipeline; the YAML ``ingest:`` section here
+        # carries the ROBUSTNESS knobs only (resume gates whether fsck
+        # preserves journaled upload state on the shared store layer).
+        self.ingest_config = None if ingest is None else _ingest_config(ingest)
         # Manifest Accept negotiation: strict mode 406s clients pinned to
         # types we don't hold; default serves the stored bytes like the
         # reference (old docker clients regress under strict -- ADVICE r5).
@@ -1518,6 +1546,11 @@ class AgentNode:
                     else 6 * 3600
                 ),
                 expect_namespace=False,
+                resume=(
+                    self.ingest_config.resume
+                    if self.ingest_config is not None
+                    else True
+                ),
             )
         factory = PeerIDFactory(
             PeerIDFactory.ADDR_HASH if self.p2p_port else PeerIDFactory.RANDOM
@@ -1650,6 +1683,10 @@ class AgentNode:
         if cfg.get("slo") is not None:
             self.slo_config = _slo_config(cfg["slo"])
             _apply_slo("agent", self.slo_config)
+        if cfg.get("ingest") is not None:
+            # Robustness knobs only on agents (no pipeline): takes
+            # effect at the next fsck/sweep that consults it.
+            self.ingest_config = _ingest_config(cfg["ingest"])
         if cfg.get("canary") is not None:
             # Live enable/disable + knob swap: the prober loop re-reads
             # its config object every tick.

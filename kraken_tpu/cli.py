@@ -1126,6 +1126,10 @@ def main(argv: list[str] | None = None) -> None:
             # -- the synthetic prober that keeps the SLO plane fed at
             # zero user traffic. Shipped off (needs origins).
             canary=cfg.get("canary"),
+            # YAML: ingest: {resume} -- robustness knobs on agents (no
+            # pipeline runs here; resume gates whether fsck preserves
+            # journaled upload sessions on the shared store layer).
+            ingest=cfg.get("ingest"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

@@ -40,6 +40,7 @@ WHEN a piece is hashed, never piece boundaries.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -48,6 +49,9 @@ from typing import Optional
 import numpy as np
 
 from kraken_tpu.core.hasher import DIGEST_SIZE, HashPool, PieceHasher
+from kraken_tpu.utils import failpoints
+
+_log = logging.getLogger("kraken.ingest")
 
 STAGES = ("read", "pack", "transfer", "hash", "commit")
 
@@ -101,6 +105,19 @@ class IngestConfig:
     # single-chip device hasher; non-conforming windows fall back to
     # host-mode handling, bit-identically.
     pack_mode: str = "host"
+    # Resumable upload sessions: journal per-upload durable progress to a
+    # ``upload/<uid>.session`` sidecar so a crashed/drained origin
+    # re-adopts live sessions after restart and clients resume from the
+    # journaled offset instead of retrying from zero. Shipped ON (pure
+    # robustness; one tiny sidecar write per flush batch). On agents the
+    # same knob gates keeping resumable partial state across a restart.
+    resume: bool = True
+    # Publish metainfo and seed the blob from its upload spool as soon as
+    # every piece is hashed -- strictly BEFORE the commit rename -- so
+    # agents fan out behind the upload front. Shipped OFF (rollout
+    # runbook in docs/OPERATIONS.md "Resumable ingest &
+    # serve-while-ingest").
+    serve_while_ingest: bool = False
 
     def __post_init__(self):
         if self.window_bytes < 1 << 20:
@@ -238,6 +255,10 @@ class IngestSession:
         self._read_t0 = 0.0
         self._t0: Optional[float] = None
         self._done = False
+        # Sticky device->host degradation flag: set by the first window
+        # whose device path faults; later windows route straight to the
+        # host pass. Benign cross-thread bool.
+        self._fell_back = False
         self.stage_seconds: dict[str, float] = dict.fromkeys(
             ("read", "pack", "transfer", "hash"), 0.0
         )
@@ -251,6 +272,11 @@ class IngestSession:
         measured from here to :meth:`submit`."""
         if self._lease is not None:
             raise RuntimeError("previous window was never submitted")
+        if failpoints.fire("ingest.window.read"):
+            # Staging-read fault (torn spool, bad request body): fired
+            # BEFORE the semaphore/lease so nothing needs returning; the
+            # caller's abort() path is what the site exists to exercise.
+            raise failpoints.FailpointError("ingest.window.read")
         # Blocks while windows_in_flight windows are queued/running: the
         # NEXT read must not race ahead of the staging budget.
         self._sem.acquire()
@@ -311,7 +337,15 @@ class IngestSession:
 
     def abort(self) -> None:
         """Stop trusting this session: wait out in-flight windows (their
-        leases must return to the pool) and drop the results."""
+        leases must return to the pool) and drop the results. Every
+        staging lease provably returns: the un-submitted window's lease
+        is released here, submitted windows release theirs in
+        ``_process``'s finally -- joined below before the drop."""
+        hit = failpoints.fire("ingest.abort")
+        if hit and hit.delay_s:
+            # Chaos: stretch the abort window so teardown races (a PATCH
+            # failing while windows are still hashing) become reachable.
+            time.sleep(hit.delay_s)
         if self._lease is not None:
             self._lease.release()
             self._lease = None
@@ -323,6 +357,36 @@ class IngestSession:
                 pass
         self._futs = []
         self._done = True
+
+    def completed_digest_prefix(self) -> np.ndarray:
+        """Digests of the in-order prefix of windows already hashed --
+        non-blocking (stops at the first pending window). The resumable-
+        upload journal tick reads this on the PATCH flush thread, so it
+        must never wait on a device hash wall."""
+        out = []
+        for f in self._futs:
+            if not f.done() or f.exception() is not None:
+                break
+            out.append(f.result())
+        if not out:
+            return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        return np.concatenate(out) if len(out) > 1 else out[0]
+
+    def digest_prefix(self, n_pieces: int) -> np.ndarray:
+        """First ``n_pieces`` digests, blocking on the windows that hold
+        them (session-adoption replay verify). Window faults propagate --
+        the caller treats the session as unadoptable."""
+        out, got = [], 0
+        for f in self._futs:
+            if got >= n_pieces:
+                break
+            arr = f.result()
+            out.append(arr)
+            got += arr.shape[0]
+        if not out:
+            return np.empty((0, DIGEST_SIZE), dtype=np.uint8)
+        cat = np.concatenate(out) if len(out) > 1 else out[0]
+        return cat[:n_pieces]
 
     def overlap_ratio(self) -> float:
         """sum-of-stage-walls / session wall. 1.0 = fully serial; toward
@@ -341,36 +405,101 @@ class IngestSession:
         try:
             view = lease.view[:nbytes]
             plen = self.piece_length
-            m, ragged = divmod(nbytes, plen)
-            hasher = self.pipeline.hasher
-            uniform = m > 0 and ragged == 0
-            if uniform:
-                arr = np.frombuffer(view, dtype=np.uint8).reshape(m, plen)
-                if (
-                    self._cfg.pack_mode != "host"
-                    and m % 1024 == 0
-                    and plen % 64 == 0
-                    and hasher.name.startswith("tpu")
-                ):
-                    return self._packed_window(arr, plen)
-                if hasattr(hasher, "stage_window"):
-                    t0 = time.perf_counter()
-                    handle = hasher.stage_window(arr, plen)
-                    self._bill("transfer", time.perf_counter() - t0)
-                    t0 = time.perf_counter()
-                    out = hasher.hash_staged_window(handle)
-                    self._bill("hash", time.perf_counter() - t0)
-                    return out
-            # Fallback (CPU HashPool path, ragged final window, hashers
-            # without the staged protocol): one batch call, billed to
-            # hash. Bit-identical by definition -- same boundaries.
-            t0 = time.perf_counter()
-            out = hasher.hash_pieces(view, plen)
-            self._bill("hash", time.perf_counter() - t0)
-            return out
+            if self._fell_back:
+                # A previous window already tripped the device fallback:
+                # the rest of the stream stays on the host path (a chip
+                # that faulted once is not re-trusted mid-blob).
+                return self._host_window(view, plen)
+            try:
+                if failpoints.fire("origin.ingest.device_fail"):
+                    raise failpoints.FailpointError(
+                        "origin.ingest.device_fail"
+                    )
+                return self._hasher_window(view, plen)
+            except Exception as e:
+                # Live degradation: the device/TPU hash path died mid-
+                # stream. Fall back to the host hashlib pass for this
+                # window AND the stream remainder -- bit-identical by
+                # construction (same piece boundaries, same SHA-256).
+                self._fell_back = True
+                reason = (
+                    "failpoint"
+                    if isinstance(e, failpoints.FailpointError)
+                    else "device_error"
+                )
+                from kraken_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "ingest_fallbacks_total",
+                    "Ingest windows rerouted to the host hash path after"
+                    " a device-path fault (one increment per fallback"
+                    " event, not per rerouted window)",
+                ).inc(reason=reason)
+                _log.warning(
+                    "ingest window hash failed on %s (%s); host hash "
+                    "path takes the stream remainder",
+                    self.pipeline.hasher.name, e,
+                )
+                return self._host_window(view, plen)
         finally:
             lease.release()
             self._sem.release()
+
+    def _hasher_window(self, view, plen: int) -> np.ndarray:
+        """The configured hasher's path for one window (device packed,
+        device staged, or the hasher's own batch call)."""
+        nbytes = len(view)
+        m, ragged = divmod(nbytes, plen)
+        hasher = self.pipeline.hasher
+        uniform = m > 0 and ragged == 0
+        if uniform:
+            arr = np.frombuffer(view, dtype=np.uint8).reshape(m, plen)
+            if (
+                self._cfg.pack_mode != "host"
+                and m % 1024 == 0
+                and plen % 64 == 0
+                and hasher.name.startswith("tpu")
+            ):
+                return self._packed_window(arr, plen)
+            if hasattr(hasher, "stage_window"):
+                if failpoints.fire("ingest.window.transfer"):
+                    raise failpoints.FailpointError("ingest.window.transfer")
+                t0 = time.perf_counter()
+                handle = hasher.stage_window(arr, plen)
+                self._bill("transfer", time.perf_counter() - t0)
+                if failpoints.fire("ingest.window.hash"):
+                    raise failpoints.FailpointError("ingest.window.hash")
+                t0 = time.perf_counter()
+                out = hasher.hash_staged_window(handle)
+                self._bill("hash", time.perf_counter() - t0)
+                return out
+        # CPU HashPool path, ragged final window, hashers without the
+        # staged protocol: one batch call, billed to hash. Bit-identical
+        # by definition -- same boundaries.
+        if failpoints.fire("ingest.window.hash"):
+            raise failpoints.FailpointError("ingest.window.hash")
+        t0 = time.perf_counter()
+        out = hasher.hash_pieces(view, plen)
+        self._bill("hash", time.perf_counter() - t0)
+        return out
+
+    def _host_window(self, view, plen: int) -> np.ndarray:
+        """Inline hashlib piece pass -- the degradation target. No
+        device, no pool, no shared state: cannot fail the way the
+        primary path just did."""
+        import hashlib
+
+        nbytes = len(view)
+        n = max(1, -(-nbytes // plen)) if nbytes else 0
+        out = np.empty((n, DIGEST_SIZE), dtype=np.uint8)
+        t0 = time.perf_counter()
+        for i in range(n):
+            piece = view[i * plen:(i + 1) * plen]
+            out[i] = np.frombuffer(
+                hashlib.sha256(piece).digest(), dtype=np.uint8
+            )
+        self._bill("hash", time.perf_counter() - t0)
+        return out
 
     def _packed_window(self, arr: np.ndarray, plen: int) -> np.ndarray:
         """``pack: native|device`` window: explicit relayout + the
@@ -384,6 +513,8 @@ class IngestSession:
             sha256_packed_tiles,
         )
 
+        if failpoints.fire("ingest.window.pack"):
+            raise failpoints.FailpointError("ingest.window.pack")
         nb = packed_nb(plen // 64)
         if self._cfg.pack_mode == "native":
             from kraken_tpu import native

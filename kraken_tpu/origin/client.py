@@ -18,6 +18,7 @@ from kraken_tpu.placement.hashring import Ring
 from kraken_tpu.placement.replicawalk import _RAISE, walk_replicas
 from urllib.parse import quote
 
+from kraken_tpu.utils.backoff import DecorrelatedJitter
 from kraken_tpu.utils.deadline import Deadline
 from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
 
@@ -25,9 +26,23 @@ from kraken_tpu.utils.httputil import HTTPClient, HTTPError, base_url
 class BlobClient:
     """HTTP client for one origin."""
 
-    def __init__(self, addr: str, http: HTTPClient | None = None):
+    # Bounded resume: enough round-trips to ride out an origin restart
+    # (crash -> supervisor respawn -> fsck -> listen) without turning a
+    # permanently dead origin into an unbounded retry loop -- the
+    # ClusterClient's replica walk is the next line of defense.
+    RESUME_ATTEMPTS = 4
+
+    def __init__(
+        self, addr: str, http: HTTPClient | None = None, resume: bool = True
+    ):
         self.addr = addr
         self._http = http or HTTPClient()
+        # Resume-on-failure for chunked uploads: on a transport error,
+        # exhausted 5xx, or offset conflict, HEAD the upload URL for the
+        # origin's durable offset and re-PATCH only the tail. Off =
+        # legacy fail-fast (one shot per replica).
+        self.resume = resume
+        self._backoff = DecorrelatedJitter(0.2, 5.0)
 
     def _url(self, path: str) -> str:
         return f"{base_url(self.addr)}{path}"
@@ -130,57 +145,179 @@ class BlobClient:
         )
 
     async def upload(self, namespace: str, d: Digest, data: bytes,
-                     chunk_size: int = 16 * 1024 * 1024) -> None:
-        """Chunked upload: start -> PATCH chunks -> commit."""
-        uid = await self._start_upload(namespace, d)
-        for off in range(0, len(data), chunk_size) or [0]:
-            await self._patch_chunk(
-                namespace, d, uid, off, data[off : off + chunk_size]
-            )
-        await self._commit_upload(namespace, d, uid)
+                     chunk_size: int = 16 * 1024 * 1024,
+                     deadline: Deadline | None = None) -> None:
+        """Chunked upload: start -> PATCH chunks -> commit. With resume
+        on, a mid-stream failure re-queries the origin's durable offset
+        (HEAD) and re-PATCHes only the tail."""
+        import io
+
+        def open_at(offset: int):
+            f = io.BytesIO(data)
+            f.seek(offset)
+            return f
+
+        await self._upload_resumable(
+            namespace, d, open_at, chunk_size, deadline
+        )
 
     async def upload_from_file(
         self, namespace: str, d: Digest, path: str,
         chunk_size: int = 16 * 1024 * 1024,
+        deadline: Deadline | None = None,
     ) -> None:
         """Chunked upload streamed from a local file -- O(chunk) memory
         (replication and proxy pushes of arbitrarily large blobs)."""
-        uid = await self._start_upload(namespace, d)
-        off = 0
-        with await asyncio.to_thread(open, path, "rb") as f:
-            while True:
-                chunk = await asyncio.to_thread(f.read, chunk_size)
-                if not chunk and off > 0:
-                    break
-                await self._patch_chunk(namespace, d, uid, off, chunk)
-                off += len(chunk)
-                if not chunk:
-                    break  # zero-length blob: one empty PATCH
-        await self._commit_upload(namespace, d, uid)
+
+        def open_at(offset: int):
+            f = open(path, "rb")
+            try:
+                f.seek(offset)
+            except OSError:
+                f.close()
+                raise
+            return f
+
+        await self._upload_resumable(
+            namespace, d, open_at, chunk_size, deadline
+        )
 
     async def upload_from_store(
         self, namespace: str, d: Digest, store,
         chunk_size: int = 16 * 1024 * 1024,
+        deadline: Deadline | None = None,
     ) -> None:
         """Chunked upload streamed straight from a CAStore -- works for
         flat AND chunk-backed blobs (``open_cache_file`` composes the
         tier's reads), so replication of a manifest-backed blob never
         needs a flat copy on disk. O(chunk) memory either way."""
+
+        def open_at(offset: int):
+            f = store.open_cache_file(d)  # KeyError when absent
+            try:
+                f.seek(offset)
+            except OSError:
+                f.close()
+                raise
+            return f
+
+        await self._upload_resumable(
+            namespace, d, open_at, chunk_size, deadline
+        )
+
+    # -- resumable upload engine -------------------------------------------
+
+    async def _upload_resumable(
+        self, namespace: str, d: Digest, open_at, chunk_size: int,
+        deadline: Deadline | None = None,
+    ) -> None:
+        """Start -> stream -> commit with resume-on-failure.
+
+        ``open_at(offset)`` returns a (sync) reader positioned at
+        ``offset`` -- sources must be re-readable, which bytes, files,
+        and store blobs all are. Each recovery round HEADs the upload
+        URL for the origin's durable offset (the journaled session on a
+        restarted origin answers with what actually survived) and
+        re-sends from there under decorrelated-jitter backoff. A 404
+        from HEAD means the session is gone/unadoptable: ONE fresh
+        session restart, then give up (the cluster client's replica
+        fan-out is the next recourse)."""
         uid = await self._start_upload(namespace, d)
-        off = 0
-        f = store.open_cache_file(d)  # KeyError when absent
+        attempts = 0
+        restarted = False
+        prev_sleep = 0.0
+        offset = 0
+        while True:
+            try:
+                await self._stream_from(
+                    namespace, d, uid, open_at, offset, chunk_size
+                )
+                await self._commit_resumable(namespace, d, uid, attempts > 0)
+                return
+            except (HTTPError, OSError, asyncio.TimeoutError) as e:
+                if not self.resume:
+                    raise
+                if isinstance(e, HTTPError) and e.status not in (409,) and \
+                        e.status < 500:
+                    raise  # 4xx (bad digest, unknown upload): not transient
+                attempts += 1
+                if attempts > self.RESUME_ATTEMPTS:
+                    raise
+                if deadline is not None and deadline.expired:
+                    raise
+                prev_sleep = self._backoff.next(prev_sleep)
+                if deadline is not None:
+                    prev_sleep = min(prev_sleep, deadline.remaining())
+                await asyncio.sleep(prev_sleep)
+                try:
+                    offset = await self._session_offset(
+                        namespace, d, uid, deadline
+                    )
+                except HTTPError as he:
+                    if he.status != 404:
+                        continue  # transient HEAD failure: retry round
+                    # Session unadoptable or swept: one clean restart.
+                    if restarted:
+                        raise e
+                    restarted = True
+                    uid = await self._start_upload(namespace, d)
+                    offset = 0
+                except (OSError, asyncio.TimeoutError):
+                    continue  # origin still down: next backoff round
+
+    async def _stream_from(
+        self, namespace: str, d: Digest, uid: str, open_at, offset: int,
+        chunk_size: int,
+    ) -> None:
+        f = await asyncio.to_thread(open_at, offset)
         try:
             while True:
                 chunk = await asyncio.to_thread(f.read, chunk_size)
-                if not chunk and off > 0:
+                if not chunk and offset > 0:
                     break
-                await self._patch_chunk(namespace, d, uid, off, chunk)
-                off += len(chunk)
+                await self._patch_chunk(namespace, d, uid, offset, chunk)
+                offset += len(chunk)
                 if not chunk:
                     break  # zero-length blob: one empty PATCH
         finally:
-            f.close()
-        await self._commit_upload(namespace, d, uid)
+            await asyncio.to_thread(f.close)
+
+    async def _session_offset(
+        self, namespace: str, d: Digest, uid: str,
+        deadline: Deadline | None = None,
+    ) -> int:
+        """The origin's durable offset for this upload session
+        (X-Upload-Offset from HEAD on the upload URL). Raises HTTPError
+        404 when the session is gone or unadoptable."""
+        _status, headers, _body = await self._http.request_full(
+            "HEAD",
+            self._url(
+                f"/namespace/{quote(namespace, safe='')}/blobs/{d.hex}"
+                f"/uploads/{uid}"
+            ),
+            retry_5xx=False,
+            deadline=deadline,
+        )
+        try:
+            return int(headers.get("X-Upload-Offset", ""))
+        except ValueError:
+            raise HTTPError("HEAD", self._url("/uploads"), 502)
+
+    async def _commit_resumable(
+        self, namespace: str, d: Digest, uid: str, resumed: bool
+    ) -> None:
+        """Commit, idempotently under resume: when a RESUMED upload's
+        commit answers 404 (a previous commit attempt landed but its
+        response was lost -- the upload is gone because it succeeded),
+        confirm via stat before declaring success."""
+        try:
+            await self._commit_upload(namespace, d, uid)
+        except HTTPError as e:
+            if not (resumed and e.status == 404):
+                raise
+            info = await self.stat(namespace, d, local_only=True)
+            if info is None:
+                raise
 
     async def _start_upload(self, namespace: str, d: Digest) -> str:
         body = await self._http.post(

@@ -53,6 +53,11 @@ from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 _log = logging.getLogger("kraken.origin")
 
 
+class _SessionUnadoptable(Exception):
+    """A journaled upload session whose spool contradicts its journal:
+    the session is discarded and the client restarts the upload."""
+
+
 class _UploadDigest:
     """Running SHA-256 over an upload's bytes, valid only while every
     PATCH lands at the tracked offset with no concurrent writer.
@@ -83,7 +88,7 @@ class _UploadDigest:
         "_hash", "_pos", "_active", "_valid", "created", "hash_seconds",
         "_plen", "_piece", "_piece_len", "_piece_digests",
         "_pool", "_parts", "_futs", "_ses", "_win", "_win_pos",
-        "stage_walls",
+        "stage_walls", "namespace", "digest_hex",
     )
 
     def __init__(self, piece_length: int = 0, pool=None, pipeline=None):
@@ -119,6 +124,27 @@ class _UploadDigest:
         # piece_hashes on pipeline trackers; commit puts them on the
         # ingest trace span).
         self.stage_walls: dict | None = None
+        # Journal identity (resumable sessions): bound by the first PATCH
+        # that knows the route's namespace + claimed digest.
+        self.namespace = ""
+        self.digest_hex = ""
+
+    def bind(self, namespace: str, digest_hex: str) -> None:
+        if not self.digest_hex:
+            self.namespace = namespace
+            self.digest_hex = digest_hex
+
+    @property
+    def offset(self) -> int:
+        return self._pos
+
+    @property
+    def usable(self) -> bool:
+        return self._valid and not self._active
+
+    @property
+    def active(self) -> bool:
+        return self._active
 
     def begin_patch(self, offset: int) -> bool:
         """False = stop tracking this upload (commit will re-read)."""
@@ -168,9 +194,15 @@ class _UploadDigest:
         return h.digest()
 
     def write_and_update(self, f, chunk: bytes) -> None:
+        f.write(chunk)
+        self.absorb(chunk)
+
+    def absorb(self, chunk: bytes) -> None:
+        """Advance the hash state over ``chunk`` WITHOUT a spool write --
+        the shared half of write_and_update, also the session-adoption
+        replay (the bytes are already on disk; only the state is gone)."""
         import time
 
-        f.write(chunk)
         t0 = time.perf_counter()
         self._hash.update(chunk)
         self._pos += len(chunk)
@@ -230,6 +262,53 @@ class _UploadDigest:
             lag = len(self._futs) - 2 * self._pool.workers
             if lag > 0:
                 self._futs[lag - 1].result()
+
+    def completed_piece_prefix(self) -> bytes:
+        """Concatenated digests of the in-order prefix of pieces already
+        hashed -- NON-blocking (done futures only), journal-tick safe.
+        Bytes behind :attr:`offset` but past the prefix are re-verified
+        by the adoption replay, so a short prefix only weakens the early
+        consistency check, never correctness."""
+        if not self._plen:
+            return b""
+        if self._ses is not None:
+            return self._ses.completed_digest_prefix().tobytes()
+        if self._pool is not None:
+            out = []
+            for fut in self._futs:
+                if not fut.done() or fut.exception() is not None:
+                    break
+                out.append(fut.result())
+            return b"".join(out)
+        return b"".join(self._piece_digests)
+
+    def digest_prefix(self, n_pieces: int) -> bytes:
+        """First ``n_pieces`` piece digests, BLOCKING on their windows --
+        the adoption replay's consistency check against the journal."""
+        if n_pieces <= 0 or not self._plen:
+            return b""
+        if self._ses is not None:
+            return self._ses.digest_prefix(n_pieces).tobytes()
+        if self._pool is not None:
+            return b"".join(
+                fut.result() for fut in self._futs[:n_pieces]
+            )
+        return b"".join(self._piece_digests[:n_pieces])
+
+    def journal_doc(self) -> dict | None:
+        """The resumable-session journal for the CURRENT durable state,
+        or None when this tracker can't vouch for the spool (invalidated,
+        or never bound to a digest)."""
+        if not self._valid or not self.digest_hex:
+            return None
+        return {
+            "version": 1,
+            "digest": self.digest_hex,
+            "namespace": self.namespace,
+            "offset": self._pos,
+            "piece_length": self._plen,
+            "piece_hashes": self.completed_piece_prefix().hex(),
+        }
 
     def result(self, upload_size: int) -> Digest | None:
         """The digest, or None when tracking was invalidated or the bytes
@@ -332,6 +411,8 @@ class OriginServer(LameduckMixin):
         rpc=None,  # utils.deadline.RPCConfig (optional)
         delta=None,  # p2p.delta.DeltaConfig (optional; gates /recipe)
         ingest_pipeline=None,  # core.ingest.IngestPipeline (optional)
+        ingest_resume: bool = True,  # journal + re-adopt upload sessions
+        serve_while_ingest: bool = False,  # seed from the spool pre-commit
     ):
         self.store = store
         self.generator = generator
@@ -363,6 +444,12 @@ class OriginServer(LameduckMixin):
         self._dedup_tasks: set[asyncio.Task] = set()
         self._heal_cluster = None  # lazy ClusterClient (heal plane)
         self._upload_digests: dict[str, _UploadDigest] = {}
+        # Resumable sessions (ingest.resume) + spool seeding
+        # (ingest.serve_while_ingest) -- YAML knobs, SIGHUP live-reloaded
+        # by assembly._sync_ingest.
+        self.resume_enabled = ingest_resume
+        self.serve_while_ingest = serve_while_ingest
+        self._purge_task: asyncio.Task | None = None
         # Optimistic stream-time piece length: the piece-length config is
         # keyed on FINAL blob size (unknown mid-stream), so stream piece-
         # hashing bets on the smallest tier and falls back to the post-
@@ -443,6 +530,10 @@ class OriginServer(LameduckMixin):
         app = web.Application(client_max_size=1 << 30)
         r = app.router
         r.add_post("/namespace/{ns}/blobs/{d}/uploads", self._start_upload)
+        r.add_route(
+            "HEAD", "/namespace/{ns}/blobs/{d}/uploads/{uid}",
+            self._upload_offset,
+        )
         r.add_patch("/namespace/{ns}/blobs/{d}/uploads/{uid}", self._patch_upload)
         r.add_put("/namespace/{ns}/blobs/{d}/uploads/{uid}/commit", self._commit)
         r.add_post("/namespace/{ns}/blobs/{d}/adopt", self._adopt)
@@ -456,7 +547,63 @@ class OriginServer(LameduckMixin):
         r.add_get("/health", self._health)
         self.add_lameduck_routes(r)
         self.bind_app(app)
+        app.cleanup_ctx.append(self._upload_digest_purge_ctx)
         return app
+
+    async def _upload_digest_purge_ctx(self, app):
+        """App-lifetime timer purging TTL-expired upload trackers. The
+        old sweep only ran when the dict crossed 1024 entries at
+        _start_upload time -- an idle origin kept dead trackers (and
+        their pinned chunk views / pipeline sessions) for ever."""
+        self._purge_task = asyncio.create_task(
+            self._purge_upload_digests_loop()
+        )
+        yield
+        self._purge_task.cancel()
+        import contextlib
+
+        with contextlib.suppress(asyncio.CancelledError):
+            await self._purge_task
+        self._purge_task = None
+
+    async def _purge_upload_digests_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.UPLOAD_DIGEST_PURGE_SECONDS)
+            self.purge_upload_digests()
+
+    def purge_upload_digests(self) -> None:
+        """One TTL tick over the tracker dict (timer-driven; also
+        callable from tests). Active trackers (a PATCH body streaming
+        right now) are never dropped mid-write."""
+        import time
+
+        cutoff = time.monotonic() - self.UPLOAD_DIGEST_TTL_SECONDS
+        for uid in [
+            uid for uid, t in self._upload_digests.items()
+            if t.created < cutoff and not t.active
+        ]:
+            self._drop_upload_digest(uid, reason="ttl")
+
+    def _drop_upload_digest(self, uid: str, reason: str) -> None:
+        tracker = self._upload_digests.pop(uid, None)
+        if tracker is None:
+            return
+        if tracker.usable:
+            # A still-valid tracker is losing its fast path: its commit
+            # (if it ever arrives) falls back to the verifying re-read.
+            _log.warning(
+                "upload digest tracker evicted while still usable "
+                "(reason=%s uid=%s): commit will re-read", reason, uid,
+            )
+        # Release pipeline staging leases / pinned chunk views NOW --
+        # an evicted tracker nobody commits would otherwise hold them
+        # until process exit.
+        tracker.invalidate()
+        REGISTRY.counter(
+            "upload_digests_evicted_total",
+            "Upload digest trackers dropped before commit (ttl = aged"
+            " out; capacity = cap reached, oldest evicted)",
+        ).inc(reason=reason)
 
     def _digest(self, req: web.Request) -> Digest:
         try:
@@ -502,20 +649,22 @@ class OriginServer(LameduckMixin):
         # re-hashing the entire blob. Out-of-order or concurrent PATCHes
         # just invalidate the tracker and commit falls back to the
         # re-read. Entries are removed at commit; ABANDONED uploads
-        # (client crashed before committing) age out here, so they can't
-        # permanently eat the cap and silently disable the fast path for
-        # every future upload. Falling back is always correct.
-        import time
-
-        now = time.monotonic()
-        if len(self._upload_digests) >= 1024:
-            cutoff = now - self.UPLOAD_DIGEST_TTL_SECONDS
-            for k in [
-                k for k, v in self._upload_digests.items()
-                if v.created < cutoff
-            ]:
-                del self._upload_digests[k]
-        if len(self._upload_digests) < 4096:
+        # (client crashed before committing) age out on the purge timer
+        # (_purge_upload_digests_loop), so they can't permanently eat the
+        # cap and silently disable the fast path for every future upload.
+        # At the hard cap the OLDEST idle tracker is evicted (metered,
+        # never a silent drop). Falling back is always correct.
+        if len(self._upload_digests) >= self.UPLOAD_DIGEST_CAP:
+            victims = sorted(
+                (
+                    (t.created, k)
+                    for k, t in self._upload_digests.items()
+                    if not t.active
+                ),
+            )
+            if victims:
+                self._drop_upload_digest(victims[0][1], reason="capacity")
+        if len(self._upload_digests) < self.UPLOAD_DIGEST_CAP:
             self._upload_digests[uid] = _UploadDigest(
                 piece_length=self._stream_piece_length,
                 pool=self._stream_hash_pool,
@@ -524,6 +673,8 @@ class OriginServer(LameduckMixin):
         return web.Response(text=uid)
 
     UPLOAD_DIGEST_TTL_SECONDS = 6 * 3600.0  # matches upload-spool lifetime
+    UPLOAD_DIGEST_PURGE_SECONDS = 300.0  # timer tick for the TTL sweep
+    UPLOAD_DIGEST_CAP = 4096  # hard bound on tracked sessions
 
     async def _patch_upload(self, req: web.Request) -> web.Response:
         uid = req.match_info["uid"]
@@ -531,6 +682,30 @@ class OriginServer(LameduckMixin):
             offset = int(req.headers.get("X-Upload-Offset", "0"))
         except ValueError:
             raise web.HTTPBadRequest(text="malformed X-Upload-Offset")
+        # A PATCH past the durable spool size of a JOURNALED session
+        # would seek past EOF and leave a HOLE under the client's bytes
+        # -- exactly what a blind transport retry does after an origin
+        # crash lost the tail (the transport retried, the client's
+        # offset didn't). 409 sends the client to HEAD for the durable
+        # offset and re-send from there. Only journaled sessions get the
+        # guard: a journal exists only for sequential tracked streams,
+        # so legacy out-of-order clients (first PATCH at a late offset,
+        # tracker invalidated, commit re-reads) are untouched. Rewrites
+        # at or below the size stay allowed (duplicate retry of a PATCH
+        # whose response was lost: same bytes, commit re-reads).
+        if offset > 0 and self.resume_enabled:
+            doc = await asyncio.to_thread(self.store.read_upload_session, uid)
+            if doc is not None:
+                try:
+                    size = await asyncio.to_thread(
+                        self.store.upload_size, uid
+                    )
+                except UploadNotFoundError:
+                    raise web.HTTPNotFound(text="unknown upload")
+                if offset > size:
+                    raise web.HTTPConflict(
+                        text=f"offset {offset} past durable size {size}"
+                    )
         # Stream the request body straight into the upload file (one held
         # handle): one PATCH may carry an arbitrarily large body without
         # O(body) RAM or per-chunk reopen syscalls.
@@ -541,6 +716,15 @@ class OriginServer(LameduckMixin):
         tracker = self._upload_digests.get(uid)
         if tracker is not None and not tracker.begin_patch(offset):
             tracker = None
+        if tracker is not None:
+            # Journal identity: the route carries the namespace and the
+            # claimed digest; the session journal needs both so a
+            # restarted origin can guard the blob (scrub/fsck) and the
+            # client can HEAD this URL for the durable offset.
+            tracker.bind(
+                urllib.parse.unquote(req.match_info["ns"]),
+                self._digest(req).hex,
+            )
         self._inflight_writes += 1  # drain waits for streaming bodies
         try:
             f.seek(offset)
@@ -565,6 +749,12 @@ class OriginServer(LameduckMixin):
                         tracker.write_and_update(f, b)
                     else:
                         f.write(b)
+                if tracker is not None and self.resume_enabled:
+                    # Durable-progress journal, once per flush batch: the
+                    # bytes just written are pushed out of the userspace
+                    # buffer FIRST, so the journaled offset never claims
+                    # bytes a process crash could lose.
+                    self._journal_upload(uid, tracker, f)
 
             async for chunk in req.content.iter_chunked(1 << 20):
                 pending.append(chunk)
@@ -602,6 +792,152 @@ class OriginServer(LameduckMixin):
                     tracker.invalidate()
                 raise
         return web.Response(status=204)
+
+    # -- resumable sessions ------------------------------------------------
+
+    def _journal_upload(self, uid: str, tracker: _UploadDigest, f) -> None:
+        """Persist the session journal (flush thread, off-loop). Best
+        effort: a failed journal write only costs resumability, never
+        the upload itself."""
+        import os
+
+        doc = tracker.journal_doc()
+        if doc is None:
+            return
+        try:
+            f.flush()
+            if self.store.durability == "fsync":
+                os.fsync(f.fileno())
+            self.store.write_upload_session(uid, doc)
+        except OSError as e:
+            _log.warning(
+                "upload session journal write failed (upload stays "
+                "un-resumable): uid=%s: %s", uid, e,
+            )
+
+    async def _upload_offset(self, req: web.Request) -> web.Response:
+        """HEAD on the upload URL: the durable offset a resuming client
+        re-PATCHes from (X-Upload-Offset). Re-adopts the session from
+        its journal when the in-memory tracker is gone (origin restart)
+        or invalidated (failed PATCH mid-stream) -- the SAME path either
+        way, so crash recovery and mid-stream resume can't diverge. 404
+        means the session is unadoptable: restart the upload (possibly
+        on another replica)."""
+        uid = req.match_info["uid"]
+        tracker = self._upload_digests.get(uid)
+        if tracker is not None and tracker.active:
+            raise web.HTTPConflict(text="a PATCH is in flight")
+        if tracker is not None and tracker.usable:
+            return web.Response(
+                status=200, headers={"X-Upload-Offset": str(tracker.offset)}
+            )
+        if tracker is not None:
+            # Invalidated mid-stream: the journal (durable state) is the
+            # truth now; drop the dead tracker and rebuild from disk.
+            self._upload_digests.pop(uid, None)
+        offset: int | None = None
+        if self.resume_enabled:
+            try:
+                adopted = await asyncio.to_thread(
+                    self._adopt_session_sync, uid
+                )
+            except _SessionUnadoptable as e:
+                REGISTRY.counter(
+                    "upload_sessions_unadoptable_total",
+                    "Journaled upload sessions refused at adoption"
+                    " (spool/journal inconsistent): client restarts",
+                ).inc()
+                _log.warning("upload session unadoptable: uid=%s: %s", uid, e)
+                await asyncio.to_thread(self.store.abort_upload, uid)
+                raise web.HTTPNotFound(text="session unadoptable")
+            if adopted is not None:
+                self._upload_digests[uid] = adopted
+                offset = adopted.offset
+                REGISTRY.counter(
+                    "upload_sessions_adopted_total",
+                    "Journaled upload sessions re-adopted after an origin"
+                    " restart or mid-stream tracker invalidation",
+                ).inc()
+        if offset is None:
+            # No journal (resume off, journal torn, or never tracked):
+            # the spool size is still a correct resume point -- commit
+            # falls back to the verifying re-read.
+            try:
+                offset = await asyncio.to_thread(self.store.upload_size, uid)
+            except UploadNotFoundError:
+                raise web.HTTPNotFound(text="unknown upload")
+        return web.Response(
+            status=200, headers={"X-Upload-Offset": str(offset)}
+        )
+
+    def _adopt_session_sync(self, uid: str) -> _UploadDigest | None:
+        """Rebuild an upload tracker from its journal + spool (off-loop).
+
+        Returns None when there is nothing to adopt (no/torn journal --
+        the caller degrades to size-based resume). Raises
+        :class:`_SessionUnadoptable` when the spool contradicts the
+        journal -- the spool is then suspect and the whole session is
+        discarded. The replay re-hashes the durable prefix on the host,
+        so a resumed stream is bit-identical to an uninterrupted one by
+        construction; the journaled piece-hash prefix is checked against
+        the replay as an early torn-spool detector."""
+        doc = self.store.read_upload_session(uid)
+        if doc is None:
+            return None
+        if failpoints.fire("origin.upload.resume"):
+            raise _SessionUnadoptable("failpoint origin.upload.resume")
+        try:
+            offset = int(doc["offset"])
+            plen = int(doc["piece_length"])
+            prefix = bytes.fromhex(doc.get("piece_hashes", ""))
+            namespace = str(doc.get("namespace", ""))
+            digest_hex = str(doc.get("digest", ""))
+        except (KeyError, TypeError, ValueError):
+            return None  # torn journal: size-based resume still works
+        if offset < 0 or plen < 0:
+            return None
+        try:
+            size = self.store.upload_size(uid)
+        except UploadNotFoundError:
+            # Orphan journal (spool gone): clean it up; nothing to adopt.
+            self.store.delete_upload_session(uid)
+            return None
+        if size < offset:
+            raise _SessionUnadoptable(
+                f"spool holds {size} bytes, journal claims {offset}"
+            )
+        if size > offset:
+            # Bytes past the journaled offset were written but never
+            # journaled: their hash state is unknown -- drop them; the
+            # client re-sends from the durable offset.
+            self.store.truncate_upload(uid, offset)
+        tracker = _UploadDigest(
+            piece_length=plen if self._stream_piece_length else 0,
+            pool=self._stream_hash_pool,
+            pipeline=self._ingest_pipeline,
+        )
+        tracker.bind(namespace, digest_hex)
+        try:
+            with open(self.store.upload_path(uid), "rb") as fh:
+                while True:
+                    chunk = fh.read(1 << 20)
+                    if not chunk:
+                        break
+                    tracker.absorb(chunk)
+            if tracker.offset != offset:
+                raise _SessionUnadoptable(
+                    f"replayed {tracker.offset} bytes, journal claims "
+                    f"{offset}"
+                )
+            if prefix and tracker.digest_prefix(len(prefix) // 32) != prefix:
+                raise _SessionUnadoptable("piece-hash prefix mismatch")
+        except _SessionUnadoptable:
+            tracker.invalidate()
+            raise
+        except Exception as e:
+            tracker.invalidate()
+            raise _SessionUnadoptable(f"replay failed: {e}")
+        return tracker
 
     async def _commit(self, req: web.Request) -> web.Response:
         from kraken_tpu.utils.slo import CANARY_NAMESPACE, SLO
@@ -669,17 +1005,58 @@ class OriginServer(LameduckMixin):
                         tracker.piece_hashes,
                         size, self.generator.piece_lengths.piece_length(size),
                     )
+            early_metainfo = None
+            if (
+                self.serve_while_ingest
+                and piece_hashes is not None
+                and self.scheduler is not None
+                and size > 0
+            ):
+                # Every byte is already in the upload spool (commit below
+                # is only the verify + rename) and every piece hash is
+                # known, so the metainfo is final: publish it NOW and seed
+                # from the spool. Agents pulling this blob get pieces
+                # before the commit finishes; promote_partial() below
+                # repoints the torrent at the cache path once it does.
+                try:
+                    early_metainfo = await asyncio.to_thread(
+                        self.generator.adopt, d, size,
+                        self.generator.piece_lengths.piece_length(size),
+                        piece_hashes,
+                    )
+                    self.scheduler.seed_partial(
+                        early_metainfo, ns, self.store.upload_path(uid)
+                    )
+                except Exception:
+                    # Early publish is an optimization; the commit path
+                    # below publishes authoritatively either way.
+                    _log.warning(
+                        "serve-while-ingest early publish failed; blob "
+                        "serves after commit", exc_info=True,
+                    )
+                    early_metainfo = None
+            hit = failpoints.fire("origin.commit.slow")
+            if hit is not None and hit.delay_s:
+                await asyncio.sleep(hit.delay_s)
             t_commit = time.perf_counter()
             try:
                 await asyncio.to_thread(
                     self.store.commit_upload, uid, d, precomputed=precomputed
                 )
             except UploadNotFoundError:
+                await self._retract_early_publish(d, early_metainfo)
                 raise web.HTTPNotFound(text="unknown upload")
             except DigestMismatchError as e:
+                await self._retract_early_publish(d, early_metainfo)
                 raise web.HTTPBadRequest(text=str(e))
             except FileExistsInCacheError:
+                if early_metainfo is not None and self.scheduler is not None:
+                    # The bytes ARE committed (by a racing uploader): the
+                    # early torrent stays valid at the cache path.
+                    self.scheduler.promote_partial(d, self.store.cache_path(d))
                 return web.Response(status=409, text="already cached")
+            if early_metainfo is not None and self.scheduler is not None:
+                self.scheduler.promote_partial(d, self.store.cache_path(d))
             from kraken_tpu.core.ingest import record_stage
 
             commit_s = time.perf_counter() - t_commit
@@ -690,7 +1067,7 @@ class OriginServer(LameduckMixin):
                     f"ingest_{k}": round(v, 6) if isinstance(v, float) else v
                     for k, v in tracker.stage_walls.items()
                 })
-            metainfo = None
+            metainfo = early_metainfo
             if piece_hashes is not None:
                 if tracker.stage_walls is None:
                     # Stream-time piece hashes cover the final size at the
@@ -706,13 +1083,32 @@ class OriginServer(LameduckMixin):
                         "cpu", size, len(piece_hashes) // 32,
                         tracker.hash_seconds,
                     )
-                metainfo = await asyncio.to_thread(
-                    self.generator.adopt, d, size,
-                    self.generator.piece_lengths.piece_length(size),
-                    piece_hashes,
-                )
+                if metainfo is None:  # early publish already adopted
+                    metainfo = await asyncio.to_thread(
+                        self.generator.adopt, d, size,
+                        self.generator.piece_lengths.piece_length(size),
+                        piece_hashes,
+                    )
             await self._post_commit(ns, d, metainfo=metainfo)
         return web.Response(status=201)
+
+    async def _retract_early_publish(self, d: Digest, early_metainfo) -> None:
+        """Commit failed after a serve-while-ingest early publish: stop
+        advertising bytes that will never commit, and drop the published
+        metainfo sidecar so `/metainfo` can't hand out a torrent whose
+        blob is gone."""
+        if early_metainfo is None:
+            return
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        if self.scheduler is not None:
+            self.scheduler.unseed(d)
+        try:
+            await asyncio.to_thread(
+                self.store.delete_metadata, d, TorrentMetaMetadata
+            )
+        except OSError as e:
+            _log.warning("early-publish metainfo retract failed: %s", e)
 
     async def _post_commit(self, ns: str, d: Digest, metainfo=None) -> None:
         # Remember the namespace beside the blob: the repair path
@@ -1172,12 +1568,27 @@ class OriginServer(LameduckMixin):
         await self._brownout_gate()
         ns = urllib.parse.unquote(req.match_info["ns"])
         d = self._digest(req)
-        await self._ensure_local(ns, d)
+        # Cached sidecar FIRST, before any in-cache check: during a
+        # serve-while-ingest window the metainfo is published (and the
+        # torrent seeding from the spool) while the blob is NOT yet in
+        # the cache -- agents must be able to start their pull now.
+        metainfo = await asyncio.to_thread(self.generator.get_cached, d)
+        if metainfo is not None and self.scheduler is not None:
+            try:
+                # Metainfo fetch precedes a swarm download: make sure we
+                # seed (no-op when the spool-backed torrent is live).
+                self.scheduler.seed(metainfo, ns)
+            except KeyError:
+                # Sidecar without bytes or a live torrent (early-publish
+                # orphan after a crash): treat as a miss; _ensure_local
+                # restores or 404s.
+                metainfo = None
+        if metainfo is None:
+            await self._ensure_local(ns, d)
+            metainfo = await self.generator.generate(d)
+            if self.scheduler is not None:
+                self.scheduler.seed(metainfo, ns)
         self._touch(d)  # metainfo fetch = imminent swarm read
-        metainfo = await self.generator.generate(d)
-        if self.scheduler is not None:
-            # Metainfo fetch precedes a swarm download: make sure we seed.
-            self.scheduler.seed(metainfo, ns)
         return web.Response(body=metainfo.serialize())
 
     async def _similar(self, req: web.Request) -> web.Response:

@@ -476,6 +476,28 @@ class Scheduler:
         download agents keep seeding automatically)."""
         self._get_or_create_control(metainfo, namespace)
 
+    def seed_partial(self, metainfo: MetaInfo, namespace: str, path: str) -> None:
+        """Seed a blob whose bytes are all on disk but NOT yet committed
+        (serve-while-ingest): the torrent reads straight from the upload
+        spool at ``path``. Pulls of a still-ingesting blob start now;
+        :meth:`promote_partial` repoints at the cache path post-commit,
+        :meth:`unseed` tears down if the commit fails."""
+        torrent = Torrent(
+            self.archive.store, metainfo, self.archive.verifier,
+            complete=True, path=path,
+        )
+        self._get_or_create_control(metainfo, namespace, torrent=torrent)
+
+    def promote_partial(self, d: Digest, path: str) -> None:
+        """Commit landed: repoint blob ``d``'s spool-backed torrent at its
+        committed cache path. No-op when no such torrent is live."""
+        h = self._digest_to_hash.get(d)
+        if h is None:
+            return
+        ctl = self._controls.get(h)
+        if ctl is not None and getattr(ctl.torrent, "spool_backed", False):
+            ctl.torrent.promote(path)
+
     def unseed(self, d: Digest) -> bool:
         """Stop seeding blob ``d`` (DELETE / cache eviction): the torrent
         control, its announces, and its conns go away -- a seeder must not
@@ -504,7 +526,7 @@ class Scheduler:
     # -- torrent control ---------------------------------------------------
 
     def _get_or_create_control(
-        self, metainfo: MetaInfo, namespace: str
+        self, metainfo: MetaInfo, namespace: str, torrent=None
     ) -> _TorrentControl:
         h = metainfo.info_hash
         ctl = self._controls.get(h)
@@ -514,7 +536,8 @@ class Scheduler:
             # stop() already swept the controls; creating one now would
             # leak its retry loop (and re-announce a dead node).
             raise RuntimeError("scheduler is stopped")
-        torrent = self.archive.create_torrent(metainfo)
+        if torrent is None:
+            torrent = self.archive.create_torrent(metainfo)
         dispatcher = Dispatcher(
             torrent,
             requests=RequestManager(
@@ -773,6 +796,11 @@ class Scheduler:
         if pool is None or not pool.can_accept:
             return False
         if not ctl.torrent.complete() or self.bandwidth is not None:
+            return False
+        if getattr(ctl.torrent, "spool_backed", False):
+            # Serve-while-ingest: the backing file is the upload spool; a
+            # failed commit unlinks it, which must also close the serving
+            # fd -- keep the conn on the main loop until promoted.
             return False
         if not os.path.exists(ctl.torrent.blob_path):
             # Chunk-backed blob (store/chunkstore.py): there is no flat

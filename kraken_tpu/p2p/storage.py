@@ -180,12 +180,24 @@ class Torrent:
         metainfo: MetaInfo,
         verifier: BatchedVerifier,
         complete: bool = False,
+        path: Optional[str] = None,
     ):
         self.store = store
         self.metainfo = metainfo
         self._verifier = verifier
+        # Serve-while-ingest: a complete torrent whose bytes still live at
+        # the upload spool path (every byte is on disk; commit is just the
+        # rename). promote() repoints it at the cache path post-commit --
+        # an fd opened on the spool keeps working because rename preserves
+        # the inode. While spool_backed, shard handoff is skipped: the
+        # worker's long-lived fd would outlive a commit failure's unlink.
+        self.spool_backed = False
         if complete:
-            self._path = store.cache_path(metainfo.digest)
+            if path is not None:
+                self._path = path
+                self.spool_backed = True
+            else:
+                self._path = store.cache_path(metainfo.digest)
             self._status = None  # complete: no bitfield needed
         else:
             # Incomplete data lives at the partial path until the last
@@ -383,6 +395,14 @@ class Torrent:
             if self._fd_refs == 0 and self._fd is not None:
                 self._fd.close()
                 self._fd = None
+
+    def promote(self, path: str) -> None:
+        """Repoint a spool-backed torrent at its committed path (commit
+        renamed the spool into the cache, same inode). New opens hit the
+        cache path; an fd already open on the old name is unaffected."""
+        with self._fd_lock:
+            self._path = path
+            self.spool_backed = False
 
     def read_piece(self, i: int) -> bytes:
         if not self.has_piece(i):

@@ -189,6 +189,7 @@ class CAStore:
         """
         src = self._upload_path(uid)
         if not os.path.exists(src):
+            self.delete_upload_session(uid)
             raise UploadNotFoundError(uid)
         if verify:
             if precomputed is not None:
@@ -198,6 +199,7 @@ class CAStore:
                     actual = Digest.from_reader(f)
             if actual != d:
                 os.unlink(src)
+                self.delete_upload_session(uid)
                 raise DigestMismatchError(f"expected {d}, got {actual}")
         dst = self.cache_path(d)
         with self._lock:
@@ -206,13 +208,100 @@ class CAStore:
             # exists to repair.
             if os.path.exists(dst) or self.is_chunked(d):
                 os.unlink(src)
+                self.delete_upload_session(uid)
                 raise FileExistsInCacheError(str(d))
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             self._commit_file(src, dst)
+        # Journal last: a crash between rename and this unlink leaves an
+        # orphan journal (spool gone), which fsck/cleanup sweep as such.
+        self.delete_upload_session(uid)
 
     def abort_upload(self, uid: str) -> None:
         with contextlib.suppress(FileNotFoundError):
             os.unlink(self._upload_path(uid))
+        self.delete_upload_session(uid)
+
+    # -- resumable-upload session journals ---------------------------------
+    #
+    # ``upload/<uid>.session`` is a tiny JSON sidecar the origin writes at
+    # every durable flush of a chunked upload: the byte offset the spool
+    # provably holds, the optimistic stream piece length, and the hex
+    # prefix of piece digests already hashed behind that offset. After a
+    # crash (or a mid-stream tracker invalidation) the origin re-adopts
+    # the session from this journal instead of forcing a from-zero
+    # retry -- see origin/server.py ``_adopt_session_sync`` and the
+    # OPERATIONS.md "Resumable ingest & serve-while-ingest" runbook.
+
+    SESSION_SUFFIX = ".session"
+
+    def upload_session_path(self, uid: str) -> str:
+        return self._upload_path(uid) + self.SESSION_SUFFIX
+
+    def write_upload_session(self, uid: str, doc: dict) -> None:
+        """Atomically persist the resumable-upload journal for ``uid``.
+
+        Plain tmp+rename (durability-aware), deliberately NOT through
+        ``_commit_file``: the ``castore.commit`` failpoint models blob
+        commits, and arming it must not also tear journal writes."""
+        import json
+
+        path = self.upload_session_path(uid)
+        tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(doc).encode())
+            if self.durability == "fsync":
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read_upload_session(self, uid: str) -> Optional[dict]:
+        """The journal doc, or None when absent or torn (a torn journal
+        means the session is unadoptable, never an error)."""
+        import json
+
+        try:
+            with open(self.upload_session_path(uid), "rb") as f:
+                doc = json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def delete_upload_session(self, uid: str) -> None:
+        with contextlib.suppress(OSError):
+            os.unlink(self.upload_session_path(uid))
+
+    def list_upload_sessions(self) -> list[str]:
+        """uids that have a session journal (spool may or may not exist)."""
+        try:
+            names = os.listdir(self.upload_dir)
+        except FileNotFoundError:
+            return []
+        n = len(self.SESSION_SUFFIX)
+        return sorted(
+            name[:-n] for name in names
+            if name.endswith(self.SESSION_SUFFIX) and ".tmp" not in name
+        )
+
+    def live_upload_digests(self) -> set[str]:
+        """Digest hexes with a live journaled upload session -- the
+        still-arriving-tail guard consulted by scrub and fsck so an
+        in-flight blob (or its early-published metainfo sidecar) is
+        never quarantined or swept mid-ingest."""
+        out: set[str] = set()
+        for uid in self.list_upload_sessions():
+            doc = self.read_upload_session(uid)
+            if doc and isinstance(doc.get("digest"), str):
+                out.add(doc["digest"])
+        return out
+
+    def truncate_upload(self, uid: str, size: int) -> None:
+        """Cut the spool back to ``size`` bytes (session adoption drops
+        bytes beyond the journaled durable offset -- they were written
+        but never journaled, so their hash state is unknown)."""
+        path = self._upload_path(uid)
+        if not os.path.exists(path):
+            raise UploadNotFoundError(uid)
+        os.truncate(path, size)
 
     # -- direct cache writes (blobrefresh; torrent allocation) -------------
 
@@ -506,6 +595,10 @@ class CAStore:
 
     def set_metadata(self, d: Digest, md: Metadata) -> None:
         path = self._md_path(self.cache_path(d), md.name)
+        # Sidecars normally follow their data file, whose commit creates
+        # the shard dir -- but serve-while-ingest publishes the metainfo
+        # sidecar BEFORE the blob lands, so the dir may not exist yet.
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(md.serialize())

@@ -15,6 +15,7 @@ Policy, in order:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import os
@@ -155,11 +156,27 @@ class CleanupManager:
         except FileNotFoundError:
             return
         now = time.time()
+        present = set(names)
         for name in names:
             path = os.path.join(self.store.upload_dir, name)
+            suffix = self.store.SESSION_SUFFIX
+            if suffix in name:
+                # Session journals sweep WITH their spool (below), never
+                # alone -- unlinking a live journal would silently strip
+                # a resumable upload down to size-based resume. Orphan
+                # journals (spool committed/aborted under a crash) and
+                # torn ``.tmp`` writes are debris.
+                base = name.split(suffix, 1)[0]
+                if base not in present or not name.endswith(suffix):
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+                continue
             try:
                 if now - os.path.getmtime(path) > ttl:
                     os.unlink(path)
+                    # The journal pairs with the spool: sweep as a unit.
+                    with contextlib.suppress(OSError):
+                        os.unlink(path + suffix)
             except OSError:
                 # FileNotFoundError: committed/aborted under us -- gone.
                 # Anything else (stray subdir, permission artifact): skip

@@ -164,6 +164,7 @@ def run_fsck(
     expect_namespace: bool = False,
     verify: str = "auto",  # auto (crash window) | all | none
     quarantine: bool = True,  # offline report-only runs pass False
+    resume: bool = True,  # preserve journaled upload sessions for adoption
 ) -> FsckReport:
     """One reconciliation pass over ``store``'s tree. Synchronous (runs
     off-loop in assembly; directly in the offline CLI). Safe by
@@ -177,6 +178,14 @@ def run_fsck(
     ``expect_namespace`` is True on origins only: agents never write
     namespace sidecars, so orphan-data adoption there would mislabel the
     entire store.
+
+    ``resume`` mirrors the node's ``ingest.resume`` config: journaled
+    upload sessions (``upload/<uid>.session`` beside their spool) are
+    resumable crash state, NOT debris -- a restarted origin re-adopts
+    them on the client's next HEAD, so fsck must leave a fresh
+    spool+journal pair alone. With resume off the journals are dead
+    weight and sweep unconditionally (the spools keep the plain TTL
+    rule).
     """
     if verify not in ("auto", "all", "none"):
         raise ValueError(f"unknown verify mode: {verify!r}")
@@ -195,22 +204,50 @@ def run_fsck(
     # 1. Stale upload spool files (client died before commit). A LIVE
     # upload keeps a fresh mtime with every PATCH -- only entries idle
     # past the TTL age out, exactly like the periodic cleanup sweep.
-    if upload_ttl_seconds > 0:
+    # Spool + session journal are ONE unit: a swept spool takes its
+    # journal with it, and a journal whose spool is gone is an orphan
+    # (crash between commit's rename and the journal unlink).
+    if upload_ttl_seconds > 0 or not resume:
         swept = 0
+        journals = 0
         try:
             names = os.listdir(store.upload_dir)
         except FileNotFoundError:
             names = []
+        present = set(names)
         for name in names:
             path = os.path.join(store.upload_dir, name)
+            if CAStore.SESSION_SUFFIX + ".tmp" in name:
+                # Torn journal write (tmp survivor): always debris.
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                    journals += 1
+                continue
+            if name.endswith(CAStore.SESSION_SUFFIX):
+                uid = name[: -len(CAStore.SESSION_SUFFIX)]
+                if not resume or uid not in present:
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+                        journals += 1
+                continue  # live journal: only sweeps with its spool below
             age_from = _mtime(path)
             if age_from is None:
                 continue
-            if now - age_from > upload_ttl_seconds:
+            if upload_ttl_seconds > 0 and now - age_from > upload_ttl_seconds:
                 with contextlib.suppress(OSError):
                     os.unlink(path)
                     swept += 1
+                with contextlib.suppress(OSError):
+                    os.unlink(path + CAStore.SESSION_SUFFIX)
         report._count("stale_spool", swept)
+        report._count("upload_session", journals)
+
+    # Digests with a live journaled upload session: their sidecars
+    # (early-published metainfo, namespace) may exist BEFORE the blob
+    # does -- serve-while-ingest publishes ahead of commit, and a crash
+    # in that window leaves sidecars whose data arrives when the client
+    # resumes. Not orphans; leave them for the resumed commit.
+    live_uploads = store.live_upload_digests() if resume else set()
 
     stamp = read_clean_shutdown(store)
     if verify == "auto" and stamp is None:
@@ -269,6 +306,7 @@ def run_fsck(
                 manifest = f"{base}._md_{ChunkManifestMetadata.name}"
                 if (
                     base not in present
+                    and base not in live_uploads
                     and f"{base}.part" not in present
                     and not (
                         store.chunkstore is not None

@@ -100,7 +100,15 @@ class Scrubber:
     async def run_cycle(self) -> list[Digest]:
         """Verify every cached blob once; returns the quarantined digests."""
         quarantined: list[Digest] = []
+        # Digests with a live journaled upload session are mid-ingest:
+        # their tail is still arriving (resume) or their commit is in
+        # flight (serve-while-ingest) -- judging them now risks
+        # quarantining a blob the very next PATCH completes. The next
+        # cycle scrubs them committed.
+        live = await asyncio.to_thread(self.store.live_upload_digests)
         for d in await asyncio.to_thread(self.store.list_cache_digests):
+            if d.hex in live:
+                continue
             try:
                 ok = await self._verify(d)
             except (KeyError, FileNotFoundError):

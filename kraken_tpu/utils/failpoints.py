@@ -70,9 +70,17 @@ KNOWN_FAILPOINTS = frozenset({
     "httputil.request.error",
     "httputil.request.slow",
     "httputil.request.truncate_body",
+    "ingest.abort",
+    "ingest.window.hash",
+    "ingest.window.pack",
+    "ingest.window.read",
+    "ingest.window.transfer",
+    "origin.commit.slow",
+    "origin.ingest.device_fail",
     "origin.patch.close",
     "origin.patch.write",
     "origin.recipe.miss",
+    "origin.upload.resume",
     "p2p.conn.disconnect",
     "p2p.conn.recv.corrupt",
     "p2p.conn.send.delay",

@@ -410,7 +410,11 @@ def test_enospc_mid_patch_clean_error_spool_reclaimed_retry_succeeds(tmp_path):
             dedup=False,
         )
         await origin.start()
-        oc = BlobClient(origin.addr, HTTPClient(retries=0))
+        # resume=False pins the LEGACY fail-fast contract (a mid-stream
+        # ENOSPC surfaces as a clean 500, never a hang or corrupt blob);
+        # test_enospc_mid_patch_resume_heals_transparently covers the
+        # resuming client.
+        oc = BlobClient(origin.addr, HTTPClient(retries=0), resume=False)
         try:
             blob = os.urandom(3 * 64 * 1024 + 500)
             d = Digest.from_bytes(blob)
@@ -909,5 +913,242 @@ def test_chaos_soak_probabilistic_faults_swarm(tmp_path):
         finally:
             failpoints.FAILPOINTS.disarm_all()
             await _teardown(tracker, origin, agents, cluster)
+
+    asyncio.run(main())
+
+
+# -- scenario 8: origin SIGKILL mid-upload -> journaled resume, bit-identical -
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_origin_crash_mid_upload_client_resumes_bit_identical(tmp_path):
+    """ACCEPTANCE: an origin hard-killed mid-upload (no clean-shutdown
+    stamp, every in-memory tracker lost) restarts, fsck preserves the
+    journaled session, HEAD re-adopts it at the durable offset, the
+    client re-PATCHes ONLY the tail, and the committed digest + served
+    metainfo are bit-identical to the single-shot oracle."""
+
+    async def main():
+        import aiohttp
+
+        from kraken_tpu.core.hasher import get_hasher
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        piece = 64 * 1024
+        blob = os.urandom(5 * piece + 77)
+        d = Digest.from_bytes(blob)
+        cut = 3 * piece + 11  # past the flush -> journaled durable offset
+        port = _free_port()
+        root = str(tmp_path / "origin")
+
+        origin1 = OriginNode(
+            store_root=root, http_port=port,
+            piece_lengths=SMALL_PIECES, dedup=False,
+        )
+        await origin1.start()
+        base = f"http://{origin1.addr}/namespace/{NS}/blobs/{d}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(f"{base}/uploads") as r:
+                uid = await r.text()
+            async with http.patch(
+                f"{base}/uploads/{uid}", data=blob[:cut],
+                headers={"X-Upload-Offset": "0"},
+            ) as r:
+                assert r.status == 204
+        # SIGKILL stand-in: stop WITHOUT the clean-shutdown stamp. The
+        # process state (upload trackers, pipeline sessions) dies with
+        # it; only the spool + session journal survive on disk.
+        mp = pytest.MonkeyPatch()
+        mp.setattr(
+            "kraken_tpu.assembly.write_clean_shutdown", lambda store: None
+        )
+        try:
+            await origin1.stop()
+        finally:
+            mp.undo()
+
+        origin2 = OriginNode(
+            store_root=root, http_port=port,
+            piece_lengths=SMALL_PIECES, dedup=False,
+        )
+        adopted0 = REGISTRY.counter("upload_sessions_adopted_total").value()
+        await origin2.start()  # startup fsck preserves the live session
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.request(
+                    "HEAD", f"{base}/uploads/{uid}"
+                ) as r:
+                    assert r.status == 200
+                    offset = int(r.headers["X-Upload-Offset"])
+                # Resume from the journaled durable offset: the client
+                # re-sends ONLY the tail, not the whole blob.
+                assert offset == cut
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[offset:],
+                    headers={"X-Upload-Offset": str(offset)},
+                ) as r:
+                    assert r.status == 204
+                async with http.put(f"{base}/uploads/{uid}/commit") as r:
+                    assert r.status == 201
+            assert (
+                REGISTRY.counter("upload_sessions_adopted_total").value()
+                == adopted0 + 1
+            )
+            assert origin2.store.read_cache_file(d) == blob
+            stored = origin2.store.get_metadata(d, TorrentMetaMetadata)
+            oracle = get_hasher("cpu").hash_pieces(blob, piece).tobytes()
+            assert stored.metainfo.piece_hashes == oracle
+            assert stored.metainfo.length == len(blob)
+        finally:
+            await origin2.stop()
+
+    asyncio.run(main())
+
+
+# -- scenario 9: device hasher dies mid-stream -> host fallback, identical ---
+
+
+def test_device_hasher_failpoint_falls_back_host_bit_identical(tmp_path):
+    async def main():
+        from kraken_tpu.core.hasher import get_hasher
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        piece = 64 * 1024
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"),
+            piece_lengths=SMALL_PIECES, dedup=False,
+            ingest={"window_bytes": 1 << 20, "windows_in_flight": 2},
+        )
+        await origin.start()
+        oc = BlobClient(origin.addr, HTTPClient(retries=0))
+        try:
+            blob = os.urandom(4 * piece + 123)
+            d = Digest.from_bytes(blob)
+            fell0 = REGISTRY.counter("ingest_fallbacks_total").value(
+                reason="failpoint"
+            )
+            failpoints.FAILPOINTS.arm("origin.ingest.device_fail", "once")
+            await oc.upload(NS, d, blob)  # degrades live, never errors
+            assert _fired("origin.ingest.device_fail") >= 1
+            assert (
+                REGISTRY.counter("ingest_fallbacks_total").value(
+                    reason="failpoint"
+                )
+                == fell0 + 1
+            )
+            stored = origin.store.get_metadata(d, TorrentMetaMetadata)
+            oracle = get_hasher("cpu").hash_pieces(blob, piece).tobytes()
+            assert stored.metainfo.piece_hashes == oracle
+            assert await oc.download(NS, d) == blob
+        finally:
+            await oc.close()
+            await origin.stop()
+
+    asyncio.run(main())
+
+
+# -- scenario 10: ENOSPC mid-PATCH -> the resuming client heals silently -----
+
+
+def test_enospc_mid_patch_resume_heals_transparently(tmp_path):
+    """The default (resume=True) client turns scenario 2's hard failure
+    into a non-event: the failed PATCH is retried from the origin's
+    durable offset under backoff and the upload completes with NO
+    exception surfacing to the caller."""
+
+    async def main():
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"),
+            piece_lengths=SMALL_PIECES, dedup=False,
+        )
+        await origin.start()
+        oc = BlobClient(origin.addr, HTTPClient(retries=0))
+        try:
+            blob = os.urandom(3 * 64 * 1024 + 500)
+            d = Digest.from_bytes(blob)
+            failpoints.FAILPOINTS.arm("origin.patch.write", "once")
+            await oc.upload(NS, d, blob)  # no pytest.raises: it heals
+            assert _fired("origin.patch.write") >= 1
+            assert await oc.download(NS, d) == blob
+        finally:
+            await oc.close()
+            await origin.stop()
+
+    asyncio.run(main())
+
+
+# -- scenario 11: agent pulls a blob whose commit hasn't finished ------------
+
+
+def test_pull_of_still_ingesting_blob_serves_before_commit(tmp_path):
+    """serve_while_ingest: once every byte is spooled and every piece
+    hash known (commit REQUEST time), the metainfo publishes and the
+    origin seeds straight from the spool -- an agent pull completes
+    while the commit itself is still grinding (origin.commit.slow)."""
+
+    async def main():
+        from kraken_tpu.origin.metainfogen import TorrentMetaMetadata
+
+        tracker = TrackerNode(
+            announce_interval_seconds=0.1, peer_ttl_seconds=5.0
+        )
+        await tracker.start()
+        origin = OriginNode(
+            store_root=str(tmp_path / "origin"),
+            tracker_addr=tracker.addr,
+            piece_lengths=SMALL_PIECES,
+            dedup=False,
+            ingest={
+                "window_bytes": 1 << 20,
+                "windows_in_flight": 2,
+                "serve_while_ingest": True,
+            },
+        )
+        await origin.start()
+        cluster = ClusterClient(
+            Ring(HostList(static=[origin.addr]), max_replica=1)
+        )
+        tracker.server.origin_cluster = cluster
+        agent = AgentNode(
+            store_root=str(tmp_path / "agent"), tracker_addr=tracker.addr
+        )
+        await agent.start()
+        oc = BlobClient(origin.addr, HTTPClient(retries=0))
+        try:
+            blob = os.urandom(5 * 64 * 1024 + 99)
+            d = Digest.from_bytes(blob)
+            # The commit stalls 3s AFTER early publish -- the window in
+            # which the swarm must already be serving the spool bytes.
+            failpoints.FAILPOINTS.arm("origin.commit.slow", "once+delay:3000")
+            upload_task = asyncio.create_task(oc.upload(NS, d, blob))
+            # Early publish lands the metainfo sidecar before commit.
+            await _wait_for(
+                lambda: origin.store.get_metadata(d, TorrentMetaMetadata)
+                is not None,
+                msg="early-published metainfo",
+            )
+            got = await _pull(agent, d)
+            assert not upload_task.done(), (
+                "pull must complete INSIDE the commit window"
+            )
+            assert got == blob
+            await upload_task  # the slow commit still succeeds
+            assert origin.store.in_cache(d)
+            assert await _pull(agent, d) == blob  # post-promote re-serve
+        finally:
+            await oc.close()
+            await agent.stop()
+            await origin.stop()
+            await cluster.close()
+            await tracker.stop()
 
     asyncio.run(main())

@@ -355,8 +355,18 @@ def test_ingest_sections_construct_ingest_config():
         )
         assert 1 << 20 <= cfg.window_bytes <= 1 << 30, path
         assert cfg.pack_workers >= 0, path
+        assert cfg.resume is True, (
+            f"{path}: shipped resume must stay ON (pure robustness --"
+            " journaled sessions survive origin crashes; flipping it off"
+            " is a per-cluster opt-out, not a shipped default)"
+        )
+        assert cfg.serve_while_ingest is False, (
+            f"{path}: shipped serve_while_ingest must stay OFF (serving"
+            " from the upload spool pre-commit is a rollout step --"
+            " docs/OPERATIONS.md runbook)"
+        )
         seen += 1
-    assert seen >= 1  # the origin registers the ingest knobs
+    assert seen >= 2  # origin AND agent register the ingest knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -620,3 +620,236 @@ def test_ingest_reload_applies_and_live_enables(tmp_path):
     assert bare.ingest_pipeline is not None
     assert bare.generator.pipeline is bare.ingest_pipeline
     assert bare.ingest_pipeline.config.window_bytes == 4 << 20
+
+
+# -- crash-safe resumable sessions (PR 17) ---------------------------------
+
+
+def test_resume_adopts_journal_and_hashes_bit_identical(tmp_path):
+    """Tentpole: a PATCH stream interrupted mid-upload resumes from the
+    journaled durable offset and hashes BIT-IDENTICAL to an
+    uninterrupted stream. The in-memory tracker is dropped between
+    chunks (what an origin restart does to every tracker); HEAD must
+    re-adopt from the journal+spool and the resumed tail must land on
+    the stream fast path -- the committed MetaInfo equals the oracle."""
+
+    async def main():
+        import os
+
+        blob = os.urandom(7 * PIECE + 321)
+        d = Digest.from_bytes(blob)
+        node = _pipe_node(tmp_path)
+        await node.start()
+        try:
+            cut = 3 * PIECE + 100
+            base = f"http://{node.addr}/namespace/ns/blobs/{d}"
+            async with ClientSession() as http:
+                async with http.post(f"{base}/uploads") as r:
+                    uid = await r.text()
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[:cut],
+                    headers={"X-Upload-Offset": "0"},
+                ) as r:
+                    assert r.status == 204
+                # The journal landed with the flush.
+                doc = node.store.read_upload_session(uid)
+                assert doc is not None and doc["offset"] == cut
+                assert doc["digest"] == d.hex
+                # Simulate restart: the tracker (and its pipeline
+                # session) evaporates; only spool+journal survive.
+                node.server._upload_digests.pop(uid).invalidate()
+                async with http.request(
+                    "HEAD", f"{base}/uploads/{uid}"
+                ) as r:
+                    assert r.status == 200
+                    assert int(r.headers["X-Upload-Offset"]) == cut
+                # Adopted: the tracker is live again and mid-stream.
+                assert uid in node.server._upload_digests
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[cut:],
+                    headers={"X-Upload-Offset": str(cut)},
+                ) as r:
+                    assert r.status == 204
+                async with http.put(f"{base}/uploads/{uid}/commit") as r:
+                    assert r.status == 201
+            stored = node.store.get_metadata(d, TorrentMetaMetadata).metainfo
+            want = get_hasher("cpu").hash_pieces(blob, PIECE).tobytes()
+            assert stored.serialize() == type(stored)(
+                d, len(blob), PIECE, want
+            ).serialize()
+            assert node.store.read_cache_file(d) == blob
+            # Commit cleaned the journal up with the spool.
+            assert node.store.read_upload_session(uid) is None
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_resume_patch_past_durable_size_409s(tmp_path):
+    """A blind PATCH retry past the journaled durable size would seek
+    past EOF and bury a hole under the client's bytes -- the origin must
+    409 it (the resume protocol's signal to HEAD for the real offset),
+    while rewrites at/below the durable size stay allowed."""
+
+    async def main():
+        import os
+
+        blob = os.urandom(4 * PIECE)
+        d = Digest.from_bytes(blob)
+        node = _node(tmp_path)
+        await node.start()
+        try:
+            base = f"http://{node.addr}/namespace/ns/blobs/{d}"
+            async with ClientSession() as http:
+                async with http.post(f"{base}/uploads") as r:
+                    uid = await r.text()
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[:PIECE],
+                    headers={"X-Upload-Offset": "0"},
+                ) as r:
+                    assert r.status == 204
+                # Past-EOF offset (the crash-retry hole): refused.
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[2 * PIECE :],
+                    headers={"X-Upload-Offset": str(2 * PIECE)},
+                ) as r:
+                    assert r.status == 409
+                # Recover exactly as a resuming client would.
+                async with http.request(
+                    "HEAD", f"{base}/uploads/{uid}"
+                ) as r:
+                    off = int(r.headers["X-Upload-Offset"])
+                assert off == PIECE
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[off:],
+                    headers={"X-Upload-Offset": str(off)},
+                ) as r:
+                    assert r.status == 204
+                async with http.put(f"{base}/uploads/{uid}/commit") as r:
+                    assert r.status == 201
+            assert node.store.read_cache_file(d) == blob
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_unadoptable_session_404s_and_client_restarts(tmp_path):
+    """A session whose spool contradicts its journal (here: forced via
+    the origin.upload.resume failpoint) must 404 the HEAD -- the
+    client's cue to restart the upload from scratch -- and the suspect
+    spool+journal must be gone."""
+
+    async def main():
+        import os
+
+        from kraken_tpu.utils import failpoints
+
+        blob = os.urandom(3 * PIECE)
+        d = Digest.from_bytes(blob)
+        node = _node(tmp_path)
+        await node.start()
+        try:
+            base = f"http://{node.addr}/namespace/ns/blobs/{d}"
+            async with ClientSession() as http:
+                async with http.post(f"{base}/uploads") as r:
+                    uid = await r.text()
+                async with http.patch(
+                    f"{base}/uploads/{uid}", data=blob[:PIECE],
+                    headers={"X-Upload-Offset": "0"},
+                ) as r:
+                    assert r.status == 204
+                node.server._upload_digests.pop(uid).invalidate()
+                failpoints.allow()
+                failpoints.FAILPOINTS.arm("origin.upload.resume", "once")
+                try:
+                    async with http.request(
+                        "HEAD", f"{base}/uploads/{uid}"
+                    ) as r:
+                        assert r.status == 404
+                finally:
+                    failpoints.FAILPOINTS.disarm_all()
+                    failpoints.allow(False)
+                # The whole session is discarded: spool AND journal.
+                assert node.store.read_upload_session(uid) is None
+                import os as _os
+
+                assert not _os.path.exists(node.store.upload_path(uid))
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_pipeline_abort_returns_every_lease(tmp_path):
+    """abort() mid-stream must provably return every BufferPool lease --
+    a leaked staging lease caps all future ingest concurrency."""
+    from kraken_tpu.core.ingest import IngestConfig, IngestPipeline
+
+    pipe = IngestPipeline(
+        get_hasher("cpu"),
+        IngestConfig(window_bytes=1 << 20, windows_in_flight=2),
+    )
+    ses = pipe.session(4096)
+    buf = ses.begin_window()
+    buf[: 4096] = b"x" * 4096
+    ses.submit(4096)
+    ses.begin_window()  # second window leased, never submitted
+    ses.abort()
+    assert pipe._bufpool.leased == 0
+
+
+def test_upload_digest_ttl_purge_and_capacity_eviction(tmp_path):
+    """Satellite (b): idle trackers purge on the TTL tick (not only past
+    a size watermark) and the hard cap evicts the OLDEST idle tracker,
+    metered -- never a silent drop."""
+
+    async def main():
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        node = _node(tmp_path)
+        await node.start()
+        try:
+            server = node.server
+            base = f"http://{node.addr}/namespace/ns/blobs"
+            d = Digest.from_bytes(b"ttl-purge")
+            async with ClientSession() as http:
+                async with http.post(f"{base}/{d}/uploads") as r:
+                    uid = await r.text()
+            assert uid in server._upload_digests
+            # Age the tracker past the TTL and tick the purge.
+            server._upload_digests[uid].created -= (
+                server.UPLOAD_DIGEST_TTL_SECONDS + 1
+            )
+            before = REGISTRY.counter(
+                "upload_digests_evicted_total"
+            ).value(reason="ttl")
+            server.purge_upload_digests()
+            assert uid not in server._upload_digests
+            after = REGISTRY.counter(
+                "upload_digests_evicted_total"
+            ).value(reason="ttl")
+            assert after == before + 1
+
+            # Capacity: with the cap forced to 1, a second start evicts
+            # the first (oldest) tracker with reason=capacity.
+            server.UPLOAD_DIGEST_CAP = 1
+            async with ClientSession() as http:
+                async with http.post(f"{base}/{d}/uploads") as r:
+                    uid1 = await r.text()
+                cap_before = REGISTRY.counter(
+                    "upload_digests_evicted_total"
+                ).value(reason="capacity")
+                async with http.post(f"{base}/{d}/uploads") as r:
+                    uid2 = await r.text()
+            assert uid1 not in server._upload_digests
+            assert uid2 in server._upload_digests
+            cap_after = REGISTRY.counter(
+                "upload_digests_evicted_total"
+            ).value(reason="capacity")
+            assert cap_after == cap_before + 1
+        finally:
+            await node.stop()
+
+    asyncio.run(main())

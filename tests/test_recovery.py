@@ -641,3 +641,157 @@ def test_heal_never_trusts_an_unverified_cached_copy(tmp_path):
         )
 
     asyncio.run(main())
+
+
+# -- crash-safe resumable sessions: fsck / scrub / cleanup guards ------------
+
+
+def _journal(s: CAStore, uid: str, digest_hex: str, offset: int = 0) -> None:
+    s.write_upload_session(
+        uid,
+        {
+            "version": 1,
+            "digest": digest_hex,
+            "namespace": "testns",
+            "offset": offset,
+            "piece_length": 65536,
+            "piece_hashes": "",
+        },
+    )
+
+
+def test_fsck_preserves_live_journaled_session(tmp_path):
+    """A fresh spool + its session journal is a RESUMABLE upload: fsck
+    must leave both exactly in place for the restarted origin to adopt."""
+    s = _store(tmp_path)
+    uid = s.create_upload()
+    s.write_upload_chunk(uid, 0, b"still arriving")
+    _journal(s, uid, "e" * 64, offset=14)
+
+    report = run_fsck(s, upload_ttl_seconds=3600)
+    assert report.clean, report.repairs
+    assert s.upload_exists(uid)
+    assert s.read_upload_session(uid) is not None
+
+
+def test_fsck_sweeps_orphan_journal_and_tmp_debris(tmp_path):
+    """A journal whose spool is gone (crash between commit's rename and
+    the journal unlink) and a torn .tmp journal write are both debris."""
+    s = _store(tmp_path)
+    _journal(s, "deadbeef" * 4, "f" * 64)
+    torn = os.path.join(
+        s.upload_dir, "cafecafe" * 4 + CAStore.SESSION_SUFFIX + ".tmp.1234"
+    )
+    with open(torn, "wb") as f:
+        f.write(b"{torn")
+
+    report = run_fsck(s, upload_ttl_seconds=3600)
+    assert report.repairs == {"upload_session": 2}
+    assert s.read_upload_session("deadbeef" * 4) is None
+    assert not os.path.exists(torn)
+
+
+def test_fsck_resume_false_clears_journals_keeps_fresh_spool(tmp_path):
+    """resume=False (the rollback knob) drops every journal -- sessions
+    degrade to size-based resume -- without touching live spools."""
+    s = _store(tmp_path)
+    uid = s.create_upload()
+    s.write_upload_chunk(uid, 0, b"bytes")
+    _journal(s, uid, "a" * 64, offset=5)
+
+    report = run_fsck(s, upload_ttl_seconds=3600, resume=False)
+    assert report.repairs == {"upload_session": 1}
+    assert s.upload_exists(uid), "the spool itself is still live"
+    assert s.read_upload_session(uid) is None
+
+
+def test_fsck_ttl_stale_spool_takes_its_journal_with_it(tmp_path):
+    """Spool + journal age out as ONE unit: a swept spool must not leave
+    its journal behind as a next-pass orphan (or worse, a live-digest
+    entry shielding sidecars forever)."""
+    s = _store(tmp_path)
+    uid = s.create_upload()
+    s.write_upload_chunk(uid, 0, b"abandoned")
+    _journal(s, uid, "b" * 64, offset=9)
+    _backdate(s.upload_path(uid))
+
+    report = run_fsck(s, upload_ttl_seconds=3600)
+    assert report.repairs == {"stale_spool": 1}
+    assert not s.upload_exists(uid)
+    assert s.read_upload_session(uid) is None
+
+
+def test_fsck_keeps_early_publish_sidecar_for_live_session(tmp_path):
+    """serve-while-ingest publishes metainfo sidecars BEFORE the data
+    file exists; with a live journaled session for that digest the
+    sidecar is NOT an orphan -- the resumed commit delivers its bytes.
+    Once the session is gone the same sidecar is debris again."""
+    s = _store(tmp_path)
+    hex_ = "c" * 64
+    sidecar = _plant_orphan_sidecar(s, hex_)
+    uid = s.create_upload()
+    s.write_upload_chunk(uid, 0, b"tail en route")
+    _journal(s, uid, hex_, offset=13)
+
+    report = run_fsck(s, upload_ttl_seconds=3600)
+    assert report.clean, report.repairs
+    assert os.path.exists(sidecar)
+
+    # Session gone (abort clears spool+journal): now it IS an orphan.
+    s.abort_upload(uid)
+    report = run_fsck(s, upload_ttl_seconds=3600)
+    assert report.repairs == {"orphan_sidecar": 1}
+    assert not os.path.exists(sidecar)
+
+
+def test_scrub_skips_blob_with_live_upload_session(tmp_path):
+    """Satellite (c): a blob whose tail is still arriving (live session
+    journal names its digest) must not be quarantined mid-ingest even if
+    the cached bytes don't hash out yet; the next cycle -- session gone
+    -- scrubs it for real."""
+    s = _store(tmp_path)
+    blob = os.urandom(20_000)
+    d = _put(s, blob)
+    with open(s.cache_path(d), "r+b") as f:
+        f.seek(5_000)
+        f.write(b"\x5a")  # reads as corrupt until the "tail" lands
+    uid = s.create_upload()
+    s.write_upload_chunk(uid, 0, b"x")
+    _journal(s, uid, d.hex, offset=1)
+
+    async def cycle():
+        sc = Scrubber(s, ScrubConfig(bytes_per_second=0))
+        return await sc.run_cycle()
+
+    assert asyncio.run(cycle()) == []
+    assert s.in_cache(d), "mid-ingest blob must never be quarantined"
+
+    s.abort_upload(uid)
+    bad = asyncio.run(cycle())
+    assert [b.hex for b in bad] == [d.hex]
+    assert not s.in_cache(d)
+
+
+def test_cleanup_sweeps_spool_and_journal_as_unit(tmp_path):
+    """Periodic cleanup mirrors fsck's session semantics: stale spool +
+    journal go together, an orphan journal goes alone, a live journal is
+    never unlinked out from under its spool."""
+    from kraken_tpu.store.cleanup import CleanupConfig, CleanupManager
+
+    s = _store(tmp_path)
+    stale = s.create_upload()
+    s.write_upload_chunk(stale, 0, b"abandoned")
+    _journal(s, stale, "1" * 64)
+    _backdate(s.upload_path(stale))
+    live = s.create_upload()
+    s.write_upload_chunk(live, 0, b"active")
+    _journal(s, live, "2" * 64)
+    _journal(s, "feedface" * 4, "3" * 64)  # orphan: no spool
+
+    mgr = CleanupManager(s, CleanupConfig(tti_seconds=0, upload_ttl_seconds=3600))
+    mgr.run_once()
+    assert not s.upload_exists(stale)
+    assert s.read_upload_session(stale) is None
+    assert s.upload_exists(live)
+    assert s.read_upload_session(live) is not None
+    assert s.read_upload_session("feedface" * 4) is None
