@@ -42,6 +42,14 @@ def main():
     ap.add_argument("--tracker-restart-after", type=float, default=0.0)
     ap.add_argument("--tracker-down-mode", default="refuse",
                     choices=["refuse", "blackhole"])
+    # Total-outage drill (PEX plane): kill EVERY tracker mid-run with
+    # gossip peer exchange on, against a same-seed no-kill control --
+    # the row is what fraction of in-flight pulls still complete.
+    ap.add_argument("--tracker-kill-all", action="store_true")
+    ap.add_argument("--pex", action="store_true",
+                    help="gossip peer exchange (implied by "
+                         "--tracker-kill-all)")
+    ap.add_argument("--pex-interval", type=float, default=5.0)
     args = ap.parse_args()
 
     t0 = time.time()
@@ -59,11 +67,29 @@ def main():
         n_trackers=args.trackers,
         tracker_down_mode=args.tracker_down_mode,
         tracker_restart_after_s=args.tracker_restart_after,
+        pex=args.pex or args.tracker_kill_all,
+        pex_interval_s=args.pex_interval,
     )
     r = run_sim(**kw, restart_at_s=args.restart_at,
                 restart_frac=args.restart_frac,
                 tracker_kill_at_s=args.tracker_kill_at,
-                tracker_kill=args.tracker_kill)
+                tracker_kill=args.tracker_kill,
+                tracker_kill_all=args.tracker_kill_all)
+    if args.tracker_kill_all and args.tracker_kill_at > 0:
+        # Same-seed no-kill control: "the fleet survived TOTAL tracker
+        # loss at ratio X of its healthy completion, costing Y of pull
+        # p99" is a measured delta, not a cross-shape comparison.
+        control = run_sim(**kw, restart_at_s=args.restart_at,
+                          restart_frac=args.restart_frac)
+        r["control_no_tracker_kill"] = control
+        if control["completed"]:
+            r["tracker_blackout_completion_ratio"] = round(
+                r["completed"] / control["completed"], 4
+            )
+        if r["p99_s"] is not None and control["p99_s"]:
+            r["tracker_blackout_p99_delta_s"] = round(
+                r["p99_s"] - control["p99_s"], 3
+            )
     if args.tracker_kill > 0 and args.tracker_kill_at > 0:
         # Like-for-like healthy-fleet control (same seed/config, no
         # kill): "the tracker death cost X of announce p99" is a

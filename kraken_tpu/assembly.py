@@ -50,6 +50,7 @@ from kraken_tpu.utils.resources import ResourceSentinel, ResourcesConfig
 from kraken_tpu.utils.slo import SLO, SLOConfig
 from kraken_tpu.utils.trace import TRACER, TraceConfig
 from kraken_tpu.p2p.delta import DeltaConfig, DeltaPlanner
+from kraken_tpu.p2p.pex import PexConfig
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
@@ -177,6 +178,13 @@ def _delta_config(delta) -> DeltaConfig:
     if isinstance(delta, DeltaConfig):
         return delta
     return DeltaConfig.from_dict(delta)
+
+
+def _pex_config(pex) -> PexConfig:
+    """Same normalization for the YAML ``pex:`` section."""
+    if isinstance(pex, PexConfig):
+        return pex
+    return PexConfig.from_dict(pex)
 
 
 def _profiling_config(profiling) -> ProfilerConfig:
@@ -1389,6 +1397,7 @@ class AgentNode:
         slo: dict | SLOConfig | None = None,
         canary: dict | CanaryConfig | None = None,
         ingest: dict | IngestConfig | None = None,
+        pex: dict | PexConfig | None = None,
     ):
         self.host = host
         self.http_port = http_port
@@ -1484,6 +1493,12 @@ class AgentNode:
         # reload can enable it without a restart).
         self.canary_config = _canary_config(canary)
         self.canary: Optional[CanaryProber] = None
+        # Gossip peer exchange (p2p/pex.py): conns piggyback peer
+        # deltas so the swarm survives total tracker loss; known peers
+        # persist to <store>/peercache.json and seed redials across a
+        # restart. YAML `pex:`; shipped ON; SIGHUP live-reloads every
+        # knob except the peercache path (fixed at startup).
+        self.pex_config = _pex_config(pex)
         self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
@@ -1584,6 +1599,8 @@ class AgentNode:
             config=self.scheduler_config,
             bandwidth=self.p2p_bandwidth,
             delta=self.delta,
+            pex=self.pex_config,
+            peercache_path=os.path.join(self.store.root, "peercache.json"),
         )
         await self.scheduler.start()
         self._tracker_client.port = self.scheduler.port
@@ -1693,6 +1710,12 @@ class AgentNode:
             self.canary_config = _canary_config(cfg["canary"])
             if self.canary is not None:
                 self.canary.config = self.canary_config
+        if cfg.get("pex") is not None:
+            # Gossip cadence/budgets/TTLs swap live; the peercache path
+            # is fixed at startup (a moved cache is a fresh cache).
+            self.pex_config = _pex_config(cfg["pex"])
+            if self.scheduler is not None:
+                self.scheduler.reload_pex(self.pex_config)
 
     async def drain(self, timeout: float | None = None) -> None:
         """Lameduck drain (SIGTERM path): stop announcing, fail /health,

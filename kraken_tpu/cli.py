@@ -1130,6 +1130,11 @@ def main(argv: list[str] | None = None) -> None:
             # pipeline runs here; resume gates whether fsck preserves
             # journaled upload sessions on the shared store layer).
             ingest=cfg.get("ingest"),
+            # YAML: pex: {enabled, send_enabled, interval_seconds, ...}
+            # -- the gossip peer-exchange plane ("Tracker outage
+            # survival"): the swarm keeps discovering peers when every
+            # tracker is down; peers persist across restarts.
+            pex=cfg.get("pex"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "agent"}, args.config)

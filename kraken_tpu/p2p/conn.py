@@ -60,6 +60,11 @@ class HandshakeResult:
     # active trace: serve spans on the accept side join this trace, and
     # it travels with the shardpool handoff descriptor.
     traceparent: str = ""
+    # The remote's p2p LISTEN port (0 = unknown/older peer). An inbound
+    # conn's transport port is ephemeral, so without this the accept side
+    # has no dialable addr to gossip for the peer -- PEX carries only
+    # peers whose listen port is known.
+    listen_port: int = 0
 
 
 class Conn:
@@ -342,6 +347,7 @@ async def handshake_outbound(
     own_bitfield: bytes,
     num_pieces: int,
     timeout: float = 10.0,
+    own_listen_port: int = 0,
 ) -> HandshakeResult:
     """Dial-side handshake: send ours, await theirs. The active trace
     context (the dial span) rides the handshake so the remote's serve
@@ -351,6 +357,7 @@ async def handshake_outbound(
         Message.handshake(
             str(own_peer_id), info_hash.hex, name, namespace, own_bitfield,
             num_pieces, traceparent=trace.current_traceparent() or "",
+            listen_port=own_listen_port,
         ),
     )
     return await _read_handshake(reader, timeout)
@@ -362,6 +369,7 @@ async def handshake_inbound(
     own_peer_id: PeerID,
     own_bitfield_for: "callable",
     timeout: float = 10.0,
+    own_listen_port: int = 0,
 ) -> HandshakeResult:
     """Accept-side handshake: read theirs first (it names the torrent),
     then reply with our bitfield for that torrent.
@@ -377,6 +385,7 @@ async def handshake_inbound(
         Message.handshake(
             str(own_peer_id), theirs.info_hash.hex, theirs.name,
             theirs.namespace, bits, num_pieces,
+            listen_port=own_listen_port,
         ),
     )
     return theirs
@@ -404,6 +413,7 @@ async def _read_handshake(reader: asyncio.StreamReader, timeout: float) -> Hands
             bitfield=msg.payload,
             num_pieces=h["num_pieces"],
             traceparent=str(h.get("tp", "") or ""),
+            listen_port=int(h.get("lp", 0) or 0),
         )
     except (KeyError, ValueError) as e:
         raise WireError(f"malformed handshake: {e}") from e

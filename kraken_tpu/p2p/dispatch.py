@@ -70,12 +70,17 @@ class Dispatcher:
         on_peer_failure: Callable[[PeerID, str], None] | None = None,
         churn_idle_seconds: float = 4.0,
         events: Producer | None = None,  # swarm tracing
+        on_peer_exchange: Callable[[PeerID, dict], None] | None = None,
     ):
         self.torrent = torrent
         self.requests = requests or RequestManager()
         self.churn_idle = churn_idle_seconds
         self.events = events or NoopProducer()
         self._on_peer_failure = on_peer_failure or (lambda p, r: None)
+        # PEX sink (scheduler's _on_pex): SYNC -- called from _handle on
+        # the recv pump, so it must not await. Raising ValueError on a
+        # malformed frame feeds the standard _fail_peer ban path.
+        self._on_peer_exchange = on_peer_exchange or (lambda p, h: None)
         self._peers: dict[PeerID, _Peer] = {}
         self._io_tasks: set[asyncio.Task] = set()
         # get_running_loop, not the deprecated get_event_loop: under a
@@ -395,6 +400,11 @@ class Dispatcher:
             self._spawn_io(peer, self._request_more(peer))
         elif msg.type == MsgType.CANCEL_PIECE:
             pass  # best-effort: payload may already be in flight
+        elif msg.type == MsgType.PEER_EXCHANGE:
+            # Deliberately NOT refreshing last_useful: gossip must not
+            # earn a churn exemption, or an idle peer could keep its conn
+            # slot alive forever by chattering addrs.
+            self._on_peer_exchange(peer.conn.peer_id, msg.header)
         elif msg.type == MsgType.ERROR:
             raise ConnClosedError(msg.header.get("detail", "peer error"))
 

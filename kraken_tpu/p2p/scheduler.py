@@ -20,6 +20,8 @@ import asyncio
 import contextlib
 import logging
 import os
+import random
+import secrets
 from typing import Optional, Protocol
 
 from kraken_tpu.core.digest import Digest
@@ -27,6 +29,7 @@ from kraken_tpu.core.metainfo import InfoHash, MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
 from kraken_tpu.p2p.conn import (
     Conn,
+    ConnClosedError,
     HandshakeResult,
     PeerBusyError,
     handshake_inbound,
@@ -36,13 +39,20 @@ from kraken_tpu.p2p.announcequeue import AnnounceQueue
 from kraken_tpu.p2p.connstate import ConnState, ConnStateConfig
 from kraken_tpu.p2p.dispatch import Dispatcher
 from kraken_tpu.p2p.networkevent import NoopProducer, Producer
+from kraken_tpu.p2p.pex import (
+    MAX_ENTRIES_PER_MESSAGE,
+    KnownPeers,
+    PeerCache,
+    PexConfig,
+    PexManager,
+)
 from kraken_tpu.p2p.piecerequest import RequestManager
 from kraken_tpu.p2p.shardpool import ShardPool
 from kraken_tpu.p2p.storage import Torrent
 from kraken_tpu.p2p.wire import Message, WireError, send_message
 
 
-from kraken_tpu.utils import trace
+from kraken_tpu.utils import failpoints, trace
 from kraken_tpu.utils.backoff import DecorrelatedJitter
 from kraken_tpu.utils.bandwidth import BandwidthLimiter
 from kraken_tpu.utils.bufpool import BufferPool
@@ -100,6 +110,7 @@ class SchedulerConfig:
         wire_send_batch: int = 16,
         bufpool_budget_mb: int = 256,
         data_plane_workers: int = 0,
+        max_announce_inflight: int = 32,
     ):
         self.announce_interval = announce_interval_seconds
         self.dial_timeout = dial_timeout_seconds
@@ -135,6 +146,14 @@ class SchedulerConfig:
         # sendfile off the main loop. 0 = everything on the main loop
         # (the pre-round-8 behavior). SIGHUP-resizable.
         self.data_plane_workers = data_plane_workers
+        # PER-AGENT announce concurrency cap. The rate cap bounds how
+        # many announces START per second; during a full tracker outage
+        # every in-flight announce hangs to its timeout, and without a
+        # concurrency bound N failing torrents stack N timed-out walks
+        # -- a storm of busywork against dead hosts, re-synchronized at
+        # every revival. The per-torrent decorrelated-jitter backoff
+        # desyncs the retries; this bounds how many run at once.
+        self.max_announce_inflight = max(1, max_announce_inflight)
 
     @classmethod
     def from_dict(cls, doc: dict) -> "SchedulerConfig":
@@ -157,11 +176,22 @@ class SchedulerConfig:
 
 
 class _TorrentControl:
-    def __init__(self, torrent: Torrent, namespace: str, dispatcher: Dispatcher):
+    def __init__(
+        self,
+        torrent: Torrent,
+        namespace: str,
+        dispatcher: Dispatcher,
+        known_peers_cap: int = 256,
+    ):
         self.torrent = torrent
         self.namespace = namespace
         self.dispatcher = dispatcher
         self.tasks: set[asyncio.Task] = set()
+        # Dialable-peer book for the PEX plane (p2p/pex.py): fed by
+        # tracker announces, handshakes carrying a listen port, gossip,
+        # and the peercache -- what this node gossips onward and what
+        # the peercache persists for crash redials.
+        self.known_peers = KnownPeers(cap=known_peers_cap)
         # The download's trace context (utils/trace.py): announce and
         # dial tasks are spawned from long-lived pump loops, OUTSIDE the
         # downloader's contextvar scope, so the control carries the
@@ -205,6 +235,8 @@ class Scheduler:
         is_origin: bool = False,
         metainfo_resolver=None,
         delta=None,  # p2p.delta.DeltaPlanner (agents; optional)
+        pex: PexConfig | None = None,
+        peercache_path: str | None = None,
     ):
         self.peer_id = peer_id
         self.ip = ip
@@ -251,6 +283,25 @@ class Scheduler:
         self._announce_queue = AnnounceQueue()
         self._announce_pump_task: Optional[asyncio.Task] = None
         self._announce_tasks: set[asyncio.Task] = set()
+        # PEX gossip plane (p2p/pex.py): receive is merged behind the
+        # connstate blacklist in _on_pex; the send pump gossips deltas
+        # on existing conns. SIGHUP live-reloads via reload_pex().
+        self.pex_config = pex or PexConfig()
+        self._pex = PexManager(self.pex_config)
+        self._pex_task: Optional[asyncio.Task] = None
+        # Disk-backed last-known-peers cache: loaded once at start(),
+        # merged+flushed periodically, seeding redials (and serving
+        # metainfo) across an agent restart during a tracker outage.
+        self._peercache: Optional[PeerCache] = (
+            PeerCache(
+                peercache_path,
+                ttl_seconds=self.pex_config.peercache_ttl_seconds,
+            )
+            if peercache_path and self.pex_config.peercache
+            else None
+        )
+        self._peercache_doc: dict[str, dict] = {}
+        self._peercache_task: Optional[asyncio.Task] = None
         # Lameduck drain (docs/OPERATIONS.md "Degradation plane"): stop
         # announcing and refuse NEW conns, but keep serving established
         # ones so in-flight pieces finish. Entered by SIGTERM or
@@ -286,6 +337,15 @@ class Scheduler:
             self._start_shardpool()
         _log.info("scheduler config reloaded")
 
+    def reload_pex(self, config: PexConfig) -> None:
+        """Live swap of the YAML ``pex:`` section (SIGHUP): cadence,
+        budgets, and the enable switches apply from the next tick or
+        received frame; dedup state survives (it is correctness, not
+        tuning). The peercache path is fixed at construction."""
+        self.pex_config = config
+        self._pex.reconfigure(config)
+        _log.info("pex config reloaded")
+
     def _start_shardpool(self) -> None:
         self._shardpool = ShardPool(
             self.config.data_plane_workers,
@@ -304,11 +364,30 @@ class Scheduler:
         if self.config.data_plane_workers > 0:
             self._start_shardpool()
         self._announce_pump_task = asyncio.create_task(self._announce_pump())
+        self._pex_task = asyncio.create_task(self._pex_pump())
+        if self._peercache is not None:
+            # Load off-loop (disk read); tolerant of anything on disk.
+            self._peercache_doc = await asyncio.to_thread(
+                self._peercache.load
+            )
+            self._peercache_task = asyncio.create_task(
+                self._peercache_flush_loop()
+            )
 
     async def stop(self) -> None:
         self._stopped = True
         if self._announce_pump_task is not None:
             self._announce_pump_task.cancel()
+        if self._pex_task is not None:
+            self._pex_task.cancel()
+        if self._peercache_task is not None:
+            self._peercache_task.cancel()
+        if self._peercache is not None:
+            # Final snapshot while the controls still exist: a planned
+            # restart must resume with the freshest peer book, not the
+            # last periodic flush's.
+            with contextlib.suppress(Exception):
+                await self._flush_peercache()
         for t in list(self._announce_tasks):
             t.cancel()
         for t in list(self._convert_tasks):
@@ -374,7 +453,24 @@ class Scheduler:
             "p2p.download", digest=d.hex[:12], namespace=namespace,
         ) as sp:
             plan_t0 = asyncio.get_running_loop().time()
-            metainfo = await self.metainfo_client.get(namespace, d)
+            try:
+                metainfo = await self.metainfo_client.get(namespace, d)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # Tracker dark (total outage): the peercache may hold
+                # this blob's metainfo from a pull that was in flight
+                # before a restart -- the ONLY way a fresh boot can
+                # rejoin its swarm with every tracker down. No cache
+                # record: the original failure stands, typed as-is.
+                metainfo = self._peercache_metainfo(d)
+                if metainfo is None:
+                    raise
+                REGISTRY.counter(
+                    "pex_peercache_metainfo_hits_total",
+                    "Metainfo served from the peercache because every"
+                    " tracker fetch failed",
+                ).inc()
             if (
                 self._delta is not None
                 and metainfo.info_hash not in self._controls
@@ -547,14 +643,19 @@ class Scheduler:
             on_peer_failure=lambda pid, reason: self._peer_failed(pid, h, reason),
             churn_idle_seconds=self.config.conn_churn_idle,
             events=self.events,
+            on_peer_exchange=lambda pid, hdr: self._on_pex(pid, h, hdr),
         )
-        ctl = _TorrentControl(torrent, namespace, dispatcher)
+        ctl = _TorrentControl(
+            torrent, namespace, dispatcher,
+            known_peers_cap=self.pex_config.max_known_peers,
+        )
         self._controls[h] = ctl
         self._digest_to_hash[torrent.metainfo.digest] = h
         # First announce ASAP (downloads need peers now); re-announces are
         # paced by the queue pump under the global rate cap.
         self._announce_queue.schedule(h, 0.0)
         ctl.spawn(self._retry_loop(ctl))
+        self._seed_from_peercache(ctl)
         self.events.emit(
             "add_torrent", h.hex, blob=metainfo.name, complete=torrent.complete()
         )
@@ -564,6 +665,168 @@ class Scheduler:
         self.conn_state.blacklist.add(peer_id, h)
         self.conn_state.remove(peer_id, h)
         self.events.emit("blacklist_conn", h.hex, peer=peer_id.hex, reason=reason)
+
+    # -- peer exchange (PEX) -----------------------------------------------
+
+    def _on_pex(self, sender: PeerID, h: InfoHash, header: dict) -> None:
+        """One received PEER_EXCHANGE frame (sync, on the recv pump via
+        the dispatcher). A ValueError out of ingest -- shape garbage or
+        an entry flood -- propagates into the dispatcher's _fail_peer
+        ban path, exactly like a bad piece. Accepted peers merge behind
+        the SAME gates announces use: _maybe_dial goes through
+        conn_state.add_pending, so a blacklisted peer gossiped back in
+        stays blacklisted, and the token-bucket dial budget keeps even
+        an honest gossip storm from flooding the dial queue."""
+        ctl = self._controls.get(h)
+        if ctl is None:
+            return
+        # Failpoint p2p.pex.drop: lossy gossip plane -- discovery must
+        # still converge off later ticks / other senders.
+        if failpoints.fire("p2p.pex.drop"):
+            return
+        if not self.pex_config.enabled:
+            return
+        now = asyncio.get_running_loop().time()
+        fresh, drops = self._pex.ingest(h.hex, sender, header, now)
+        src = f"gossip:{sender.hex}"
+        for pid in drops:
+            ctl.known_peers.drop(pid, src)
+        for peer in fresh:
+            if peer.peer_id == self.peer_id:
+                continue
+            if not ctl.known_peers.add(peer, src):
+                continue  # book full of authoritative entries
+            if ctl.torrent.complete():
+                continue  # seeders learn addrs but never dial
+            if not self._pex.try_dial_budget():
+                continue
+            self._maybe_dial(ctl, peer)
+
+    async def _pex_pump(self) -> None:
+        """ONE task gossips for every conn: each jittered tick computes
+        per-conn deltas (what that conn has not heard yet, capped at the
+        send budget) and spawns the sends -- never awaiting a send
+        inline, so one stuck peer cannot stall the plane's cadence."""
+        rng = random.Random()
+        while True:
+            cfg = self.pex_config  # re-read: reload_pex swaps it live
+            interval = max(1.0, cfg.interval_seconds)
+            await asyncio.sleep(
+                interval * (1.0 + rng.uniform(-cfg.jitter, cfg.jitter))
+            )
+            if not cfg.send_enabled:
+                continue
+            self._gossip_tick()
+
+    def _gossip_tick(self) -> None:
+        frames = 0
+        for key, conn in list(self._conn_owners.items()):
+            pid, h = key
+            ctl = self._controls.get(h)
+            if ctl is None:
+                continue
+            added, dropped = self._pex.delta_for(
+                key, pid, ctl.known_peers.snapshot()
+            )
+            # Failpoint p2p.pex.flood: a hostile peer ignoring the send
+            # budget -- the RECEIVER must ban us (entry-count violation),
+            # not balloon its dial queue.
+            if failpoints.fire("p2p.pex.flood"):
+                added = [
+                    {"id": secrets.token_hex(20), "ip": "203.0.113.1",
+                     "p": 1 + (i % 65000)}
+                    for i in range(MAX_ENTRIES_PER_MESSAGE + 1)
+                ]
+            if not added and not dropped:
+                continue
+            frames += 1
+            ctl.spawn(self._send_pex(conn, added, dropped))
+        if frames:
+            with trace.span("p2p.pex.gossip", frames=frames):
+                pass
+
+    async def _send_pex(
+        self, conn: Conn, added: list[dict], dropped: list[str]
+    ) -> None:
+        with contextlib.suppress(ConnClosedError):
+            await conn.send(Message.peer_exchange(added, dropped))
+
+    # -- peercache (disk-backed last-known peers) --------------------------
+
+    def _peercache_metainfo(self, d: Digest) -> MetaInfo | None:
+        """Cached metainfo for blob ``d``, from a pull that was in
+        flight when the cache was last flushed. None on any miss or
+        decode problem (the cache must never add failure modes)."""
+        for rec in self._peercache_doc.values():
+            try:
+                mi = MetaInfo.deserialize(rec["metainfo"].encode())
+            except Exception:
+                _log.debug(
+                    "peercache record undecodable; skipped", exc_info=True
+                )
+                continue
+            if mi.digest == d:
+                return mi
+        return None
+
+    def _seed_from_peercache(self, ctl: _TorrentControl) -> None:
+        """New incomplete control: seed its dial set with the cached
+        last-known peers (TTL-aged at load). Dials ride the normal
+        connstate gates; the first successful tracker announce then
+        refreshes the book with authoritative records."""
+        if ctl.torrent.complete():
+            return
+        rec = self._peercache_doc.get(ctl.torrent.info_hash.hex)
+        if rec is None:
+            return
+        seeded = 0
+        for peer in rec["peers"]:
+            if peer.peer_id == self.peer_id:
+                continue
+            ctl.known_peers.add(peer, "cache")
+            self._maybe_dial(ctl, peer)
+            seeded += 1
+        if seeded:
+            REGISTRY.counter(
+                "pex_peercache_seeds_total",
+                "Dial candidates seeded from the disk peercache at"
+                " torrent creation",
+            ).inc(seeded)
+
+    async def _peercache_flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.pex_config.peercache_flush_seconds)
+            try:
+                await self._flush_peercache()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _log.warning("peercache flush failed", exc_info=True)
+
+    async def _flush_peercache(self) -> None:
+        """Merge live in-flight torrents over the loaded doc (carried
+        records keep their TTL clocks) and persist off-loop. Completed
+        pulls drop out -- a restart serves them from the store."""
+        if self._peercache is None:
+            return
+        doc = dict(self._peercache_doc)
+        for h, ctl in list(self._controls.items()):
+            if ctl.torrent.complete():
+                doc.pop(h.hex, None)
+                continue
+            peers = [
+                p for p in ctl.known_peers.snapshot()
+                if p.peer_id != self.peer_id
+            ]
+            if not peers:
+                continue
+            doc[h.hex] = {
+                "namespace": ctl.namespace,
+                "metainfo": ctl.torrent.metainfo.serialize().decode(),
+                "peers": peers,
+            }
+        self._peercache_doc = doc
+        await asyncio.to_thread(self._peercache.save, doc)
 
     # -- announce / dial ---------------------------------------------------
 
@@ -578,7 +841,15 @@ class Scheduler:
                 carry + cfg.max_announce_rate * cfg.announce_tick,
                 max(1.0, cfg.max_announce_rate),  # burst at most 1 s of budget
             )
-            budget = int(carry)
+            # Satellite cap: never more than max_announce_inflight walks
+            # in flight PER AGENT. Healthy trackers finish announces in
+            # milliseconds and never feel this; during a full outage it
+            # is what keeps N failing torrents from stacking N hung
+            # timeout walks (the rate cap only bounds starts).
+            room = max(
+                0, cfg.max_announce_inflight - len(self._announce_tasks)
+            )
+            budget = min(int(carry), room)
             carry -= budget
             now = asyncio.get_running_loop().time()
             for h in self._announce_queue.pop_ready(now, budget):
@@ -622,6 +893,10 @@ class Scheduler:
                 interval = interval_r
             self.events.emit("announce", h.hex, returned=len(peers))
             for peer in peers:
+                if peer.peer_id != self.peer_id:
+                    # Authoritative handout: feeds the PEX gossip book
+                    # (and the peercache snapshot behind it).
+                    ctl.known_peers.add(peer, "tracker")
                 self._maybe_dial(ctl, peer)
             # Announce SLI (utils/slo.py): client-side latency covers
             # the whole fleet walk -- failovers and breaker shedding
@@ -647,8 +922,16 @@ class Scheduler:
             # fixed tick (a tracker death otherwise synchronizes every
             # torrent's retry into one storm at its revival).
             _announce_failures.record(f"announce {h.hex[:12]}", e)
+            # Backoff-and-probe during a LATCHED fleet outage: with every
+            # tracker dark (tracker/client.py outage latch) there is no
+            # failover left to find, so retries stretch well past the
+            # normal interval -- PEX carries discovery -- and each one
+            # that does run doubles as the recovery probe. The latch
+            # clears on the first success and cadence snaps back.
+            outage = bool(getattr(self.announce_client, "outage", False))
+            cap = interval * (8.0 if outage else 1.0)
             jitter = DecorrelatedJitter(
-                base_seconds=min(1.0, interval), max_seconds=interval
+                base_seconds=min(1.0, interval), max_seconds=cap
             )
             ctl.announce_backoff = jitter.next(ctl.announce_backoff)
             interval = ctl.announce_backoff
@@ -717,6 +1000,7 @@ class Scheduler:
                     ctl.torrent.bitfield(),
                     ctl.torrent.num_pieces,
                     timeout=self.config.dial_timeout,
+                    own_listen_port=self.port,
                 )
             except (PeerBusyError, OSError, asyncio.TimeoutError) as e:
                 if sp is not None:
@@ -727,6 +1011,12 @@ class Scheduler:
                 # retries the seeder within seconds once churn frees its
                 # slots.
                 self.conn_state.blacklist.add(peer.peer_id, h, soft=True)
+                if not isinstance(e, PeerBusyError):
+                    # Dead addr (refused/timeout), not at-capacity: drop
+                    # it from the gossip book so we stop advertising --
+                    # and persisting -- an address nobody answers at.
+                    # The tracker re-adds it if it comes back.
+                    ctl.known_peers.discard(peer.peer_id)
                 return
             except WireError as e:
                 if sp is not None:
@@ -752,7 +1042,8 @@ class Scheduler:
     ) -> None:
         try:
             theirs = await handshake_inbound(
-                reader, writer, self.peer_id, self._bitfield_for
+                reader, writer, self.peer_id, self._bitfield_for,
+                own_listen_port=self.port,
             )
         except _AtCapacity:
             # Polite rejection: the dialer must learn this is capacity,
@@ -932,11 +1223,25 @@ class Scheduler:
         key = (theirs.peer_id, h)
         self._conn_owners[key] = conn
         conn.closed.add_done_callback(lambda _f: self._conn_closed(key, conn))
+        if theirs.listen_port:
+            # A live handshake is the best peer record there is: the
+            # remote told us its LISTEN port (its transport port here may
+            # be an ephemeral dial-side port), and the socket names its
+            # reachable ip. Feeds the gossip book + peercache.
+            peername = writer.get_extra_info("peername")
+            if peername:
+                ctl.known_peers.add(
+                    PeerInfo(
+                        theirs.peer_id, peername[0], theirs.listen_port
+                    ),
+                    "conn",
+                )
         self.events.emit("add_active_conn", h.hex, peer=theirs.peer_id.hex)
 
     def _conn_closed(self, key: tuple[PeerID, InfoHash], conn: Conn) -> None:
         if self._conn_owners.get(key) is conn:
             del self._conn_owners[key]
+            self._pex.forget_conn(key)
             self.conn_state.remove(*key)
             self.events.emit(
                 "drop_active_conn", key[1].hex, peer=key[0].hex,

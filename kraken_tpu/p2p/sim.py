@@ -114,6 +114,18 @@ class SimConfig:
     tracker_fail_timeout_s: float = 5.0  # blackhole: announce budget
     tracker_breaker_fails: int = 3
     tracker_breaker_cooldown_s: float = 10.0
+    # Gossip peer exchange (p2p/pex.py's model; OFF by default so legacy
+    # runs replay bit-exact). Every pex_interval_s each peer offers each
+    # conn up to pex_max_peers ids from its known-peer book; receivers
+    # merge and dial through the SAME blacklist + capacity gates an
+    # announce handout does -- so with every tracker dead the swarm
+    # keeps discovering peers over the conns it already has.
+    pex: bool = False
+    pex_interval_s: float = 5.0
+    pex_max_peers: int = 16
+    # Total-outage drill: kill EVERY tracker at tracker_kill_at_s
+    # (overrides tracker_kill's count).
+    tracker_kill_all: bool = False
 
     def blobs(self) -> tuple[int, ...]:
         return self.blob_pieces or (self.num_pieces,)
@@ -135,7 +147,7 @@ class _Peer:
         "pid", "origin", "join_t", "done_t", "blob_done_t", "has", "avail",
         "conns", "requests", "cs", "bl", "busy_until", "recv_until",
         "uplink_bps", "offline_until", "order", "incarnation",
-        "tracker_health",
+        "tracker_health", "known",
     )
 
     def __init__(self, pid: PeerID, cfg: SimConfig, origin: bool, join_t: float):
@@ -189,6 +201,11 @@ class _Peer:
             )
             if cfg.fleet else None
         )
+        # PEX mode: the per-torrent known-peer book (p2p/pex.KnownPeers'
+        # role) -- tracker handouts, established conns and received
+        # gossip all land here; gossip sends and tracker-free redials
+        # draw from it. Restart chaos keeps it: that is the peercache.
+        self.known: list[set[PeerID]] = [set() for _ in blobs]
         # Bumped on every restart: events scheduled against the OLD
         # process (queued serves, in-flight pieces) must not charge or
         # feed the reborn one.
@@ -259,6 +276,8 @@ class SwarmSim:
         self.announce_failovers = 0  # attempts that walked past a tracker
         self.announce_failures = 0   # walks that exhausted the whole fleet
         self.tracker_kills = 0
+        self.pex_messages = 0  # gossip frames sent
+        self.pex_dials = 0     # dials sourced from gossip/book, not announces
 
     # -- event plumbing ----------------------------------------------------
 
@@ -299,8 +318,12 @@ class SwarmSim:
         self._at(cfg.churn_tick_s, self._churn_tick)
         if cfg.restart_frac > 0 and cfg.restart_at_s > 0:
             self._at(cfg.restart_at_s, self._restart_wave)
-        if cfg.fleet and cfg.tracker_kill > 0 and cfg.tracker_kill_at_s > 0:
+        if cfg.fleet and cfg.tracker_kill_at_s > 0 and (
+            cfg.tracker_kill > 0 or cfg.tracker_kill_all
+        ):
             self._at(cfg.tracker_kill_at_s, self._tracker_kill_wave)
+        if cfg.pex:
+            self._at(cfg.pex_interval_s, self._pex_tick)
 
         while self._heap and self.now <= cfg.max_sim_s and self._remaining:
             t, _seq, fn = heapq.heappop(self._heap)
@@ -343,6 +366,9 @@ class SwarmSim:
         candidates = random.sample(self._members[t], k)
         others = [self._info(q, t) for q in candidates if q != p.pid][:limit]
         handout = default_priority(others)
+        if self.cfg.pex:
+            for info in handout:
+                p.known[t].add(info.peer_id)
         self.announce_q.schedule(
             (p.pid, t), self.now + self.cfg.announce_interval_s
         )
@@ -423,6 +449,9 @@ class SwarmSim:
         candidates = random.sample(tr.members[t], k)
         others = [self._info(q, t) for q in candidates if q != p.pid][:limit]
         handout = default_priority(others)
+        if self.cfg.pex:
+            for info in handout:
+                p.known[t].add(info.peer_id)
         if p.blob_complete(t):
             return  # seeders announce for discoverability, don't dial
         for info in handout:
@@ -435,7 +464,11 @@ class SwarmSim:
         brings them back EMPTY; announces re-form the swarm."""
         names = [tr.name for tr in self.trackers]
         ranked = rendezvous_hash(self.hs[0].hex, names, k=len(names))
-        for name in ranked[: self.cfg.tracker_kill]:
+        kill = (
+            len(ranked) if self.cfg.tracker_kill_all
+            else self.cfg.tracker_kill
+        )
+        for name in ranked[:kill]:
             tr = self._tracker_by_name[name]
             tr.up = False
             tr.wipe()
@@ -477,6 +510,10 @@ class SwarmSim:
 
     def _established(self, a: _Peer, b: _Peer, t: int) -> None:
         a.cs.promote(b.pid, self.hs[t])
+        if self.cfg.pex:
+            # A live conn IS peer knowledge ("conn"-sourced book entry).
+            a.known[t].add(b.pid)
+            b.known[t].add(a.pid)
         for x, y in ((a, b), (b, a)):
             if y.pid not in x.conns[t]:
                 x.conns[t][y.pid] = self.now
@@ -513,6 +550,65 @@ class SwarmSim:
                         self._drop_conn(p, self.peers[qid], t)
         if self._remaining:
             self._at(self.now + self.cfg.churn_tick_s, self._churn_tick)
+
+    # -- gossip peer exchange ----------------------------------------------
+
+    def _pex_tick(self) -> None:
+        """One gossip round, modeling p2p/pex.py: every online peer
+        offers each conn up to ``pex_max_peers`` ids from its known book
+        (live conns included -- production's ``delta_for`` snapshots the
+        live book). Gossip is NOT useful traffic (no churn exemption, as
+        the dispatcher rules), and every dial -- on receive AND from the
+        retry-loop redial below -- goes through the SAME blacklist +
+        capacity gates an announce handout does."""
+        cfg = self.cfg
+        for p in self.peers.values():
+            if p.offline(self.now):
+                continue
+            for t in range(len(self.blobs)):
+                pool = p.known[t] | set(p.conns[t])
+                pool.discard(p.pid)
+                if not pool:
+                    continue
+                ordered = sorted(pool)
+                for qid in list(p.conns[t]):
+                    cand = [x for x in ordered if x != qid]
+                    if len(cand) > cfg.pex_max_peers:
+                        cand = random.sample(cand, cfg.pex_max_peers)
+                    if not cand:
+                        continue
+                    self.pex_messages += 1
+                    q = self.peers[qid]
+                    self._at(
+                        self.now + cfg.latency_s,
+                        lambda q=q, t=t, cand=cand:
+                            self._pex_receive(q, t, cand),
+                    )
+                # The scheduler's retry loop over the book: an
+                # incomplete agent redials known peers it is not
+                # connected to -- this is what un-strands an agent
+                # whose every conn churned away while the trackers are
+                # dead (its book is the only discovery plane left).
+                if not p.origin and not p.blob_complete(t):
+                    for pid in ordered:
+                        if pid not in p.conns[t]:
+                            self.pex_dials += 1
+                            self._try_dial(p, pid, t)
+        if self._remaining:
+            self._at(self.now + cfg.pex_interval_s, self._pex_tick)
+
+    def _pex_receive(self, q: _Peer, t: int, cand: list[PeerID]) -> None:
+        if q.offline(self.now):
+            return
+        for pid in cand:
+            if pid != q.pid and pid in self.peers:
+                q.known[t].add(pid)
+        if q.origin or q.blob_complete(t):
+            return
+        for pid in cand:
+            if pid != q.pid and pid in self.peers and pid not in q.conns[t]:
+                self.pex_dials += 1
+                self._try_dial(q, pid, t)
 
     # -- restart chaos -----------------------------------------------------
 
@@ -688,6 +784,9 @@ class SwarmSim:
             "announce_failovers": self.announce_failovers,
             "announce_failures": self.announce_failures,
             "tracker_kills": self.tracker_kills,
+            # Gossip plane (0 outside pex mode).
+            "pex_messages": self.pex_messages,
+            "pex_dials": self.pex_dials,
         }
 
 

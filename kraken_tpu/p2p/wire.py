@@ -56,6 +56,7 @@ class MsgType(enum.IntEnum):
     CANCEL_PIECE = 5
     COMPLETE = 6
     ERROR = 7
+    PEER_EXCHANGE = 8
 
 
 class WireError(Exception):
@@ -108,13 +109,17 @@ class Message:
     def handshake(
         cls, peer_id: str, info_hash: str, name: str, namespace: str,
         bitfield: bytes, num_pieces: int, traceparent: str = "",
+        listen_port: int = 0,
     ) -> "Message":
         """``name`` is the blob digest hex -- carried alongside the info
         hash so the accepting side can load its stored metainfo directly
         (no reverse info-hash index needed). ``traceparent`` (dial side
         only) lets the accepting node's serve spans join the dialer's
         trace (utils/trace.py); absent for peers without an active
-        trace."""
+        trace. ``listen_port`` is this side's p2p LISTEN port (an inbound
+        conn's transport port is ephemeral) -- it gives the remote a
+        dialable addr to gossip over PEX; 0 omits the key (older peers
+        tolerate its absence the same way)."""
         header = {
             "peer_id": peer_id,
             "info_hash": info_hash,
@@ -124,6 +129,8 @@ class Message:
         }
         if traceparent:
             header["tp"] = traceparent
+        if listen_port:
+            header["lp"] = listen_port
         return cls(MsgType.HANDSHAKE, header, payload=bitfield)
 
     @classmethod
@@ -160,6 +167,16 @@ class Message:
     @classmethod
     def error(cls, code: str, detail: str = "") -> "Message":
         return cls(MsgType.ERROR, {"code": code, "detail": detail})
+
+    @classmethod
+    def peer_exchange(cls, added: list[dict], dropped: list[str]) -> "Message":
+        """Gossip frame (PEX): compact per-torrent peer deltas riding an
+        existing conn. ``added`` entries are dicts with short keys --
+        ``id`` (peer id hex), ``ip``, ``p`` (listen port), ``o`` (origin
+        flag, omitted when false) -- ``dropped`` is peer id hexes the
+        sender no longer has conns to. The torrent is implied by the conn
+        the frame rides on (conns are per-info-hash)."""
+        return cls(MsgType.PEER_EXCHANGE, {"a": added, "d": dropped})
 
 
 def _head(msg: Message, header: bytes) -> bytes:

@@ -33,6 +33,7 @@ import asyncio
 import itertools
 import json
 import logging
+import time
 
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.metainfo import ChunkRecipe, InfoHash, MetaInfo
@@ -42,7 +43,7 @@ from urllib.parse import quote
 from kraken_tpu.placement.healthcheck import PassiveFilter
 from kraken_tpu.placement.hrw import rendezvous_hash
 from kraken_tpu.placement.replicawalk import walk_replicas
-from kraken_tpu.utils import trace
+from kraken_tpu.utils import failpoints, trace
 from kraken_tpu.utils.deadline import Deadline, DeadlineExceeded
 from kraken_tpu.utils.dedup import TTLCache
 from kraken_tpu.utils.httputil import HTTPClient, base_url
@@ -134,6 +135,12 @@ class TrackerClient:
             origin=self.is_origin,
             complete=complete,
         )
+        # Failpoint tracker.blackout: this tracker is DARK (bad deploy,
+        # dead shared backend) -- a typed connectivity failure, exactly
+        # what a refused socket raises, so breakers trip and the fleet
+        # outage latch engages through the production path.
+        if failpoints.fire("tracker.blackout"):
+            raise ConnectionError("failpoint tracker.blackout")
         # An externally-supplied deadline (the fleet client's walk
         # budget) is owned by the caller: IT counts the exhaustion, this
         # hop only propagates it.
@@ -168,6 +175,8 @@ class TrackerClient:
     async def get(
         self, namespace: str, d: Digest, deadline: Deadline | None = None
     ) -> MetaInfo:
+        if failpoints.fire("tracker.blackout"):
+            raise ConnectionError("failpoint tracker.blackout")
         with trace.span("tracker.get_metainfo", digest=d.hex[:12]):
             raw = await self._http.get(
                 f"{base_url(self.addr)}/namespace/"
@@ -285,6 +294,30 @@ class TrackerFleetClient:
             "tracker_fleet_failovers_total",
             "Requests served by a tracker other than their shard owner",
         )
+        # Total-outage latch: every breaker open at once means the whole
+        # tracker plane is down, and walking the full failover order at
+        # full budget per request is pure queue-building. While latched,
+        # walks with no probe-eligible tracker fail fast (no HTTP); the
+        # latch clears only on a SUCCESSFUL walk (hysteresis -- one
+        # breaker entering half-open is a probe opportunity, not
+        # recovery). Registered eagerly so the gauge exists at 0 before
+        # the first outage.
+        self.outage = False
+        self._outage_accrue_t = 0.0
+        self._outage_gauge = REGISTRY.gauge(
+            "tracker_outage",
+            "1 while every tracker in the fleet is breaker-open (total "
+            "tracker outage), else 0",
+        )
+        self._outage_gauge.set(0)
+        self._outages_total = REGISTRY.counter(
+            "tracker_outages_total",
+            "Transitions into total tracker outage (all breakers open)",
+        )
+        self._outage_seconds = REGISTRY.counter(
+            "tracker_outage_seconds_total",
+            "Seconds spent with the tracker outage latch engaged",
+        )
         self._recipes = _RecipeCache(recipe_cache_ttl_seconds)
         self.set_addrs(addrs)
 
@@ -346,6 +379,43 @@ class TrackerFleetClient:
         where the request goes when the whole fleet is healthy)."""
         return rendezvous_hash(key_hex, self._addrs, k=1)[0]
 
+    def _outage_check(self) -> None:
+        """Walk-entry gate for the total-outage latch.
+
+        ``PassiveFilter.healthy`` is False only for OPEN-AND-COOLING
+        breakers -- past the cooldown it reads True again (the half-open
+        probe invitation). So "every addr unhealthy" simultaneously
+        means "total outage" and "nothing is probe-eligible right now":
+        latch and fail fast with a typed error instead of burning the
+        full walk budget on sockets we already know are dark. The
+        moment any cooldown expires the addr reads healthy, this gate
+        passes, and the walk itself becomes the probe. Clearing the
+        latch is ``_walk``'s success path, never this gate (hysteresis).
+        """
+        now = time.monotonic()
+        if self.outage:
+            self._outage_seconds.inc(max(0.0, now - self._outage_accrue_t))
+            self._outage_accrue_t = now
+        if not all(not self.health.healthy(a, now) for a in self._addrs):
+            return
+        if not self.outage:
+            self.outage = True
+            self._outage_accrue_t = now
+            self._outage_gauge.set(1)
+            self._outages_total.inc()
+            _log.error(
+                "tracker fleet outage: all %d trackers breaker-open (%s)",
+                len(self._addrs), ",".join(self._addrs),
+            )
+            from kraken_tpu.utils.trace import TRACER
+            TRACER.trigger_dump(
+                "tracker_outage",
+                f"all {len(self._addrs)} trackers breaker-open",
+            )
+        raise ConnectionError(
+            "tracker fleet outage: all trackers breaker-open"
+        )
+
     async def _walk(self, key_hex: str, op, *, op_name: str,
                     deadline: Deadline, hedge: bool):
         """Shared walk wrapper: counts a failover whenever the serving
@@ -361,6 +431,7 @@ class TrackerFleetClient:
         after ``fail_threshold`` announces the fleet routes around the
         corpse entirely. Hedged walks need no slice: the hedge timer
         already races past a hung primary."""
+        self._outage_check()
         owner = self.owner_of(key_hex)
         served: list[str] = []
         per_attempt = (
@@ -388,6 +459,15 @@ class TrackerFleetClient:
         )
         if served and served[0] != owner:
             self._failovers.inc(op=op_name)
+        if self.outage:
+            # A whole walk succeeded end to end: that is recovery, not a
+            # half-open flicker -- unlatch.
+            self.outage = False
+            self._outage_seconds.inc(
+                max(0.0, time.monotonic() - self._outage_accrue_t)
+            )
+            self._outage_gauge.set(0)
+            _log.warning("tracker fleet recovered from total outage")
         return result
 
     # -- the client protocols ----------------------------------------------

@@ -85,6 +85,8 @@ KNOWN_FAILPOINTS = frozenset({
     "p2p.conn.recv.corrupt",
     "p2p.conn.send.delay",
     "p2p.delta.base.evict",
+    "p2p.pex.drop",
+    "p2p.pex.flood",
     "p2p.shard.serve.disconnect",
     "rpc.brownout.slow",
     "rpc.hedge.lose",
@@ -92,6 +94,7 @@ KNOWN_FAILPOINTS = frozenset({
     "store.scrub.bitflip",
     "tracker.announce.empty",
     "tracker.announce.error",
+    "tracker.blackout",
 })
 
 

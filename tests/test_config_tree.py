@@ -28,6 +28,7 @@ CLI_KEYS = {
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
     "profiling", "fleet", "chunkstore", "slo", "canary", "ingest",
+    "pex",
 }
 
 
@@ -328,6 +329,49 @@ def test_canary_sections_construct_canary_config():
         assert cfg.ttl_seconds > cfg.interval_seconds, path
         seen += 1
     assert seen >= 1  # the agent registers the canary knobs
+
+
+def test_pex_sections_construct_pex_config():
+    """Every shipped `pex:` section must map onto PexConfig through the
+    same from_dict the CLI/assembly use -- a typo'd knob must fail here,
+    not at production boot. The shipped defaults ship the gossip plane
+    ON (receive AND send: a fleet that only listens never bootstraps
+    through a tracker outage) but with conservative send budgets, and
+    the peercache ON so restarts rejoin the swarm tracker-free."""
+    from kraken_tpu.p2p.pex import PexConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        pc = load_config(path).get("pex")
+        if pc is None:
+            continue
+        cfg = PexConfig.from_dict(pc)  # raises on unknown keys
+        assert cfg.enabled is True, (
+            f"{path}: shipped pex.enabled must stay ON (tracker-outage"
+            " survival is the point -- docs/OPERATIONS.md 'Tracker"
+            " outage survival')"
+        )
+        assert cfg.send_enabled is True, (
+            f"{path}: shipped pex.send_enabled must stay ON (a"
+            " receive-only fleet has nothing to receive)"
+        )
+        assert cfg.interval_seconds >= 10.0, (
+            f"{path}: shipped gossip cadence must stay modest (the"
+            " data-plane bands are measured with gossip on)"
+        )
+        assert 1 <= cfg.max_peers_per_message <= 64, (
+            f"{path}: shipped send budget must stay conservative"
+        )
+        assert cfg.dial_rate > 0 and cfg.dial_burst >= 1, path
+        assert cfg.seen_ttl_seconds > 0, path
+        assert cfg.max_known_peers >= 64, path
+        assert cfg.peercache is True, (
+            f"{path}: shipped peercache must stay ON (restart-survival"
+            " leg of the outage story)"
+        )
+        assert cfg.peercache_ttl_seconds > cfg.peercache_flush_seconds, path
+        seen += 1
+    assert seen >= 1  # the agent registers the pex knobs
 
 
 def test_ingest_sections_construct_ingest_config():

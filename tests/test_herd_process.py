@@ -310,6 +310,181 @@ def test_proxy_crash_resumes_upload_session(tmp_path):
         asyncio.run(drive())
 
 
+def test_all_trackers_sigkilled_mid_pull_pex_carries_the_swarm(tmp_path):
+    """ISSUE-18 acceptance chaos scenario: a REAL 3-tracker fleet (CLI
+    subprocesses) fronting an origin and two agents; every tracker is
+    SIGKILLed mid-pull. The in-flight pull must complete bit-identically
+    (the data plane + PEX gossip owe the tracker nothing), the outage
+    latch must engage, a fresh agent process must re-join the swarm from
+    its disk peercache + gossip with every tracker still dark, and when
+    the trackers restart announces resume and the latch clears on its
+    own."""
+    import socket
+
+    import yaml
+
+    def free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        return ports
+
+    ns = "pexherd"
+    with herd() as procs:
+
+        async def drive():
+            from kraken_tpu.assembly import AgentNode, OriginNode
+            from kraken_tpu.core.digest import Digest
+            from kraken_tpu.origin.client import BlobClient
+            from kraken_tpu.origin.metainfogen import PieceLengthConfig
+            from kraken_tpu.p2p.scheduler import SchedulerConfig
+            from kraken_tpu.placement.healthcheck import PassiveFilter
+            from kraken_tpu.utils.httputil import HTTPClient
+
+            ports = free_ports(3)
+            fleet = ",".join(f"127.0.0.1:{p}" for p in ports)
+            origin = OriginNode(
+                store_root=str(tmp_path / "origin"), tracker_addr=fleet,
+                piece_lengths=PieceLengthConfig(table=((0, 65536),)),
+            )
+            await origin.start()
+
+            def spawn_trackers():
+                out = []
+                for p in ports:
+                    t, _ = spawn([
+                        "tracker", "--port", str(p),
+                        "--origins", origin.addr,
+                        "--fleet", fleet, "--self-addr", f"127.0.0.1:{p}",
+                    ])
+                    procs.append(t)
+                    out.append(t)
+                return out
+
+            trackers = await asyncio.to_thread(spawn_trackers)
+
+            def fast_breakers(node):
+                # Default tracker breakers cool down for 30 s -- fine in
+                # production, glacial in CI. The cooldown must still
+                # EXCEED the ~1 s announce cadence or the breakers cool
+                # off between walks and "all open-and-cooling" (the
+                # latch condition) never holds.
+                node._tracker_client.health = PassiveFilter(
+                    fail_threshold=2, cooldown_seconds=5.0,
+                    max_cooldown_seconds=8.0,
+                )
+
+            def mk_agent(name):
+                return AgentNode(
+                    store_root=str(tmp_path / name), tracker_addr=fleet,
+                    scheduler_config=SchedulerConfig(
+                        announce_interval_seconds=0.4,
+                        retry_tick_seconds=0.3,
+                        dial_timeout_seconds=2.0,
+                    ),
+                    pex={"interval_seconds": 1.0, "jitter": 0.0,
+                         "dial_rate": 100.0, "dial_burst": 100.0},
+                    # Throttled so the tracker massacre lands MID-pull.
+                    p2p_bandwidth={"ingress_bps": 250_000, "egress_bps": 0},
+                )
+
+            agent1 = mk_agent("agent1")
+            await agent1.start()
+            fast_breakers(agent1)
+            agent2 = mk_agent("agent2")
+            await agent2.start()
+            fast_breakers(agent2)
+            http = HTTPClient(timeout_seconds=120.0)
+            try:
+                blob = os.urandom(1_200_000)
+                d = Digest.from_bytes(blob)
+                oc = BlobClient(origin.addr)
+                await oc.upload(ns, d, blob, chunk_size=400_000)
+                await oc.close()
+
+                async def pull(agent):
+                    return await http.get(
+                        f"http://{agent.addr}/namespace/{ns}/blobs/{d.hex}"
+                    )
+
+                pull1 = asyncio.create_task(pull(agent1))
+                pull2 = asyncio.create_task(pull(agent2))
+                # Both pulls engaged: metainfo fetched, peers dialing,
+                # agent2's peer book non-empty (that book is what the
+                # peercache persists).
+                deadline = asyncio.get_running_loop().time() + 20
+                while True:
+                    assert asyncio.get_running_loop().time() < deadline
+                    assert not pull1.done() and not pull2.done()
+                    ctls = list(agent2.scheduler._controls.values())
+                    if ctls and ctls[0].known_peers.snapshot():
+                        break
+                    await asyncio.sleep(0.05)
+
+                # Agent2 "crashes" mid-pull (its stop-path peercache
+                # flush is the same doc the periodic flusher writes).
+                pull2.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await pull2
+                await agent2.stop()
+                assert os.path.exists(
+                    str(tmp_path / "agent2" / "peercache.json")
+                )
+
+                # THE massacre: every tracker SIGKILLed, no drain.
+                for t in trackers:
+                    t.kill()
+                for t in trackers:
+                    t.wait(timeout=10)
+                    procs.remove(t)
+
+                # The in-flight pull completes bit-identically.
+                got = await asyncio.wait_for(pull1, timeout=90)
+                assert got == blob
+
+                # The outage latch engages (all breakers open) on the
+                # agent that keeps announcing into the dark.
+                deadline = asyncio.get_running_loop().time() + 30
+                while not agent1._tracker_client.outage:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "outage latch never engaged"
+                    )
+                    await asyncio.sleep(0.2)
+
+                # Fresh agent process, same store, every tracker still
+                # dark: metainfo + dial set come from the disk peercache,
+                # gossip with the live swarm does the rest.
+                agent2b = mk_agent("agent2")
+                await agent2b.start()
+                fast_breakers(agent2b)
+                try:
+                    got2 = await asyncio.wait_for(pull(agent2b), timeout=90)
+                    assert got2 == blob
+                finally:
+                    await agent2b.stop()
+
+                # Trackers return on the SAME addresses: announces
+                # resume (the post-cooldown walk is the probe) and the
+                # latch clears without intervention.
+                trackers2 = await asyncio.to_thread(spawn_trackers)
+                assert len(trackers2) == 3
+                deadline = asyncio.get_running_loop().time() + 60
+                while agent1._tracker_client.outage:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "outage latch never cleared after tracker restart"
+                    )
+                    await asyncio.sleep(0.2)
+            finally:
+                await http.close()
+                await agent1.stop()
+                await origin.stop()
+
+        asyncio.run(drive())
+
+
 def test_scrub_and_locate_tools(tmp_path):
     """Operator tools: `scrub` re-hashes every cached blob (exit 1 +
     corrupt-event line on bit rot), `locate` answers ring placement

@@ -1,0 +1,555 @@
+"""Gossip peer-exchange plane tests (p2p/pex.py + scheduler wiring).
+
+Property tests over the book/dedup/cache primitives, wire framing for
+the PEER_EXCHANGE frame, and in-process swarm tests proving the defense
+model: gossip discovers peers the tracker never handed out, a
+blacklisted peer gossiped back in stays banned, an addr-flooding sender
+is banned outright, and the disk peercache redials a swarm across a
+restart with the tracker dark.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.core.metainfo import MetaInfo
+from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.p2p.pex import (
+    MAX_ENTRIES_PER_MESSAGE,
+    KnownPeers,
+    PeerCache,
+    PexConfig,
+    PexManager,
+)
+from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
+from kraken_tpu.p2p.storage import (
+    AgentTorrentArchive,
+    BatchedVerifier,
+    OriginTorrentArchive,
+)
+from kraken_tpu.p2p.wire import Message, MsgType, recv_message, send_message
+from kraken_tpu.store import CAStore
+from kraken_tpu.utils import failpoints
+
+from tests.test_swarm import make_metainfo
+
+NS = "pex-ns"
+
+
+def pid(i: int) -> PeerID:
+    return PeerID((bytes([i]) * 20).hex())
+
+
+def info(i: int, port: int = 7000, origin: bool = False) -> PeerInfo:
+    return PeerInfo(pid(i), f"10.0.0.{i}", port, origin=origin)
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_pex_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown pex config keys"):
+        PexConfig.from_dict({"interval_secnods": 10.0})
+    cfg = PexConfig.from_dict(None)
+    assert cfg.enabled and cfg.send_enabled and cfg.peercache
+
+
+# -- wire framing ------------------------------------------------------------
+
+
+def test_peer_exchange_frame_roundtrip():
+    """The PEX frame survives the real wire: header intact, type routed,
+    and the empty-payload shape (it is pure header) holds."""
+    async def main():
+        got = []
+
+        async def handler(reader, writer):
+            got.append(await recv_message(reader))
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        _, writer = await asyncio.open_connection("127.0.0.1", port)
+        added = [{"id": pid(1).hex, "ip": "10.0.0.1", "p": 7001, "o": True}]
+        await send_message(writer, Message.peer_exchange(added, [pid(2).hex]))
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+
+        (m,) = got
+        assert m.type == MsgType.PEER_EXCHANGE
+        assert m.header == {"a": added, "d": [pid(2).hex]}
+        assert m.payload == b""
+
+    asyncio.run(main())
+
+
+def test_handshake_carries_listen_port():
+    m = Message.handshake("ab" * 20, "cd" * 32, "ef" * 32, "ns", b"\x01", 8,
+                          listen_port=7612)
+    assert m.header["lp"] == 7612
+    # Omitted when zero: older peers' handshakes decode identically.
+    m0 = Message.handshake("ab" * 20, "cd" * 32, "ef" * 32, "ns", b"\x01", 8)
+    assert "lp" not in m0.header
+
+
+# -- receive validation + dedup ----------------------------------------------
+
+
+def test_ingest_flood_is_a_protocol_violation():
+    mgr = PexManager(PexConfig())
+    added = [
+        {"id": (bytes([i % 251 + 1]) * 20).hex()[:40], "ip": "10.0.0.1",
+         "p": 7000}
+        for i in range(MAX_ENTRIES_PER_MESSAGE + 1)
+    ]
+    with pytest.raises(ValueError, match="pex flood"):
+        mgr.ingest("ab" * 32, pid(9), {"a": added, "d": []}, now=0.0)
+
+
+@pytest.mark.parametrize("header", [
+    {"a": "nope", "d": []},
+    {"a": [], "d": "nope"},
+    {"a": [42], "d": []},
+    {"a": [{"id": "zz" * 20, "ip": "x", "p": 1}], "d": []},  # bad hex
+    {"a": [{"id": "ab" * 20, "ip": "", "p": 1}], "d": []},   # empty ip
+    {"a": [{"id": "ab" * 20, "ip": "x", "p": 0}], "d": []},  # bad port
+    {"a": [{"id": "ab" * 20, "ip": "x", "p": 70000}], "d": []},
+    {"a": [{"ip": "x", "p": 1}], "d": []},                   # missing id
+    {"a": [], "d": [17]},                                    # non-str drop
+    {"a": [], "d": ["zz"]},                                  # bad drop hex
+])
+def test_ingest_garbage_raises_for_the_ban_path(header):
+    mgr = PexManager(PexConfig())
+    with pytest.raises(ValueError):
+        mgr.ingest("ab" * 32, pid(9), header, now=0.0)
+
+
+def test_ingest_dedup_ttl():
+    """The same addr gossiped twice inside the TTL is absorbed once;
+    past the TTL it is fresh again (and per-torrent: the same addr on a
+    different swarm is independent)."""
+    mgr = PexManager(PexConfig(seen_ttl_seconds=10.0))
+    entry = {"id": pid(1).hex, "ip": "10.0.0.1", "p": 7001}
+    h1, h2 = "aa" * 32, "bb" * 32
+    fresh, _ = mgr.ingest(h1, pid(9), {"a": [entry], "d": []}, now=0.0)
+    assert len(fresh) == 1
+    fresh, _ = mgr.ingest(h1, pid(8), {"a": [entry], "d": []}, now=5.0)
+    assert fresh == []  # different sender, same addr: still deduped
+    fresh, _ = mgr.ingest(h2, pid(8), {"a": [entry], "d": []}, now=5.0)
+    assert len(fresh) == 1  # other torrent: independent book
+    fresh, _ = mgr.ingest(h1, pid(9), {"a": [entry], "d": []}, now=10.5)
+    assert len(fresh) == 1  # TTL expired: fresh again
+
+
+def test_dial_budget_sheds_over_burst():
+    mgr = PexManager(PexConfig(dial_rate=1000.0, dial_burst=3.0))
+    grants = sum(1 for _ in range(10) if mgr.try_dial_budget())
+    assert grants == 3
+
+
+# -- known-peers book --------------------------------------------------------
+
+
+def test_known_peers_provenance_scoped_drop():
+    """A sender can only retract entries IT gossiped: gossip must not
+    evict tracker/handshake knowledge, nor another sender's entries."""
+    book = KnownPeers(cap=16)
+    book.add(info(1), "tracker")
+    book.add(info(2), "gossip:" + pid(8).hex)
+    book.add(info(3), "gossip:" + pid(9).hex)
+    evil = "gossip:" + pid(9).hex
+    book.drop(pid(1), evil)  # tracker entry: untouchable
+    book.drop(pid(2), evil)  # another sender's entry: untouchable
+    book.drop(pid(3), evil)  # its own entry: retracted
+    left = {p.peer_id for p in book.snapshot()}
+    assert left == {pid(1), pid(2)}
+    # discard (our own dial failed) is unconditional.
+    book.discard(pid(1))
+    assert {p.peer_id for p in book.snapshot()} == {pid(2)}
+
+
+def test_known_peers_authoritative_overwrites_gossip_not_vice_versa():
+    book = KnownPeers(cap=16)
+    book.add(info(1, port=7001), "tracker")
+    # Gossip cannot "move" a tracker-recorded addr...
+    book.add(info(1, port=9999), "gossip:" + pid(9).hex)
+    assert book.snapshot()[0].port == 7001
+    # ...but a live handshake can (the peer proved the addr itself).
+    book.add(info(1, port=7002), "conn")
+    assert book.snapshot()[0].port == 7002
+
+
+def test_known_peers_cap_gossip_cannot_evict_authoritative():
+    book = KnownPeers(cap=2)
+    book.add(info(1), "tracker")
+    book.add(info(2), "conn")
+    assert not book.add(info(3), "gossip:" + pid(9).hex)  # full: refused
+    assert len(book) == 2
+    # An authoritative add evicts a gossip entry, never the reverse.
+    book2 = KnownPeers(cap=2)
+    book2.add(info(1), "gossip:" + pid(9).hex)
+    book2.add(info(2), "tracker")
+    assert book2.add(info(3), "tracker")
+    assert {p.peer_id for p in book2.snapshot()} == {pid(2), pid(3)}
+
+
+# -- send deltas -------------------------------------------------------------
+
+
+def test_delta_for_budget_recipient_exclusion_and_drops():
+    mgr = PexManager(PexConfig(max_peers_per_message=2))
+    peers = [info(i) for i in range(1, 6)]
+    added, dropped = mgr.delta_for("c1", pid(3), peers)
+    assert len(added) == 2  # budget capped
+    assert all(e["id"] != pid(3).hex for e in added)  # never echo recipient
+    # Next tick says only what is NEW on this conn...
+    added2, _ = mgr.delta_for("c1", pid(3), peers)
+    assert {e["id"] for e in added2}.isdisjoint({e["id"] for e in added})
+    # ...and retracts what left the book.
+    sent = {e["id"] for e in added} | {e["id"] for e in added2}
+    _, dropped3 = mgr.delta_for("c1", pid(3), [info(1)])
+    assert set(dropped3) == sent - {pid(1).hex}
+    # A fresh conn key starts from zero; forget_conn resets it.
+    added_c2, _ = mgr.delta_for("c2", pid(3), peers)
+    assert len(added_c2) == 2
+    mgr.forget_conn("c1")
+    added_again, _ = mgr.delta_for("c1", pid(3), [info(1)])
+    assert [e["id"] for e in added_again] == [pid(1).hex]
+
+
+# -- peercache ---------------------------------------------------------------
+
+
+def _cache_doc(mi: MetaInfo, peers):
+    return {
+        mi.info_hash.hex: {
+            "namespace": NS,
+            "metainfo": mi.serialize().decode(),
+            "peers": peers,
+        }
+    }
+
+
+def test_peercache_roundtrip_and_ttl(tmp_path):
+    path = str(tmp_path / "sub" / "peercache.json")  # dir is created
+    cache = PeerCache(path, ttl_seconds=100.0)
+    mi = make_metainfo(b"x" * 10000)
+    cache.save(_cache_doc(mi, [info(1), info(2, origin=True)]), now=1000.0)
+    loaded = cache.load(now=1050.0)
+    rec = loaded[mi.info_hash.hex]
+    assert rec["namespace"] == NS
+    assert MetaInfo.deserialize(rec["metainfo"].encode()).digest == mi.digest
+    assert [p.peer_id for p in rec["peers"]] == [pid(1), pid(2)]
+    assert rec["peers"][1].origin is True
+    # TTL-aged out entirely past the horizon.
+    assert cache.load(now=1101.0) == {}
+    # Carried saved_at survives a re-save: merged-forward records keep
+    # aging on their ORIGINAL clock instead of living forever.
+    cache.save(loaded, now=1090.0)
+    assert cache.load(now=1101.0) == {}
+
+
+def test_peercache_crash_shapes_load_empty(tmp_path):
+    path = str(tmp_path / "peercache.json")
+    assert PeerCache(path).load() == {}  # missing file
+    with open(path, "w") as f:
+        f.write('{"v": 1, "torrents"')  # torn mid-write (no tmp+rename)
+    assert PeerCache(path).load() == {}
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 999, "torrents": {}}))  # future version
+    assert PeerCache(path).load() == {}
+    # A torn .tmp beside a good file is ignored debris.
+    cache = PeerCache(path, ttl_seconds=100.0)
+    mi = make_metainfo(b"y" * 5000)
+    cache.save(_cache_doc(mi, [info(1)]), now=0.0)
+    with open(path + ".tmp", "w") as f:
+        f.write('{"v": 1, "torr')
+    assert mi.info_hash.hex in cache.load(now=1.0)
+
+
+def test_peercache_one_torn_record_spares_the_rest(tmp_path):
+    path = str(tmp_path / "peercache.json")
+    cache = PeerCache(path, ttl_seconds=100.0)
+    mi = make_metainfo(b"z" * 5000)
+    cache.save(_cache_doc(mi, [info(1)]), now=0.0)
+    doc = json.load(open(path))
+    doc["torrents"]["ff" * 32] = {"namespace": 1}  # malformed sibling
+    doc["torrents"]["ee" * 32] = "not-a-map"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    loaded = cache.load(now=1.0)
+    assert set(loaded) == {mi.info_hash.hex}
+
+
+# -- swarm integration -------------------------------------------------------
+
+
+def _fast_pex(**over) -> PexConfig:
+    kw = dict(interval_seconds=1.0, jitter=0.0, seen_ttl_seconds=60.0,
+              dial_rate=100.0, dial_burst=100.0)
+    kw.update(over)
+    return PexConfig(**kw)
+
+
+def _mk_sched(tmp_path, name, client, seed_blob=None, pex=None,
+              peercache_path=None):
+    store = CAStore(str(tmp_path / name))
+    verifier = BatchedVerifier()
+    if seed_blob is not None:
+        d = Digest.from_bytes(seed_blob)
+        store.create_cache_file(d, iter([seed_blob]))
+        archive = OriginTorrentArchive(store, verifier)
+    else:
+        archive = AgentTorrentArchive(store, verifier)
+    sched = Scheduler(
+        peer_id=PeerID(os.urandom(20).hex()),
+        ip="127.0.0.1",
+        port=0,
+        archive=archive,
+        metainfo_client=client,
+        announce_client=client,
+        config=SchedulerConfig(
+            announce_interval_seconds=0.1,
+            retry_tick_seconds=0.2,
+            dial_timeout_seconds=2.0,
+        ),
+        pex=pex or _fast_pex(),
+        peercache_path=peercache_path,
+    )
+    return sched, store
+
+
+class _ScriptedClient:
+    """Announce returns a FIXED handout (closures resolve ports after
+    bind); metainfo always serves. The tracker never learns -- gossip
+    must carry anything beyond the script."""
+
+    def __init__(self, mi: MetaInfo, handout_fn):
+        self.mi = mi
+        self.handout_fn = handout_fn
+
+    async def get(self, namespace, d):
+        return self.mi
+
+    async def announce(self, d, h, namespace, complete):
+        return self.handout_fn(), 0.2
+
+
+def test_gossip_discovers_peers_the_tracker_never_handed_out(tmp_path):
+    """Leecher B's tracker handout contains ONLY leecher A -- never the
+    seeder. B must still converge bit-identically: A gossips the
+    seeder's (listen-port-carrying) record over the B<->A conn and B
+    dials it through the normal gates."""
+    async def main():
+        blob = os.urandom(120_000)
+        mi = make_metainfo(blob)
+        seeder, _ = _mk_sched(
+            tmp_path, "seeder", _ScriptedClient(mi, lambda: []),
+            seed_blob=blob,
+        )
+        refs = {}
+        a_client = _ScriptedClient(
+            mi, lambda: [PeerInfo(seeder.peer_id, "127.0.0.1", seeder.port,
+                                  origin=True)]
+        )
+        a, _ = _mk_sched(tmp_path, "a", a_client)
+        b_client = _ScriptedClient(
+            mi, lambda: [PeerInfo(a.peer_id, "127.0.0.1", refs["a_port"])]
+        )
+        b, bstore = _mk_sched(tmp_path, "b", b_client)
+        for s in (seeder, a, b):
+            await s.start()
+        refs["a_port"] = a.port
+        try:
+            seeder.seed(mi, NS)
+            await asyncio.wait_for(
+                asyncio.gather(b.download(NS, mi.digest),
+                               a.download(NS, mi.digest)),
+                30,
+            )
+            assert bstore.read_cache_file(mi.digest) == blob
+        finally:
+            for s in (seeder, a, b):
+                await s.stop()
+
+    asyncio.run(main())
+
+
+def test_blacklisted_peer_gossiped_back_stays_banned(tmp_path):
+    """The connstate blacklist outranks gossip: a banned peer's addr
+    arriving in a PEX frame must not produce a dial, while a clean addr
+    in the same frame does."""
+    async def main():
+        blob = os.urandom(20_000)
+        mi = make_metainfo(blob)
+        s, _ = _mk_sched(tmp_path, "s", _ScriptedClient(mi, lambda: []))
+        await s.start()
+        try:
+            task = asyncio.create_task(s.download(NS, mi.digest))
+            await asyncio.sleep(0.2)  # control exists, no peers to dial
+            h = mi.info_hash
+            banned, clean, sender = pid(1), pid(2), pid(9)
+            s.conn_state.blacklist.add(banned, h)
+            s._on_pex(sender, h, {"a": [
+                {"id": banned.hex, "ip": "127.0.0.1", "p": 1},
+                {"id": clean.hex, "ip": "127.0.0.1", "p": 1},
+            ], "d": []})
+            pending = s.conn_state._pending.get(h, set())
+            assert clean in pending
+            assert banned not in pending
+            task.cancel()
+        finally:
+            await s.stop()
+
+    asyncio.run(main())
+
+
+def test_pex_flood_gets_the_sender_banned(tmp_path):
+    """p2p.pex.flood failpoint: a sender ignoring the send budget ships
+    MAX_ENTRIES_PER_MESSAGE+1 entries; the receiver's ingest raises,
+    the dispatcher's ban path blacklists the sender and closes the
+    conn -- the addr-flood cannot balloon the dial queue."""
+    async def main():
+        blob = os.urandom(400_000)
+        mi = make_metainfo(blob)
+        seeder, _ = _mk_sched(
+            tmp_path, "seeder", _ScriptedClient(mi, lambda: []),
+            seed_blob=blob, pex=_fast_pex(),
+        )
+        l_client = _ScriptedClient(
+            mi, lambda: [PeerInfo(seeder.peer_id, "127.0.0.1", seeder.port,
+                                  origin=True)]
+        )
+        leecher, _ = _mk_sched(tmp_path, "leecher", l_client,
+                               pex=_fast_pex())
+        await seeder.start()
+        await leecher.start()
+        try:
+            seeder.seed(mi, NS)
+            task = asyncio.create_task(leecher.download(NS, mi.digest))
+            # Wait for the conn, then arm the flood: the next gossip
+            # tick from either side ships the oversized frame.
+            deadline = asyncio.get_running_loop().time() + 10
+            while not leecher.conn_state.num_active(mi.info_hash):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            failpoints.FAILPOINTS.disarm_all()
+            failpoints.allow()
+            failpoints.FAILPOINTS.arm("p2p.pex.flood", "always")
+            h = mi.info_hash
+            deadline = asyncio.get_running_loop().time() + 15
+            while not (
+                leecher.conn_state.blacklist.blocked(seeder.peer_id, h)
+                or seeder.conn_state.blacklist.blocked(leecher.peer_id, h)
+            ):
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "no side banned its flooding peer"
+                )
+                await asyncio.sleep(0.1)
+            task.cancel()
+        finally:
+            failpoints.FAILPOINTS.disarm_all()
+            failpoints.allow(False)
+            await seeder.stop()
+            await leecher.stop()
+
+    asyncio.run(main())
+
+
+def test_peercache_restart_redials_with_tracker_dark(tmp_path):
+    """The restart leg of the outage story: an agent mid-pull flushes
+    its peercache, restarts, and -- with every tracker RPC failing --
+    re-fetches metainfo from the cache, redials the cached seeder, and
+    completes bit-identically."""
+    async def main():
+        blob = os.urandom(150_000)
+        mi = make_metainfo(blob)
+        cache_path = str(tmp_path / "l" / "peercache.json")
+        seeder, _ = _mk_sched(
+            tmp_path, "seeder", _ScriptedClient(mi, lambda: []),
+            seed_blob=blob,
+        )
+        await seeder.start()
+        seeder.seed(mi, NS)
+
+        class _DarkClient:
+            async def get(self, namespace, d):
+                raise ConnectionError("tracker outage")
+
+            async def announce(self, d, h, namespace, complete):
+                raise ConnectionError("tracker outage")
+
+        try:
+            # Incarnation 1: tracker alive, book holds the seeder, then
+            # the node "crashes" MID-PULL -- the stop-path flush keeps
+            # incomplete torrents (a completed pull would age out of the
+            # cache by design; the store serves it after restart).
+            l_client = _ScriptedClient(
+                mi, lambda: [PeerInfo(seeder.peer_id, "127.0.0.1",
+                                      seeder.port, origin=True)]
+            )
+            l1, _ = _mk_sched(tmp_path, "l1", l_client,
+                              peercache_path=cache_path)
+            await l1.start()
+            dl = asyncio.create_task(l1.download(NS, mi.digest))
+            h = mi.info_hash
+            deadline = asyncio.get_running_loop().time() + 10
+            while not l1._controls.get(h) or not l1._controls[h].known_peers.snapshot():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            dl.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await dl
+            await l1.stop()
+            assert os.path.exists(cache_path)
+
+            # Incarnation 2: fresh store, tracker DARK. The peercache
+            # serves metainfo AND the dial set.
+            l2, l2store = _mk_sched(tmp_path, "l2", _DarkClient(),
+                                    peercache_path=cache_path)
+            await l2.start()
+            await asyncio.wait_for(l2.download(NS, mi.digest), 20)
+            assert l2store.read_cache_file(mi.digest) == blob
+            await l2.stop()
+
+            # Without a peercache the same dark-tracker pull fails
+            # TYPED at the metainfo fetch (the pre-PEX contract).
+            l3, _ = _mk_sched(tmp_path, "l3", _DarkClient())
+            await l3.start()
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(l3.download(NS, mi.digest), 10)
+            await l3.stop()
+        finally:
+            await seeder.stop()
+
+    asyncio.run(main())
+
+
+def test_reload_pex_swaps_knobs_live(tmp_path):
+    async def main():
+        blob = os.urandom(10_000)
+        mi = make_metainfo(blob)
+        s, _ = _mk_sched(tmp_path, "s", _ScriptedClient(mi, lambda: []))
+        await s.start()
+        try:
+            s.reload_pex(PexConfig(enabled=False, send_enabled=False))
+            assert s.pex_config.enabled is False
+            assert s._pex.config.send_enabled is False
+            # Receive path now drops gossip without dialing.
+            task = asyncio.create_task(s.download(NS, mi.digest))
+            await asyncio.sleep(0.2)
+            h = mi.info_hash
+            s._on_pex(pid(9), h, {"a": [
+                {"id": pid(1).hex, "ip": "127.0.0.1", "p": 1},
+            ], "d": []})
+            assert not s.conn_state._pending.get(h, set())
+            task.cancel()
+        finally:
+            await s.stop()
+
+    asyncio.run(main())

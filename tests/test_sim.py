@@ -168,6 +168,52 @@ def test_tracker_fleet_band_30k_kill_one_of_three():
     assert killed["announce_p99_s"] <= control["announce_p99_s"] * 3.0
 
 
+def test_tracker_blackout_band_1k_kill_all_with_pex():
+    """CI band for the gossip plane (ISSUE 18 acceptance): 1k agents, 3
+    trackers, ALL of them killed mid-run with PEX on. The announce plane
+    flatlines (every walk exhausts the fleet) yet >= 99% of in-flight
+    pulls must still complete -- gossip over existing conns plus
+    book-driven redials are the only discovery left. Banded against the
+    same-seed no-kill control; deterministic per (seed, config)."""
+    kw = dict(n_agents=1000, num_pieces=64, seed=0, n_trackers=3, pex=True)
+    control = run_sim(**kw)
+    killed = run_sim(**kw, tracker_kill_at_s=3.0, tracker_kill_all=True)
+    assert control["completed"] == 1000
+    assert killed["tracker_kills"] == 3
+    assert killed["announce_failures"] > 0  # the blackout was total
+    assert killed["pex_messages"] > 0
+    # THE band: >= 99% of pulls complete through total tracker loss.
+    assert killed["completed"] >= 0.99 * control["completed"], (
+        killed["completed"], control["completed"],
+    )
+    # And completion stays in family (gossip discovery is slower than a
+    # live tracker's handouts, but must not wedge the tail).
+    assert killed["p99_s"] <= control["p99_s"] * 3.0, (
+        killed["p99_s"], control["p99_s"],
+    )
+
+
+def test_tracker_blackout_without_pex_strands_the_swarm():
+    """The control for the control: the SAME total blackout with gossip
+    OFF must strand most of the swarm -- proving the band above measures
+    PEX, not some other slack in the model."""
+    kw = dict(n_agents=200, num_pieces=32, seed=0, n_trackers=3,
+              max_sim_s=120.0)
+    stranded = run_sim(**kw, tracker_kill_at_s=1.0, tracker_kill_all=True)
+    rescued = run_sim(**kw, tracker_kill_at_s=1.0, tracker_kill_all=True,
+                      pex=True, pex_interval_s=2.0)
+    assert stranded["completed"] < 0.25 * 200
+    assert rescued["completed"] == 200
+
+
+def test_pex_mode_same_seed_replays_exactly():
+    """Determinism holds with gossip + kill-all on (the band above is a
+    band, not a flake)."""
+    kw = dict(n_agents=150, num_pieces=16, seed=3, n_trackers=3, pex=True,
+              tracker_kill_at_s=1.0, tracker_kill_all=True)
+    assert run_sim(**kw) == run_sim(**kw)
+
+
 def test_1k_regression_band():
     """CI regression gate (VERDICT r4 #8): p99 at 1k agents stays within
     +/-5% of the recorded golden (12.43 s, round 5; cross-seed spread

@@ -304,6 +304,89 @@ def test_announce_rate_bounded_at_scale(tmp_path):
     asyncio.run(main())
 
 
+def test_announce_inflight_capped_when_tracker_hangs(tmp_path):
+    """Total-outage announce storm control: with every walk hanging to
+    its timeout, at most max_announce_inflight walks may be in flight
+    per agent -- the rate cap only bounds STARTS, so without this cap N
+    failing torrents stack N hung walks. When the walks finally resolve
+    the pump must resume, and the per-torrent decorrelated-jitter
+    backoffs must desync (no synchronized retry storm at revival)."""
+
+    async def main():
+        inflight = {"now": 0, "peak": 0, "total": 0}
+        gate = asyncio.Event()
+
+        class HangingClient:
+            async def get(self, namespace, d):
+                raise AssertionError("not used")
+
+            async def announce(self, d, h, namespace, complete):
+                inflight["now"] += 1
+                inflight["total"] += 1
+                inflight["peak"] = max(inflight["peak"], inflight["now"])
+                try:
+                    await gate.wait()
+                finally:
+                    inflight["now"] -= 1
+                raise ConnectionError("tracker dark")
+
+        store = CAStore(str(tmp_path / "s"))
+        client = HangingClient()
+        sched = Scheduler(
+            peer_id=PeerID(os.urandom(20).hex()),
+            ip="127.0.0.1",
+            port=0,
+            archive=OriginTorrentArchive(store, BatchedVerifier()),
+            metainfo_client=client,
+            announce_client=client,
+            config=SchedulerConfig(
+                announce_interval_seconds=0.05,
+                # Long enough that the backoff cap (= interval) leaves
+                # the jitter draw room to spread; the FIRST failure's
+                # backoff is deterministically base=1.0 s, divergence
+                # shows from the second failure on.
+                seed_announce_interval_seconds=10.0,
+                max_announce_rate=1000.0,
+                announce_tick_seconds=0.02,
+                max_announce_inflight=8,
+            ),
+        )
+        await sched.start()
+        try:
+            rng = np.random.default_rng(4)
+            for i in range(100):
+                blob = rng.integers(0, 256, size=64, dtype=np.uint8).tobytes()
+                d = Digest.from_bytes(blob + i.to_bytes(4, "big"))
+                mi = MetaInfo(d, 64, 4096, b"\x00" * 32)
+                store.create_cache_file(d, iter([blob]), verify=False)
+                sched.seed(mi, NS)
+            await asyncio.sleep(1.0)
+            # The cap held AND saturated: bounded, not stalled.
+            assert inflight["peak"] <= 8, inflight
+            assert inflight["now"] == 8, inflight
+            # Walks resolve (all failing): the pump works through the
+            # backlog instead of staying wedged at the first 8...
+            gate.set()
+            await asyncio.sleep(0.6)
+            assert inflight["total"] >= 30, inflight
+            # ...and after a SECOND failure round (first backoff is the
+            # deterministic 1.0 s base; retries land ~1 s later) the
+            # per-torrent backoffs are jittered apart, not synchronized
+            # into one storm.
+            await asyncio.sleep(1.6)
+            backoffs = {
+                round(ctl.announce_backoff, 6)
+                for ctl in sched._controls.values()
+                if ctl.announce_backoff > 1.0001
+            }
+            assert len(backoffs) >= 10, sorted(backoffs)[:20]
+        finally:
+            gate.set()
+            await sched.stop()
+
+    asyncio.run(main())
+
+
 def test_seeder_dies_mid_pull_then_returns(tmp_path):
     """The only seeder dies mid-transfer; the leecher's request timeouts +
     retry ticks keep the torrent alive, and when a seeder returns on the

@@ -433,6 +433,154 @@ def test_tracker_lameduck_drains_and_fleet_routes_around(tmp_path):
     asyncio.run(main())
 
 
+# -- total-outage latch (PEX plane) ------------------------------------------
+
+
+def _dead_fleet(monkeypatch, addrs, calls, **kw):
+    """Fleet client whose every sub-client RPC is a refused socket."""
+    from kraken_tpu.placement.healthcheck import PassiveFilter
+
+    async def dead_announce(self, d, ih, namespace, complete, deadline=None):
+        calls.append(self.addr)
+        raise ConnectionError("connection refused")
+
+    monkeypatch.setattr(TrackerClient, "announce", dead_announce)
+    kw.setdefault(
+        "health",
+        PassiveFilter(fail_threshold=1,
+                      cooldown_seconds=kw.pop("cooldown", 30.0)),
+    )
+    return _fleet_client(addrs, **kw)
+
+
+def test_outage_latch_engages_and_fail_fasts(monkeypatch):
+    """Every tracker breaker-open: the latch engages (gauge + counter +
+    typed error) and subsequent announces fail FAST -- zero sub-client
+    calls, not another full-budget walk over sockets already known
+    dark."""
+
+    async def main():
+        calls = []
+        client = _dead_fleet(monkeypatch, ["a:1", "b:2", "c:3"], calls)
+        outages = REGISTRY.counter("tracker_outages_total")
+        before = outages.value()
+        h = InfoHash("ab" * 32)
+        try:
+            assert client.outage is False
+            # Walk 1 burns the fleet: every addr fails once, every
+            # breaker opens (threshold 1).
+            with pytest.raises(ConnectionError):
+                await client.announce(None, h, NS, complete=False)
+            assert len(calls) == 3
+            # Walk 2 hits the gate: latch engages, typed error, NO calls.
+            with pytest.raises(ConnectionError, match="fleet outage"):
+                await client.announce(None, h, NS, complete=False)
+            assert len(calls) == 3
+            assert client.outage is True
+            assert outages.value() == before + 1
+            assert REGISTRY.gauge("tracker_outage").value() == 1
+            # Steady-state outage: N more announces cost ZERO walks.
+            for _ in range(10):
+                with pytest.raises(ConnectionError, match="fleet outage"):
+                    await client.announce(None, h, NS, complete=False)
+            assert len(calls) == 3
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_outage_latch_clears_only_on_walk_success(monkeypatch):
+    """Hysteresis: a cooldown expiring re-admits the walk (the walk IS
+    the probe) but the latch clears only when a walk SUCCEEDS end to
+    end -- and the latched time lands on tracker_outage_seconds_total."""
+
+    async def main():
+        calls = []
+        alive = {"up": False}
+
+        async def flaky_announce(self, d, ih, namespace, complete,
+                                 deadline=None):
+            calls.append(self.addr)
+            if not alive["up"]:
+                raise ConnectionError("connection refused")
+            return [], 0.5
+
+        from kraken_tpu.placement.healthcheck import PassiveFilter
+
+        monkeypatch.setattr(TrackerClient, "announce", flaky_announce)
+        client = _fleet_client(
+            ["a:1", "b:2"],
+            health=PassiveFilter(fail_threshold=1, cooldown_seconds=0.15),
+        )
+        seconds = REGISTRY.counter("tracker_outage_seconds_total")
+        s0 = seconds.value()
+        h = InfoHash("cd" * 32)
+        try:
+            with pytest.raises(ConnectionError):
+                await client.announce(None, h, NS, complete=False)
+            with pytest.raises(ConnectionError, match="fleet outage"):
+                await client.announce(None, h, NS, complete=False)
+            assert client.outage is True
+            # Cooldown expires -> the gate passes -> the probe walk runs
+            # but still FAILS: latched it stays (no half-open flicker).
+            await asyncio.sleep(0.2)
+            n = len(calls)
+            with pytest.raises(ConnectionError):
+                await client.announce(None, h, NS, complete=False)
+            assert len(calls) > n  # a real walk ran (the probe)
+            assert client.outage is True
+            # Trackers come back; next post-cooldown walk succeeds and
+            # the latch clears with the outage time accrued. The failed
+            # probe re-opened the breakers with a LONGER jittered
+            # cooldown (<= 3x the base), so out-wait that.
+            alive["up"] = True
+            await asyncio.sleep(0.5)
+            peers, interval = await client.announce(
+                None, h, NS, complete=False
+            )
+            assert interval == 0.5
+            assert client.outage is False
+            assert REGISTRY.gauge("tracker_outage").value() == 0
+            assert seconds.value() - s0 >= 0.3
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_set_addrs_to_all_dead_membership_short_circuits(monkeypatch):
+    """The SIGHUP footgun: membership swapped to a fleet that is ENTIRELY
+    dark. One discovery walk per new addr set is fair; after the
+    breakers trip, repeated announces must ride the outage latch --
+    not spin full-budget failover walks against corpses."""
+
+    async def main():
+        calls = []
+        client = _dead_fleet(monkeypatch, ["a:1", "b:2"], calls)
+        h = InfoHash("ef" * 32)
+        try:
+            with pytest.raises(ConnectionError):
+                await client.announce(None, h, NS, complete=False)
+            with pytest.raises(ConnectionError, match="fleet outage"):
+                await client.announce(None, h, NS, complete=False)
+            assert client.outage is True
+            # Swap to a different -- equally dead -- membership. Fresh
+            # addrs mean fresh breakers: exactly ONE discovery walk may
+            # run, then the latch must re-engage.
+            client.set_addrs(["d:4", "e:5"])
+            n = len(calls)
+            for _ in range(10):
+                with pytest.raises(ConnectionError):
+                    await client.announce(None, h, NS, complete=False)
+            assert len(calls) - n == 2, calls[n:]  # one walk over d,e
+            assert client.outage is True  # latched straight through
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
 # -- the acceptance herd: 3 trackers + origin + agent, kill one mid-pull -----
 
 
