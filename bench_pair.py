@@ -52,7 +52,8 @@ from kraken_tpu.core.metainfo import MetaInfo
 
 
 async def run_pair(blob_mb: int, piece_kb: int, root: str,
-                   workers: int = 0, reset_profiler: bool = False) -> dict:
+                   workers: int = 0, leech_workers: int = 0,
+                   reset_profiler: bool = False) -> dict:
     rng = np.random.default_rng(0)
     blob = rng.integers(0, 256, size=blob_mb << 20, dtype=np.uint8).tobytes()
     d = Digest.from_bytes(blob)
@@ -64,7 +65,7 @@ async def run_pair(blob_mb: int, piece_kb: int, root: str,
     tracker.metainfos[d.hex] = metainfo
     origin = make_peer(root, "origin", tracker, seed_blobs=[blob],
                        data_plane_workers=workers)
-    agent = make_peer(root, "agent", tracker)
+    agent = make_peer(root, "agent", tracker, leech_workers=leech_workers)
     await origin.start()
     origin.seed(metainfo, NS)
     await agent.start()
@@ -107,6 +108,7 @@ async def run_pair(blob_mb: int, piece_kb: int, root: str,
         "piece_kb": piece_kb,
         "pieces": metainfo.num_pieces,
         "workers": workers,
+        "leech_workers": leech_workers,
         "wall_s": round(wall, 4),
         "goodput_mbps": round(len(blob) / wall / 1e6, 1),
         # Main-process CPU (both endpoints' loops + verify threads) and
@@ -335,7 +337,8 @@ def run_brownout(hedge_delay_s: float = 0.1, slow_s: float = 0.5,
 NS_BROWNOUT = "bench-brownout"
 
 
-def _run_repeats(args, knockout: bool, workers: int = 0) -> list[dict]:
+def _run_repeats(args, knockout: bool, workers: int = 0,
+                 leech_workers: int = 0) -> list[dict]:
     results = []
     for _ in range(args.repeats):
         with tempfile.TemporaryDirectory() as root:
@@ -346,7 +349,7 @@ def _run_repeats(args, knockout: bool, workers: int = 0) -> list[dict]:
             with ctx:
                 r = asyncio.run(
                     run_pair(args.blob_mb, args.piece_kb, root,
-                             workers=workers)
+                             workers=workers, leech_workers=leech_workers)
                 )
             if args.profile and not knockout:
                 prof.disable()
@@ -381,6 +384,38 @@ def run_workers_scaling(args) -> None:
         "workers0_min": g0[0], "workers0_max": g0[-1],
         "workers2_mbps": med(g2),
         "workers2_min": g2[0], "workers2_max": g2[-1],
+        "median_of": len(g0),
+        "speedup": round(med(g2) / med(g0), 3) if med(g0) else None,
+    }))
+
+
+def run_leech_workers_scaling(args) -> None:
+    """Round 19 headline row: pair goodput with the DOWNLOAD plane on
+    the main loop (leech_workers=0) vs pumped through 2 leech worker
+    processes (recv + frame parse + pwrite in forked shards, payloads
+    via the shared ring, verify batched in the parent) --
+    median±spread of ``--repeats`` runs each, same rig, same harness.
+    The leech half IS the pair's critical path, so unlike the seed-side
+    workers_scaling row this is where the multi-core download claim is
+    measured: >= 1.3x on a >= 2-core rig is the acceptance bar
+    (PERF.md "Leech shard plane"); on a 1-core rig expect ~1.0x -- the
+    pump and the verifier time-slice one core."""
+
+    def med(vals):
+        return statistics.median(sorted(vals))
+
+    r0 = _run_repeats(args, knockout=False, leech_workers=0)
+    r2 = _run_repeats(args, knockout=False, leech_workers=2)
+    g0 = sorted(r["goodput_mbps"] for r in r0)
+    g2 = sorted(r["goodput_mbps"] for r in r2)
+    print(json.dumps({
+        "metric": "leech_workers_scaling",
+        "unit": "MB/s",
+        "cores": os.cpu_count(),
+        "leech0_mbps": med(g0),
+        "leech0_min": g0[0], "leech0_max": g0[-1],
+        "leech2_mbps": med(g2),
+        "leech2_min": g2[0], "leech2_max": g2[-1],
         "median_of": len(g0),
         "speedup": round(med(g2) / med(g0), 3) if med(g0) else None,
     }))
@@ -797,6 +832,7 @@ def main() -> None:
         )
     if not args.skip_workers:
         run_workers_scaling(args)
+        run_leech_workers_scaling(args)
         run_seed_serve(args)
     if not args.skip_trace:
         run_trace_overhead(args)

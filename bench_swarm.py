@@ -86,7 +86,7 @@ class InMemoryTracker:
 
 
 def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256,
-              data_plane_workers=0):
+              data_plane_workers=0, leech_workers=0):
     from kraken_tpu.p2p.connstate import ConnStateConfig
 
     store = CAStore(os.path.join(root, name))
@@ -115,6 +115,9 @@ def make_peer(root, name, tracker, *, seed_blobs=None, piece_kb=256,
             # Multi-core seed-serve plane (p2p/shardpool.py): >0 forks
             # worker processes that serve seed conns via sendfile.
             data_plane_workers=data_plane_workers,
+            # Multi-core download plane: >0 forks pump workers that own
+            # active-download conns (recv + parse + pwrite off-loop).
+            leech_workers=leech_workers,
             # Origins are servers: a 10-conn cap on the only initial seeder
             # strangles the flash crowd's first wave.
             conn_state=ConnStateConfig(

@@ -1015,6 +1015,11 @@ class OriginNode:
             "max_global_conns": 4000,
             **(doc.pop("conn_state", None) or {}),
         }
+        # Origins never download (they ARE the initial seed), so a
+        # configured leech plane would only fork idle workers -- drop
+        # the knobs even if a shared yaml sets them.
+        doc.pop("leech_workers", None)
+        doc.pop("leech_ring_mb", None)
         return SchedulerConfig.from_dict({**doc, "conn_state": conn})
 
     def reload(self, cfg: dict) -> None:

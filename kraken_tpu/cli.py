@@ -465,6 +465,11 @@ def main(argv: list[str] | None = None) -> None:
                          help="seed-serve worker processes (overrides"
                               " scheduler.data_plane_workers); a completed"
                               " agent seeds its swarm off the download loop")
+    p_agent.add_argument("--leech-workers", type=int, default=None,
+                         help="download-pump worker processes (overrides"
+                              " scheduler.leech_workers); active downloads"
+                              " move their recv+parse+pwrite off the main"
+                              " loop, verify stays batched in the parent")
 
     p_bi = sub.add_parser("build-index")
     _common(p_bi)
@@ -838,6 +843,11 @@ def main(argv: list[str] | None = None) -> None:
     if getattr(args, "data_plane_workers", None) is not None:
         scheduler_cfg = dict(scheduler_cfg or {})
         scheduler_cfg["data_plane_workers"] = args.data_plane_workers
+    # Same shape for the download plane (docs/OPERATIONS.md "Leech
+    # workers"): ships 0 = off; flip on per-host without a config edit.
+    if getattr(args, "leech_workers", None) is not None:
+        scheduler_cfg = dict(scheduler_cfg or {})
+        scheduler_cfg["leech_workers"] = args.leech_workers
 
     # YAML: resources: {interval_seconds, max_open_fds, max_rss_mb,
     # max_tasks, max_bufpool_leased, max_conns, max_orphans,

@@ -31,6 +31,7 @@ from kraken_tpu.p2p.conn import (
     Conn,
     ConnClosedError,
     HandshakeResult,
+    LeechConnProxy,
     PeerBusyError,
     handshake_inbound,
     handshake_outbound,
@@ -110,6 +111,8 @@ class SchedulerConfig:
         wire_send_batch: int = 16,
         bufpool_budget_mb: int = 256,
         data_plane_workers: int = 0,
+        leech_workers: int = 0,
+        leech_ring_mb: int = 32,
         max_announce_inflight: int = 32,
     ):
         self.announce_interval = announce_interval_seconds
@@ -146,6 +149,17 @@ class SchedulerConfig:
         # sendfile off the main loop. 0 = everything on the main loop
         # (the pre-round-8 behavior). SIGHUP-resizable.
         self.data_plane_workers = data_plane_workers
+        # Multi-core DOWNLOAD plane (p2p/shardpool.py leech mode; docs/
+        # OPERATIONS.md "Leech shard plane"): fork this many download
+        # workers; active-download conns hand off post-handshake, their
+        # recv pump + pwrite run off the main loop and piece payloads
+        # come back through a shared-memory ring for batched verify.
+        # 0 = downloads stay on the main loop. SIGHUP-resizable.
+        # leech_ring_mb sizes EACH worker's ring (slot granularity 1 MiB
+        # classes; a torrent whose piece length exceeds one slot stays
+        # on the main loop).
+        self.leech_workers = leech_workers
+        self.leech_ring_mb = leech_ring_mb
         # PER-AGENT announce concurrency cap. The rate cap bounds how
         # many announces START per second; during a full tracker outage
         # every in-flight announce hangs to its timeout, and without a
@@ -280,6 +294,12 @@ class Scheduler:
         # are handed to worker processes via fd passing and served with
         # sendfile, off this loop entirely.
         self._shardpool: Optional[ShardPool] = None
+        # Multi-core download plane (shardpool in leech mode): created at
+        # start() when leech_workers > 0; active-download conns hand off
+        # post-handshake and the dispatcher drives a LeechConnProxy --
+        # recv pump, frame parse, and pwrite all run in the workers,
+        # piece payloads come home through each worker's shared ring.
+        self._leech_pool: Optional[ShardPool] = None
         self._announce_queue = AnnounceQueue()
         self._announce_pump_task: Optional[asyncio.Task] = None
         self._announce_tasks: set[asyncio.Task] = set()
@@ -335,6 +355,15 @@ class Scheduler:
             and getattr(self, "_server", None) is not None
         ):
             self._start_shardpool()
+        leech = getattr(self, "_leech_pool", None)
+        if leech is not None:
+            leech.reconfigure(config.conn_churn_idle)
+            leech.resize(config.leech_workers)
+        elif (
+            config.leech_workers > 0
+            and getattr(self, "_server", None) is not None
+        ):
+            self._start_leech_pool()
         _log.info("scheduler config reloaded")
 
     def reload_pex(self, config: PexConfig) -> None:
@@ -355,6 +384,27 @@ class Scheduler:
         )
         self._shardpool.start()
 
+    def _start_leech_pool(self) -> None:
+        # Slots match the metainfo generator's default 4 MiB piece
+        # class (origin/metainfogen.py): ring_mb / 4 MiB slots per
+        # worker. The slab is anonymous MAP_SHARED -- pages materialize
+        # on first touch, so oversized slots for short-piece torrents
+        # cost address space, not RSS. Torrents with longer pieces
+        # (8/16 MiB tiers for >= 2 GiB blobs) skip the plane at
+        # handoff gating.
+        slot_bytes = 4 << 20
+        self._leech_pool = ShardPool(
+            self.config.leech_workers,
+            churn_idle_seconds=self.config.conn_churn_idle,
+            component=(
+                "origin-leech" if self.is_origin else "agent-leech"
+            ),
+            leech=True,
+            ring_slots=max(1, (self.config.leech_ring_mb << 20) // slot_bytes),
+            slot_bytes=slot_bytes,
+        )
+        self._leech_pool.start()
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._accept, host=self.ip, port=self.port, limit=_WIRE_BUF
@@ -363,6 +413,8 @@ class Scheduler:
             self.port = self._server.sockets[0].getsockname()[1]
         if self.config.data_plane_workers > 0:
             self._start_shardpool()
+        if self.config.leech_workers > 0:
+            self._start_leech_pool()
         self._announce_pump_task = asyncio.create_task(self._announce_pump())
         self._pex_task = asyncio.create_task(self._pex_pump())
         if self._peercache is not None:
@@ -405,6 +457,9 @@ class Scheduler:
         if self._shardpool is not None:
             await self._shardpool.stop()
             self._shardpool = None
+        if self._leech_pool is not None:
+            await self._leech_pool.stop()
+            self._leech_pool = None
 
     @property
     def addr(self) -> str:
@@ -415,7 +470,10 @@ class Scheduler:
         """Live peer conns -- the drain loop's quiesce signal. Counts
         BOTH halves of the data plane: main-loop conns and the ones
         handed to worker shards (a drain must wait for in-flight worker
-        serves exactly like in-flight dispatcher pieces)."""
+        serves exactly like in-flight dispatcher pieces). Leech-shard
+        conns are NOT added here: their proxies live in _conn_owners
+        already (the dispatcher adopted them), so adding the leech
+        pool's count would double-book every one."""
         shard = self._shardpool.num_conns if self._shardpool else 0
         return len(self._conn_owners) + shard
 
@@ -434,6 +492,11 @@ class Scheduler:
             # let in-flight serves finish, and churn their conns out --
             # the same SIGTERM semantics as the main loop.
             self._shardpool.enter_lameduck()
+        if self._leech_pool is not None:
+            # Same for the download plane: no new handoffs; established
+            # leech conns keep pulling until their download completes
+            # (in-flight work finishing IS the point of the drain).
+            self._leech_pool.enter_lameduck()
         _log.info("scheduler entering lameduck drain")
 
     # -- public API --------------------------------------------------------
@@ -561,6 +624,10 @@ class Scheduler:
             # torrent's conns gracefully (the remotes requeue elsewhere)
             # -- a seeder must not keep serving bytes it just evicted.
             self._shardpool.evict(ctl.torrent.metainfo.digest.hex)
+        if self._leech_pool is not None:
+            # Same fan-out on the download plane: leech workers hold a
+            # writable fd on the .part -- it must not outlive the blob.
+            self._leech_pool.evict(ctl.torrent.metainfo.digest.hex)
         self._announce_queue.remove(h)
         ctl.cancel_tasks()
         ctl.dispatcher.close()
@@ -1033,6 +1100,8 @@ class Scheduler:
             if not self.conn_state.promote(theirs.peer_id, h):
                 writer.close()
                 return
+            if self._try_leech_handoff(ctl, reader, writer, theirs):
+                return
             self._adopt(ctl, reader, writer, theirs)
 
     # -- inbound conns -----------------------------------------------------
@@ -1061,6 +1130,8 @@ class Scheduler:
             writer.close()
             return
         if self._try_handoff(ctl, reader, writer, theirs):
+            return
+        if self._try_leech_handoff(ctl, reader, writer, theirs):
             return
         self._adopt(ctl, reader, writer, theirs)
 
@@ -1146,6 +1217,124 @@ class Scheduler:
         transport.abort()
         self.events.emit(
             "add_active_conn", h.hex, peer=theirs.peer_id.hex, shard=True
+        )
+        return True
+
+    def _try_leech_handoff(
+        self,
+        ctl: _TorrentControl,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        theirs: HandshakeResult,
+    ) -> bool:
+        """Classify + ship an active-DOWNLOAD conn to a leech worker
+        shard (dialed or accepted while our torrent is still partial).
+
+        The worker owns the socket: recv pump, frame parse, in-process
+        serves of pieces we already have, and -- after the parent's
+        batched verify -- the pwrite. The parent keeps everything that
+        needs shared state: a :class:`LeechConnProxy` is adopted into
+        the dispatcher exactly like a Conn, so piece selection, endgame,
+        churn, blacklist verdicts, and PEX all run unchanged. Returns
+        False to fall through to the normal in-loop adopt.
+        """
+        pool = self._leech_pool
+        if pool is None or not pool.can_accept:
+            return False
+        torrent = ctl.torrent
+        if torrent.complete():
+            return False  # nothing left to pull: that's the seed plane
+        if self.bandwidth is not None:
+            # The ingress token bucket is in-process state a worker
+            # cannot share: shaped nodes keep downloads on the main loop.
+            return False
+        if getattr(torrent, "spool_backed", False):
+            return False
+        if min(
+            torrent.metainfo.piece_length, torrent.metainfo.length
+        ) > pool.slot_bytes:
+            # The largest ACTUAL piece must fit one ring slot (mmap'd
+            # pre-fork, fixed size): longer pieces stay on the main
+            # loop. min() because a blob shorter than the nominal piece
+            # length has a single piece of its own size.
+            return False
+        if not os.path.exists(torrent.blob_path):
+            # The preallocated .part is the worker's pwrite target; no
+            # flat file, no remote writes.
+            return False
+        transport = writer.transport
+        sock = transport.get_extra_info("socket")
+        if sock is None:
+            return False  # exotic transport (tests' mocks): keep in-loop
+        peername = writer.get_extra_info("peername")
+        h = torrent.info_hash
+        try:
+            transport.pause_reading()
+        except (RuntimeError, NotImplementedError):
+            return False
+        residual = bytes(getattr(reader, "_buffer", b""))
+        desc = {
+            "peer": theirs.peer_id.hex,
+            "ih": h.hex,
+            "name": torrent.metainfo.digest.hex,
+            "plen": torrent.metainfo.piece_length,
+            "len": torrent.metainfo.length,
+            "np": torrent.num_pieces,
+            "path": torrent.blob_path,
+            "residual": residual,
+            "tp": theirs.traceparent,
+            # Leech extensions: open the blob r+ (verdict pwrites land
+            # there) and seed the worker's have-set from our bitfield so
+            # it can answer the remote's requests in-process.
+            "leech": True,
+            "wr": True,
+            "have": torrent.bitfield(),
+        }
+        proxy = LeechConnProxy(
+            theirs.peer_id, h,
+            send_frames=lambda frames: pool.send_frames(proxy, frames),
+            close_remote=lambda reason, mis: pool.close_remote(
+                proxy, reason, mis
+            ),
+        )
+        try:
+            dup = sock.dup()
+        except OSError:
+            transport.resume_reading()
+            return False
+        try:
+            ok = pool.try_handoff(dup.fileno(), desc, proxy=proxy)
+        finally:
+            dup.close()
+        if not ok:
+            transport.resume_reading()
+            return False
+        # The worker owns the socket now: retire the parent transport
+        # without closing the connection (the SCM_RIGHTS ref keeps it
+        # alive until the worker adopts the fd).
+        transport.abort()
+        proxy.start()
+        if not ctl.dispatcher.add_conn(
+            proxy, theirs.bitfield, theirs.num_pieces
+        ):
+            # Duplicate peer / bad bitfield: the dispatcher closed the
+            # proxy, which echoed the close to the worker. The conn is
+            # fully handled -- do NOT fall through to _adopt (the socket
+            # is gone from this process).
+            self.conn_state.remove(theirs.peer_id, h)
+            return True
+        key = (theirs.peer_id, h)
+        self._conn_owners[key] = proxy
+        proxy.closed.add_done_callback(
+            lambda _f: self._conn_closed(key, proxy)
+        )
+        if theirs.listen_port and peername:
+            ctl.known_peers.add(
+                PeerInfo(theirs.peer_id, peername[0], theirs.listen_port),
+                "conn",
+            )
+        self.events.emit(
+            "add_active_conn", h.hex, peer=theirs.peer_id.hex, leech=True
         )
         return True
 

@@ -41,6 +41,37 @@ stdlib + msgpack -- no JAX, no aiohttp, no store machinery. A crashed
 worker is detected by control-socket EOF: its conn slots are released,
 ``data_plane_worker_crashes_total`` counts it, the resource sentinel
 flags it as a breach, and the supervisor respawns the shard.
+
+**Leech plane** (``leech_workers`` knob, shipped 0 = off): the same pool
+machinery in download mode. Active-download conns -- dialed or accepted
+while our torrent is still partial -- hand off post-handshake just like
+seed conns, but the descriptor carries ``leech``/``have``/``wr`` and the
+parent registers a :class:`~kraken_tpu.p2p.conn.LeechConnProxy` the
+dispatcher drives like any Conn. Division of labor per piece:
+
+- WORKER: recv pump + frame parse, landing PIECE_PAYLOAD bytes straight
+  into a leased slot of a per-worker :class:`~kraken_tpu.utils.bufpool.
+  SlabRing` -- an anonymous shared ``mmap`` created pre-fork, so only
+  the slot INDEX crosses the control channel, never the payload.
+- PARENT: bookkeeping only. The dispatcher's normal ``write_piece`` flow
+  verifies the slot bytes zero-copy through the shared
+  ``BatchedVerifier`` (TPU ``hash_batch`` when the agent's hasher is
+  TPU-backed, so verify amortizes across concurrent arrivals), then --
+  on a good digest -- sends a ``write`` verdict instead of pwriting.
+- WORKER: ``os.pwrite`` from the slot via its long-lived writable
+  per-torrent fd, frees the slot, acks ``written``; only then does the
+  parent mark the bitfield, preserving the crash-resume invariant (a
+  set bit implies bytes on disk). Corrupt pieces never get a ``write``
+  verdict: the parent's lease release sends ``drop``, the slot frees
+  without touching disk, and the misbehavior verdict escalates the
+  blacklist exactly as on the main loop.
+
+Outbound frames (piece requests, announce fanout, PEX) ride the control
+channel as ``send`` messages; the worker also answers PIECE_REQUESTs
+in-process from its parent-fed have-set, so a leech conn keeps seeding
+what it already has without bouncing through the main loop. Inbound
+acceptor fan-out stays handshake-in-parent + fd-pass (not SO_REUSEPORT:
+the handshake needs parent-side torrent state -- see OPERATIONS.md).
 """
 
 from __future__ import annotations
@@ -57,8 +88,9 @@ from typing import Callable, Optional
 
 import msgpack
 
-from kraken_tpu.p2p.wire import MAX_HEADER, MAX_PAYLOAD, MsgType
+from kraken_tpu.p2p.wire import MAX_HEADER, MAX_PAYLOAD, Message, MsgType, frame_head
 from kraken_tpu.utils import failpoints, trace
+from kraken_tpu.utils.bufpool import SlabRing, _class_for as _bufpool_class_for
 
 _log = logging.getLogger("kraken.p2p.shard")
 
@@ -110,7 +142,7 @@ _SENDFILE_UNSUPPORTED = {
 class _WorkerTorrent:
     __slots__ = (
         "name", "path", "piece_length", "length", "num_pieces",
-        "file", "evicted_evt", "conns",
+        "file", "evicted_evt", "conns", "writable", "have",
     )
 
     def __init__(self, desc: dict):
@@ -122,6 +154,21 @@ class _WorkerTorrent:
         self.file = None  # long-lived blob fd, opened on first serve
         self.evicted_evt = asyncio.Event()
         self.conns: set["_WorkerConn"] = set()
+        # Leech plane: writable torrents open r+ (the ``.part`` the
+        # parent preallocated) so verdict pwrites land here; ``have``
+        # mirrors the PARENT's bitfield (seeded from the handoff
+        # descriptor, grown by write acks and by the announce/complete
+        # frames the parent fans out through us) and gates which
+        # PIECE_REQUESTs this worker may answer in-process.
+        self.writable = bool(desc.get("wr"))
+        self.have: set[int] = set()
+        bits = desc.get("have") or b""
+        if bits:
+            # Same LSB-first convention as dispatch._bits_to_set.
+            self.have = {
+                i for i in range(self.num_pieces)
+                if i // 8 < len(bits) and bits[i // 8] >> (i % 8) & 1
+            }
 
     def piece_length_of(self, i: int) -> int:
         return min(self.piece_length, self.length - i * self.piece_length)
@@ -129,8 +176,10 @@ class _WorkerTorrent:
     def open(self):
         if self.file is None:
             # Buffered binary handle: sock_sendfile's native path only
-            # uses fileno() (positional os.sendfile -- safe concurrently).
-            self.file = open(self.path, "rb")
+            # uses fileno() (positional os.sendfile -- safe concurrently),
+            # as do the leech plane's os.pwrite calls (unbuffered, so the
+            # parent's commit fsync sees every byte).
+            self.file = open(self.path, "r+b" if self.writable else "rb")
         return self.file
 
     def close(self) -> None:
@@ -142,7 +191,10 @@ class _WorkerTorrent:
 
 
 class _WorkerConn:
-    __slots__ = ("cid", "sock", "torrent", "buf", "task", "peer", "ih", "tp")
+    __slots__ = (
+        "cid", "sock", "torrent", "buf", "task", "peer", "ih", "tp",
+        "leech", "wlock",
+    )
 
     def __init__(self, cid: int, sock: socket.socket, torrent: _WorkerTorrent,
                  desc: dict):
@@ -157,12 +209,18 @@ class _WorkerConn:
         # the handoff descriptor); per-request PIECE_REQUEST "tp"
         # headers override it for finer nesting.
         self.tp = desc.get("tp") or ""
+        # Leech conns interleave TWO writers on one socket: parent-
+        # authored control frames (requests, announces) and in-process
+        # piece serves. The lock keeps a corked serve atomic.
+        self.leech = bool(desc.get("leech"))
+        self.wlock = asyncio.Lock()
 
 
 class _WorkerState:
     """Everything one shard process owns. Runs inside ``asyncio.run``."""
 
-    def __init__(self, ctrl: socket.socket, shard: int, cfg: dict):
+    def __init__(self, ctrl: socket.socket, shard: int, cfg: dict,
+                 ring: SlabRing | None = None):
         self.ctrl = ctrl
         self.shard = shard
         # Idle churn mirrors the dispatcher's conn churn: a seed conn
@@ -173,6 +231,14 @@ class _WorkerState:
         self.conns: dict[int, _WorkerConn] = {}
         self.bytes_up = 0
         self.serves = 0
+        # Leech plane: the shared slab (created PRE-fork so both sides
+        # map the same pages; None on seed-only shards). This side's
+        # free list is authoritative -- the parent only reads views.
+        self.ring = ring
+        self._ring_evt = asyncio.Event()  # a slot freed; leasers recheck
+        self.bytes_down = 0
+        self.pieces = 0
+        self._write_tasks: set[asyncio.Task] = set()
         self.lameduck = False
         self._stop_evt = asyncio.Event()
         self._stats_dirty = True
@@ -239,6 +305,14 @@ class _WorkerState:
                     socket.SOL_SOCKET, socket.SO_SNDBUF,
                     max(4 << 20, msg.get("plen", 0) * 2),
                 )
+                if msg.get("leech"):
+                    # Download pump: the recv window should hold a
+                    # couple of pipelined pieces so the remote keeps
+                    # streaming while we drain into the ring.
+                    sock.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_RCVBUF,
+                        max(4 << 20, msg.get("plen", 0) * 2),
+                    )
             except OSError:
                 pass
             torrent = self.torrents.get(msg["name"])
@@ -269,6 +343,127 @@ class _WorkerState:
             self.idle_timeout = max(
                 1.0, 2.0 * float(msg.get("churn_idle", 4.0))
             )
+        elif t == "send":
+            # Parent-authored outbound frames for one leech conn
+            # (requests, announce fanout, PEX). Announce/complete also
+            # grow the torrent's have-set, so in-process serves track
+            # pieces the parent landed through OTHER conns.
+            conn = self.conns.get(msg.get("cid"))
+            if conn is not None:
+                task = asyncio.create_task(
+                    self._send_frames(conn, msg.get("frames") or [])
+                )
+                self._write_tasks.add(task)
+                task.add_done_callback(self._write_tasks.discard)
+        elif t == "write":
+            # Verify verdict: good digest. pwrite from the slot, free
+            # it, ack -- the parent marks the bitfield only on our ack.
+            task = asyncio.create_task(self._do_write(msg))
+            self._write_tasks.add(task)
+            task.add_done_callback(self._write_tasks.discard)
+        elif t == "drop":
+            # Slot abandoned parent-side (corrupt piece, duplicate,
+            # conn torn down mid-verify): free without touching disk.
+            self._free_slot(msg.get("slot"))
+        elif t == "close":
+            # Parent-initiated close (proxy.close echoed down). The
+            # conn loop's finally still sends the closed verdict; the
+            # proxy is already closed, so it no-ops on arrival.
+            conn = self.conns.get(msg.get("cid"))
+            if conn is not None and conn.task is not None:
+                conn.task.cancel()
+
+    # -- leech plane (slot recv + verdict writes + parent frames) ----------
+
+    def _free_slot(self, slot) -> None:
+        if self.ring is None or not isinstance(slot, int):
+            return
+        self.ring.release(slot)
+        self._ring_evt.set()  # wake any pump parked on a full ring
+
+    async def _lease_slot(self) -> int:
+        """Claim a ring slot, waiting while the ring is full. The wait IS
+        the backpressure: the pump stops reading, the kernel stops
+        acking, TCP throttles the remote -- no bytes are dropped."""
+        while True:
+            slot = self.ring.lease()
+            if slot is not None:
+                return slot
+            self._ring_evt.clear()
+            await self._ring_evt.wait()
+
+    async def _recv_into_slot(self, conn: _WorkerConn, slot: int,
+                              n: int) -> None:
+        """Land ``n`` payload bytes directly in the slot: residual bytes
+        already buffered first, then ``sock_recv_into`` the rest -- the
+        payload is written exactly once, by the kernel."""
+        view = self.ring.view(slot, n)
+        got = 0
+        if conn.buf:
+            take = min(len(conn.buf), n)
+            view[:take] = conn.buf[:take]
+            del conn.buf[:take]
+            got = take
+        loop = asyncio.get_running_loop()
+        while got < n:
+            r = await loop.sock_recv_into(conn.sock, view[got:])
+            if not r:
+                raise ConnectionResetError("remote closed mid-piece")
+            got += r
+
+    async def _send_frames(self, conn: _WorkerConn, frames: list) -> None:
+        """Write parent-authored frames to the conn's socket (wire.py
+        layout via the shared ``frame_head``)."""
+        out = bytearray()
+        for mt, header, payload in frames:
+            header = header or {}
+            payload = payload or b""
+            if mt == int(MsgType.ANNOUNCE_PIECE):
+                idx = header.get("index")
+                if isinstance(idx, int):
+                    conn.torrent.have.add(idx)
+            elif mt == int(MsgType.COMPLETE):
+                conn.torrent.have.update(range(conn.torrent.num_pieces))
+            packed = msgpack.packb(header)
+            out += frame_head(mt, packed, len(payload))
+            out += payload
+        if not out:
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            async with conn.wlock:
+                await loop.sock_sendall(conn.sock, bytes(out))
+        except (ConnectionError, OSError):
+            pass  # the conn loop's recv observes the death and reports it
+
+    async def _do_write(self, msg: dict) -> None:
+        slot, idx, name = msg.get("slot"), msg.get("idx"), msg.get("name")
+        ok = False
+        try:
+            t = self.torrents.get(name)
+            if t is None or not t.writable or not isinstance(idx, int):
+                raise OSError(f"no writable torrent for {name!r}")
+            ln = t.piece_length_of(idx)
+            view = self.ring.view(slot, ln)
+            f = t.open()
+            # Off-loop: a disk stall must not freeze this shard's pumps.
+            # os.pwrite on the raw fd bypasses the handle's buffering,
+            # so the parent's commit path sees the bytes immediately.
+            await asyncio.to_thread(
+                os.pwrite, f.fileno(), view, idx * t.piece_length
+            )
+            t.have.add(idx)
+            ok = True
+        except Exception as e:
+            _log.warning(
+                "leech shard write failed",
+                extra={"shard": self.shard, "piece": idx, "err": str(e)},
+            )
+        finally:
+            # Free BEFORE acking: the bytes are on disk (or abandoned),
+            # either way the slot's job is done.
+            self._free_slot(slot)
+            self._send({"t": "written", "slot": slot, "ok": ok})
 
     # -- frame plumbing ----------------------------------------------------
 
@@ -283,10 +478,23 @@ class _WorkerState:
         del conn.buf[:n]
         return out
 
-    async def _read_frame(self, conn: _WorkerConn) -> tuple[int, dict]:
-        """One wire frame (p2p/wire.py layout). Payload bytes -- always
-        unsolicited on a seed conn -- are drained and dropped to keep
-        framing; oversize or malformed input is misbehavior."""
+    # Control-frame payloads worth forwarding to the parent whole (a
+    # mid-stream BITFIELD's bits ride the payload): anything larger is
+    # drained and dropped like the seed path.
+    _FWD_PAYLOAD_MAX = 1 << 16
+
+    async def _read_frame(
+        self, conn: _WorkerConn
+    ) -> tuple[int, dict, Optional[int], int, bytes]:
+        """One wire frame (p2p/wire.py layout) as ``(mtype, header,
+        slot, payload_len, payload)``.
+
+        Seed conns: payload bytes are always unsolicited -- drained and
+        dropped to keep framing (``slot=None, payload=b""``). Leech
+        conns: a PIECE_PAYLOAD lands in a leased ring slot (``slot``
+        set, the caller notifies the parent), small control payloads are
+        captured for forwarding, and oversize or malformed input is
+        misbehavior either way."""
         prefix = await self._readexactly(conn, 9)
         mtype = prefix[0]
         header_len = int.from_bytes(prefix[1:5], "big")
@@ -304,12 +512,54 @@ class _WorkerState:
                 raise ValueError("header not a map")
         except Exception as e:
             raise _Misbehavior(f"malformed header: {e}") from e
+        if (
+            conn.leech
+            and self.ring is not None
+            and payload_len
+            and mtype == int(MsgType.PIECE_PAYLOAD)
+        ):
+            t = conn.torrent
+            idx = header.get("index")
+            if not isinstance(idx, int) or not 0 <= idx < t.num_pieces:
+                raise _Misbehavior(f"piece index out of range: {idx!r}")
+            if payload_len != t.piece_length_of(idx):
+                raise _Misbehavior(
+                    f"piece {idx}: wrong length {payload_len}"
+                )
+            slot = await self._lease_slot()
+            try:
+                await self._recv_into_slot(conn, slot, payload_len)
+                # Failpoint p2p.shard.leech.corrupt: flip the first
+                # payload byte IN the shared slot -- parent verify must
+                # catch it, the ban must cross the fork boundary, the
+                # pull must finish from healthy peers.
+                if failpoints.fire("p2p.shard.leech.corrupt"):
+                    self.ring.view(slot, 1)[0] ^= 0xFF
+                # Failpoint p2p.shard.leech.disconnect: the remote dies
+                # mid-transfer in a WORKER pump -- the piece requeues to
+                # a healthy peer and the slot must come back.
+                if failpoints.fire("p2p.shard.leech.disconnect"):
+                    raise ConnectionResetError(
+                        "failpoint p2p.shard.leech.disconnect"
+                    )
+            except BaseException:
+                self._free_slot(slot)
+                raise
+            return mtype, header, slot, payload_len, b""
+        if (
+            conn.leech
+            and payload_len
+            and payload_len <= self._FWD_PAYLOAD_MAX
+            and mtype != int(MsgType.PIECE_PAYLOAD)
+        ):
+            payload = await self._readexactly(conn, payload_len)
+            return mtype, header, None, payload_len, payload
         # Drain-and-drop any payload: a seeder never asked for one.
         remaining = payload_len
         while remaining:
             got = await self._readexactly(conn, min(remaining, _RECV_CHUNK))
             remaining -= len(got)
-        return mtype, header
+        return mtype, header, None, payload_len, b""
 
     async def _wait_writable(self, sock: socket.socket) -> None:
         loop = asyncio.get_running_loop()
@@ -383,33 +633,33 @@ class _WorkerState:
             raise ConnectionResetError("failpoint p2p.shard.serve.disconnect")
         t = conn.torrent
         ln = t.piece_length_of(idx)
-        header = msgpack.packb({"index": idx})
-        head = (
-            bytes([int(MsgType.PIECE_PAYLOAD)])
-            + len(header).to_bytes(4, "big")
-            + ln.to_bytes(4, "big")
-            + header
+        head = frame_head(
+            int(MsgType.PIECE_PAYLOAD), msgpack.packb({"index": idx}), ln
         )
         loop = asyncio.get_running_loop()
         f = t.open()  # FileNotFoundError here = evicted under us
-        _cork(conn.sock, True)
-        try:
-            await loop.sock_sendall(conn.sock, head)
-            if _HAVE_SENDFILE:
-                try:
-                    await self._sendfile(
-                        conn, f, idx * t.piece_length, ln
-                    )
-                except OSError as e:
-                    if e.errno not in _SENDFILE_UNSUPPORTED:
-                        raise
-                    # Kernel/fs without sendfile for this pair: the
-                    # pread fallback is correct, one userspace copy.
+        # wlock: on leech conns a parent-authored frame batch must not
+        # interleave with the corked head+sendfile (seed conns never
+        # contend -- the conn loop is the only writer).
+        async with conn.wlock:
+            _cork(conn.sock, True)
+            try:
+                await loop.sock_sendall(conn.sock, head)
+                if _HAVE_SENDFILE:
+                    try:
+                        await self._sendfile(
+                            conn, f, idx * t.piece_length, ln
+                        )
+                    except OSError as e:
+                        if e.errno not in _SENDFILE_UNSUPPORTED:
+                            raise
+                        # Kernel/fs without sendfile for this pair: the
+                        # pread fallback is correct, one userspace copy.
+                        await self._serve_pread(conn, f, idx, ln)
+                else:  # pragma: no cover - non-Linux
                     await self._serve_pread(conn, f, idx, ln)
-            else:  # pragma: no cover - non-Linux
-                await self._serve_pread(conn, f, idx, ln)
-        finally:
-            _cork(conn.sock, False)
+            finally:
+                _cork(conn.sock, False)
         self.bytes_up += ln
         self.serves += 1
         self._stats_dirty = True
@@ -422,19 +672,40 @@ class _WorkerState:
             raise OSError(f"short read on piece {idx}")
         await loop.sock_sendall(conn.sock, data)
 
+    # Frame types a leech conn forwards to the parent's dispatcher (the
+    # bookkeeping half: availability updates and peer gossip).
+    _FORWARD_TYPES = frozenset(
+        int(m) for m in (
+            MsgType.ANNOUNCE_PIECE, MsgType.BITFIELD,
+            MsgType.COMPLETE, MsgType.PEER_EXCHANGE,
+        )
+    )
+
     async def _handle_frame(self, conn: _WorkerConn, mtype: int,
-                            header: dict) -> None:
+                            header: dict, payload: bytes = b"") -> None:
         if mtype == MsgType.PIECE_REQUEST:
             idx = header.get("index")
             t = conn.torrent
             if not isinstance(idx, int) or not 0 <= idx < t.num_pieces:
                 raise _Misbehavior(f"piece index out of range: {idx!r}")
+            if conn.leech and idx not in t.have:
+                # Same as the main-loop dispatcher: a request for a
+                # piece we don't (yet) have is silently dropped -- the
+                # remote re-requests after our next announce.
+                return
             await self._serve_piece(conn, idx, str(header.get("tp") or ""))
+        elif conn.leech and mtype in self._FORWARD_TYPES:
+            # Dispatcher bookkeeping (peer availability, PEX gossip)
+            # lives in the parent: ship the frame home. Payloads here
+            # are small (bitfield bits) and size-capped at read time.
+            self._send({
+                "t": "frame", "cid": conn.cid, "mt": mtype, "h": header,
+                **({"p": payload} if payload else {}),
+            })
         elif mtype == MsgType.ERROR:
             raise ConnectionResetError(header.get("detail", "peer error"))
-        # ANNOUNCE_PIECE / COMPLETE / CANCEL_PIECE / BITFIELD /
-        # PIECE_PAYLOAD (already drained): progress chatter from the
-        # leecher -- nothing for a pure seeder to act on.
+        # Remaining chatter (CANCEL_PIECE; ANNOUNCE/COMPLETE on a seed
+        # conn; PIECE_PAYLOAD already drained): nothing to act on.
 
     async def _conn_loop(self, conn: _WorkerConn) -> None:
         reason, detail, mis = "remote_closed", "", False
@@ -466,11 +737,29 @@ class _WorkerState:
                     else:
                         reason = "idle_conn"
                     break
-                mtype, header = recv.result()
+                mtype, header, slot, ln, payload = recv.result()
                 recv = None
+                if slot is not None:
+                    # A complete piece landed in the shared ring: hand
+                    # the parent its slot index for verify. Ownership
+                    # transfers -- the slot comes back as a write
+                    # verdict or a drop.
+                    self.bytes_down += ln
+                    self.pieces += 1
+                    self._stats_dirty = True
+                    delivered = self._send({
+                        "t": "piece", "cid": conn.cid,
+                        "idx": header.get("index"), "slot": slot, "ln": ln,
+                    })
+                    if not delivered:
+                        # Parent backlogged/gone: the piece is lost (the
+                        # request times out and requeues) but the slot
+                        # MUST come back or the ring bleeds dry.
+                        self._free_slot(slot)
+                    continue
                 # In-flight serves run INLINE here: eviction and drain
                 # take effect between frames, never mid-sendfile.
-                await self._handle_frame(conn, mtype, header)
+                await self._handle_frame(conn, mtype, header, payload)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             reason, detail = "connection_error", str(e)
         except _Misbehavior as e:
@@ -512,11 +801,12 @@ class _WorkerState:
 
     # -- stats + lifecycle -------------------------------------------------
 
-    def _send(self, msg: dict) -> None:
+    def _send(self, msg: dict) -> bool:
         try:
             self.ctrl.send(msgpack.packb(msg))
+            return True
         except (BlockingIOError, OSError):
-            pass  # parent backlogged or gone; stats are best-effort
+            return False  # parent backlogged or gone; mostly best-effort
 
     def _send_stats(self) -> None:
         times = os.times()
@@ -525,6 +815,8 @@ class _WorkerState:
             "conns": len(self.conns),
             "bytes_up": self.bytes_up,
             "serves": self.serves,
+            "bytes_down": self.bytes_down,
+            "pieces": self.pieces,
             "cpu_s": times.user + times.system,
             "lameduck": self.lameduck,
         })
@@ -576,9 +868,12 @@ class _WorkerState:
         # they already live in the parent's ring -- stamp the shard on
         # the node id, and buffer this process's spans for shipment.
         trace.TRACER.recorder.clear()
+        stamp = (
+            f"leech{self.shard}" if self.ring is not None
+            else f"shard{self.shard}"
+        )
         trace.TRACER.node = (
-            f"{trace.TRACER.node}/shard{self.shard}"
-            if trace.TRACER.node else f"shard{self.shard}"
+            f"{trace.TRACER.node}/{stamp}" if trace.TRACER.node else stamp
         )
         trace.TRACER.on_record = self._on_span
         # Same story for the sampling profiler: the fork inherited its
@@ -608,6 +903,12 @@ class _WorkerState:
                     c.task.cancel()
             if tasks:
                 await asyncio.gather(*tasks, return_exceptions=True)
+            # Verdict pwrites still in flight finish before the fds
+            # close: the parent is awaiting their written acks.
+            if self._write_tasks:
+                await asyncio.gather(
+                    *list(self._write_tasks), return_exceptions=True
+                )
             for t in list(self.torrents.values()):
                 t.close()
             self._send_stats()
@@ -619,7 +920,7 @@ class _WorkerState:
 
 
 def _worker_main(ctrl: socket.socket, parent_fd: int, shard: int,
-                 cfg: dict) -> None:
+                 cfg: dict, ring: SlabRing | None = None) -> None:
     """Child-process entry (fork start method). Resets inherited signal
     plumbing -- the parent's asyncio handlers reference a loop this
     process must never touch -- then runs the shard's own loop."""
@@ -641,7 +942,7 @@ def _worker_main(ctrl: socket.socket, parent_fd: int, shard: int,
         except OSError:
             pass
     try:
-        asyncio.run(_WorkerState(ctrl, shard, cfg).run())
+        asyncio.run(_WorkerState(ctrl, shard, cfg, ring).run())
     except KeyboardInterrupt:  # pragma: no cover
         pass
 
@@ -653,10 +954,13 @@ def _worker_main(ctrl: socket.socket, parent_fd: int, shard: int,
 class _Worker:
     __slots__ = (
         "shard", "proc", "sock", "conns", "retiring",
-        "last_bytes", "last_serves", "cpu_s",
+        "last_bytes", "last_serves", "last_down", "last_pieces",
+        "cpu_s", "prefix", "ring",
     )
 
-    def __init__(self, shard: int, proc, sock: socket.socket):
+    def __init__(self, shard: int, proc, sock: socket.socket,
+                 prefix: str = "data_plane_shard",
+                 ring: SlabRing | None = None):
         self.shard = shard
         self.proc = proc
         self.sock = sock
@@ -664,11 +968,58 @@ class _Worker:
         self.retiring = False
         self.last_bytes = 0
         self.last_serves = 0
+        self.last_down = 0
+        self.last_pieces = 0
         self.cpu_s = 0.0
+        self.prefix = prefix
+        # Leech shards only: the shared slab this worker's pumps fill.
+        # A respawn gets a FRESH ring; the old mapping lives exactly as
+        # long as in-flight parent-side views into it.
+        self.ring = ring
 
     @property
     def label(self) -> str:
-        return f"data_plane_shard{self.shard}"
+        return f"{self.prefix}{self.shard}"
+
+
+class _SlotLease:
+    """Parent-side lease on one shared-ring slot, attached to the
+    PIECE_PAYLOAD :class:`~kraken_tpu.p2p.wire.Message` a leech worker
+    announced. Mirrors the bufpool Lease contract the dispatcher already
+    trusts: ``release()`` is idempotent and is THE single return point
+    (the dispatcher's payload-task done-callback always calls it) --
+    here it ships a ``drop`` so the worker frees the slot untouched.
+
+    ``remote_write`` is the leech plane's replacement for the parent's
+    pwrite (``Torrent.write_piece(..., remote_write=...)``): it consumes
+    the lease, sends the good-digest ``write`` verdict, and resolves on
+    the worker's ``written`` ack -- after which the slot is already free
+    worker-side, so the later ``release()`` no-ops."""
+
+    __slots__ = ("_pool", "_shard", "_slot", "_name", "_consumed")
+
+    def __init__(self, pool: "ShardPool", shard: int, slot: int, name: str):
+        self._pool = pool
+        self._shard = shard
+        self._slot = slot
+        self._name = name
+        self._consumed = False
+
+    @property
+    def released(self) -> bool:
+        return self._consumed
+
+    def release(self) -> None:
+        if self._consumed:
+            return
+        self._consumed = True
+        self._pool._drop_slot(self._shard, self._slot)
+
+    async def remote_write(self, idx: int) -> None:
+        if self._consumed:
+            raise ConnectionError("slot lease already released")
+        self._consumed = True
+        await self._pool._remote_write(self._shard, self._slot, self._name, idx)
 
 
 class ShardPool:
@@ -682,11 +1033,31 @@ class ShardPool:
         churn_idle_seconds: float = 4.0,
         on_conn_closed: ConnClosedFn | None = None,
         component: str = "p2p",
+        leech: bool = False,
+        ring_slots: int = 32,
+        slot_bytes: int = 1 << 20,
     ):
         self._target = max(0, size)
         self.churn_idle = churn_idle_seconds
         self._on_conn_closed = on_conn_closed or (lambda desc, r, m: None)
         self.component = component
+        # Leech mode: workers run download pumps, each with a pre-fork
+        # shared SlabRing of ``ring_slots`` x ``slot_bytes``-class slots.
+        self.leech = leech
+        self._ring_slots = max(1, ring_slots)
+        # Normalize to the SlabRing's power-of-two slot class so the
+        # scheduler's piece-length gate compares against the size the
+        # ring actually allocates.
+        self._slot_bytes = _bufpool_class_for(max(1, slot_bytes))
+        self._prefix = "leech_shard" if leech else "data_plane_shard"
+        # cid -> LeechConnProxy for handed-off download conns; their
+        # closed verdicts route to the proxy (the dispatcher owns the
+        # bookkeeping), NOT the seed plane's on_conn_closed callback.
+        self._proxies: dict[int, object] = {}
+        # (shard, slot) -> future resolved by the worker's written ack.
+        self._pending_writes: dict[tuple[int, int], asyncio.Future] = {}
+        # Parent-side mirror of outstanding slot leases (leak audit).
+        self.slot_leases = 0
         self._workers: dict[int, _Worker] = {}
         self._conns: dict[int, tuple[int, dict]] = {}  # cid -> (shard, desc)
         self._next_cid = 0
@@ -725,20 +1096,28 @@ class ShardPool:
         parent_sock, child_sock = socket.socketpair(
             socket.AF_UNIX, socket.SOCK_SEQPACKET
         )
+        # The ring MUST exist before the fork: both processes inherit
+        # the same anonymous MAP_SHARED pages. A respawned shard gets a
+        # fresh ring (the dead worker's free-list state is gone with
+        # it); old in-flight views pin the old mapping until they die.
+        ring = (
+            SlabRing(self._ring_slots, self._slot_bytes)
+            if self.leech else None
+        )
         ctx = multiprocessing.get_context("fork")
         proc = ctx.Process(
             target=_worker_main,
             args=(
                 child_sock, parent_sock.fileno(), shard,
-                {"churn_idle": self.churn_idle},
+                {"churn_idle": self.churn_idle}, ring,
             ),
             daemon=True,  # backstop: never outlive the node process
-            name=f"kraken-data-plane-shard{shard}",
+            name=f"kraken-{'leech' if self.leech else 'data-plane'}-shard{shard}",
         )
         proc.start()
         child_sock.close()
         parent_sock.setblocking(False)
-        w = _Worker(shard, proc, parent_sock)
+        w = _Worker(shard, proc, parent_sock, prefix=self._prefix, ring=ring)
         self._workers[shard] = w
         asyncio.get_running_loop().add_reader(
             parent_sock.fileno(), self._on_worker_msg, shard
@@ -825,7 +1204,18 @@ class ShardPool:
                 pass  # close() raises ValueError while still alive
         for cid, (shard, desc) in list(self._conns.items()):
             self._conns.pop(cid, None)
-            self._safe_conn_closed(desc, "pool_stop", False)
+            proxy = self._proxies.pop(cid, None)
+            if proxy is not None:
+                proxy.on_remote_closed("pool_stop", False)
+            else:
+                self._safe_conn_closed(desc, "pool_stop", False)
+        for key, fut in list(self._pending_writes.items()):
+            self._pending_writes.pop(key, None)
+            if not fut.done():
+                fut.set_exception(ConnectionError("pool stopped mid-write"))
+        for w in workers:
+            if w.ring is not None:
+                w.ring.close()
         self._g_alive.set(0, component=self.component)
         for t in list(self._reap_tasks):
             t.cancel()
@@ -848,10 +1238,22 @@ class ShardPool:
         quiesce signal."""
         return len(self._conns)
 
-    def try_handoff(self, fd: int, desc: dict) -> bool:
-        """Ship a handshaken seed conn (by fd) to the least-loaded shard.
+    @property
+    def slot_bytes(self) -> int:
+        """Ring slot class in bytes (power of two). A leech handoff is
+        only legal when the torrent's piece length fits one slot."""
+        return self._slot_bytes
+
+    def try_handoff(self, fd: int, desc: dict, proxy=None) -> bool:
+        """Ship a handshaken conn (by fd) to the least-loaded shard.
         False = no shard could take it right now (all retiring, control
-        channel backlogged); the caller keeps the conn on the main loop."""
+        channel backlogged); the caller keeps the conn on the main loop.
+
+        ``proxy`` (leech mode): the :class:`LeechConnProxy` the
+        dispatcher will drive. On success it is bound to the worker --
+        its outbound frames and close flow through :meth:`send_frames` /
+        :meth:`close_remote`, and the worker's verdicts route back to
+        it."""
         if not self.can_accept:
             self._c_fallbacks.inc()
             return False
@@ -869,10 +1271,72 @@ class ShardPool:
                 continue
             w.conns += 1
             self._conns[cid] = (w.shard, desc)
+            if proxy is not None:
+                proxy._shard_cid = cid
+                self._proxies[cid] = proxy
             self._c_handoffs.inc(shard=w.label)
             return True
         self._c_fallbacks.inc()
         return False
+
+    # -- leech proxy plumbing ----------------------------------------------
+
+    def send_frames(self, proxy, frames: list) -> None:
+        """Outbound frames for a handed-off leech conn (injected into
+        the proxy as its ``send_frames``). Best-effort, like every
+        control-channel send: a lost frame behaves like a lossy peer
+        (requests re-issue on piece timeout)."""
+        cid = getattr(proxy, "_shard_cid", None)
+        entry = self._conns.get(cid) if cid is not None else None
+        if entry is None:
+            return
+        w = self._workers.get(entry[0])
+        if w is not None:
+            self._send(w, {"t": "send", "cid": cid, "frames": frames})
+
+    def close_remote(self, proxy, reason: str, mis: bool) -> None:
+        """Parent-initiated close of a handed-off leech conn."""
+        cid = getattr(proxy, "_shard_cid", None)
+        entry = self._conns.get(cid) if cid is not None else None
+        if entry is None:
+            return
+        w = self._workers.get(entry[0])
+        if w is not None:
+            self._send(w, {"t": "close", "cid": cid})
+
+    def _drop_slot(self, shard: int, slot: int) -> None:
+        """A slot lease released unconsumed (corrupt piece, duplicate,
+        teardown): tell the worker to free it without writing."""
+        self.slot_leases = max(0, self.slot_leases - 1)
+        w = self._workers.get(shard)
+        if w is not None:
+            self._send(w, {"t": "drop", "slot": slot})
+        # Worker gone: its ring (and authoritative free list) died with
+        # it -- nothing to free.
+
+    async def _remote_write(self, shard: int, slot: int, name: str,
+                            idx: int) -> None:
+        """Good-digest verdict: have the worker pwrite the slot, await
+        its written ack. Raising (worker death, write error, timeout)
+        leaves the piece unmarked -- the dispatcher requeues it."""
+        self.slot_leases = max(0, self.slot_leases - 1)
+        w = self._workers.get(shard)
+        if w is None or not w.proc.is_alive():
+            raise ConnectionError("leech worker exited before write")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending_writes[(shard, slot)] = fut
+        self._send(w, {"t": "write", "name": name, "slot": slot, "idx": idx})
+        try:
+            # Generous bound: a wedged worker must not strand the
+            # dispatcher's payload task forever (its conn would never
+            # churn -- receiving>0 exempts it).
+            await asyncio.wait_for(fut, 30.0)
+        except asyncio.TimeoutError:
+            raise ConnectionError(
+                f"leech shard {shard}: written ack timed out (piece {idx})"
+            ) from None
+        finally:
+            self._pending_writes.pop((shard, slot), None)
 
     # -- worker messages ---------------------------------------------------
 
@@ -913,16 +1377,48 @@ class ShardPool:
                 bytes_delta=max(0, msg.get("bytes_up", 0) - w.last_bytes),
                 serves_delta=max(0, msg.get("serves", 0) - w.last_serves),
                 cpu_seconds=w.cpu_s,
+                bytes_down_delta=max(
+                    0, msg.get("bytes_down", 0) - w.last_down
+                ),
+                pieces_delta=max(0, msg.get("pieces", 0) - w.last_pieces),
             )
             w.last_bytes = msg.get("bytes_up", w.last_bytes)
             w.last_serves = msg.get("serves", w.last_serves)
+            w.last_down = msg.get("bytes_down", w.last_down)
+            w.last_pieces = msg.get("pieces", w.last_pieces)
         elif t == "closed":
-            entry = self._conns.pop(msg.get("cid"), None)
+            cid = msg.get("cid")
+            entry = self._conns.pop(cid, None)
             w.conns = max(0, w.conns - 1)
-            if entry is not None:
+            proxy = self._proxies.pop(cid, None)
+            if proxy is not None:
+                # Dispatcher-owned conn: the verdict flows through the
+                # proxy (misbehavior intact -> blacklist escalation);
+                # connstate/event cleanup rides its closed callback.
+                proxy.on_remote_closed(
+                    msg.get("reason", ""), bool(msg.get("mis"))
+                )
+            elif entry is not None:
                 _shard, desc = entry
                 self._safe_conn_closed(
                     desc, msg.get("reason", ""), bool(msg.get("mis"))
+                )
+        elif t == "piece":
+            self._on_piece(w, msg)
+        elif t == "written":
+            fut = self._pending_writes.get((w.shard, msg.get("slot")))
+            if fut is not None and not fut.done():
+                if msg.get("ok"):
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(
+                        OSError(f"leech shard {w.shard}: pwrite failed")
+                    )
+        elif t == "frame":
+            proxy = self._proxies.get(msg.get("cid"))
+            if proxy is not None:
+                proxy.on_frame(
+                    msg.get("mt"), msg.get("h") or {}, msg.get("p") or b""
                 )
         elif t == "spans":
             # Worker serve spans come home: adopt them so the parent's
@@ -942,6 +1438,35 @@ class ShardPool:
             )
         elif t == "ready":
             pass
+
+    def _on_piece(self, w: _Worker, msg: dict) -> None:
+        """A leech worker landed a complete piece in its ring: build the
+        zero-copy Message (payload = a view of the shared mapping, lease
+        = the slot) and deliver it to the owning proxy exactly like the
+        recv loop's payload-handler bypass."""
+        cid, slot, ln = msg.get("cid"), msg.get("slot"), msg.get("ln", 0)
+        idx = msg.get("idx")
+        entry = self._conns.get(cid)
+        proxy = self._proxies.get(cid)
+        if w.ring is None or not isinstance(slot, int):
+            return
+        lease = _SlotLease(
+            self, w.shard, slot,
+            entry[1].get("name", "") if entry else "",
+        )
+        self.slot_leases += 1
+        if proxy is None or not isinstance(idx, int):
+            lease.release()  # conn already gone: free the slot
+            return
+        m = Message(
+            MsgType.PIECE_PAYLOAD, {"index": idx},
+            w.ring.view(slot, ln), lease=lease,
+        )
+        try:
+            proxy.deliver_payload(m)
+        except Exception:
+            m.release()
+            _log.exception("leech payload delivery failed")
 
     def _safe_conn_closed(self, desc: dict, reason: str, mis: bool) -> None:
         try:
@@ -967,7 +1492,26 @@ class ShardPool:
         for cid, (s, desc) in list(self._conns.items()):
             if s == shard:
                 self._conns.pop(cid, None)
-                self._safe_conn_closed(desc, "worker_exit", False)
+                proxy = self._proxies.pop(cid, None)
+                if proxy is not None:
+                    # No blacklist: worker death is OUR fault, not the
+                    # peer's -- the dispatcher drops + requeues.
+                    proxy.on_remote_closed("worker_exit", False)
+                else:
+                    self._safe_conn_closed(desc, "worker_exit", False)
+        # In-flight verdict writes can never be acked now: fail them so
+        # write_piece raises, the piece stays unmarked, and it requeues.
+        for key, fut in list(self._pending_writes.items()):
+            if key[0] == shard:
+                self._pending_writes.pop(key, None)
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("leech worker exited mid-write")
+                    )
+        if w.ring is not None:
+            # Best-effort unmap; in-flight views keep the pages alive
+            # until verify finishes with them. The respawn maps fresh.
+            w.ring.close()
         expected = w.retiring or self._stopping
         if not expected:
             self._c_crashes.inc(shard=w.label)

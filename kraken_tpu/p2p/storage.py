@@ -64,6 +64,26 @@ class BatchedVerifier:
         self._queue: list[tuple[bytes, bytes, asyncio.Future]] = []
         self._flusher: Optional[asyncio.Task] = None
         self._inflight: set[asyncio.Task] = set()  # strong refs to hash tasks
+        # Coalescing observability (cached refs: one flush per batch, but
+        # the degenerate batch-of-1 case this exists to expose IS the
+        # per-piece path): the size histogram says whether arrivals
+        # actually coalesce, and the per-path batch counter splits host
+        # SHA from TPU dispatches -- verify_pieces_total /
+        # verify_batches_total is the average batch size on a dashboard.
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        self._h_batch_size = REGISTRY.histogram(
+            "verify_batch_size",
+            "Pieces coalesced into each verify flush",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._c_batches = REGISTRY.counter(
+            "verify_batches_total",
+            "Verify flushes dispatched, by hash path (host|tpu)",
+        )
+        self._path_label = (
+            "host" if getattr(self.hasher, "name", "cpu") == "cpu" else "tpu"
+        )
 
     async def verify(self, data: bytes | memoryview, expected: bytes) -> bool:
         # ``data`` may be a pooled memoryview (zero-copy recv path): the
@@ -95,6 +115,8 @@ class BatchedVerifier:
             "verify_batch_occupancy",
             "Batch fill of the last verify flush (batched / max_batch)",
         ).set(len(batch) / self._max_batch)
+        self._h_batch_size.observe(len(batch))
+        self._c_batches.inc(1, path=self._path_label)
         # The hash itself runs OFF the event loop: a full batch is hundreds
         # of MBs (CPU: ~100+ ms; TPU: a blocking device round-trip), and an
         # on-loop hash stalls every conn pump, announce, and accept for the
@@ -414,13 +436,29 @@ class Torrent:
             raise PieceError(f"short read on piece {i}")
         return data
 
-    async def write_piece(self, i: int, data: bytes | memoryview) -> bool:
+    async def write_piece(
+        self,
+        i: int,
+        data: bytes | memoryview,
+        remote_write=None,
+    ) -> bool:
         """Verify + persist piece ``i``. Returns True when this write
         completed the torrent. Raises :class:`PieceError` on corrupt data
         (callers blacklist the sender). File IO runs off-loop so a disk
         stall can't freeze the scheduler. ``data`` may be a pooled
         memoryview flowing straight from the wire to ``os.pwrite`` --
-        the caller releases its lease only after this returns."""
+        the caller releases its lease only after this returns.
+
+        ``remote_write`` (leech-shard plane): an async callable taking
+        the piece index that persists the already-verified bytes in the
+        WORKER that received them -- the payload stays in its shared-
+        memory slot and never crosses back to this process. It replaces
+        only the data-write step; verify, duplicate checks, the bit
+        mark, and commit all stay here, so the crash-resume invariant
+        (bit set only after the data is durably written) holds
+        unchanged. A remote write that fails (worker died mid-flight)
+        raises, the piece stays unmarked, and the dispatcher requeues
+        it like any peer error."""
         if self._status is None:
             # With endgame duplication a second copy of the final piece
             # can arrive after completion: a benign duplicate, never a
@@ -445,7 +483,10 @@ class Torrent:
         # write: it requires every bit set, and piece i's bit is only set
         # below, after this write returns.
         t0 = _time.perf_counter()
-        await asyncio.to_thread(self._write_at, i, data)
+        if remote_write is not None:
+            await remote_write(i)
+        else:
+            await asyncio.to_thread(self._write_at, i, data)
         self.write_wall += _time.perf_counter() - t0
         async with self._lock:
             # Re-check under the lock: a concurrent writer of the same

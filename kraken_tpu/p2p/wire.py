@@ -179,13 +179,29 @@ class Message:
         return cls(MsgType.PEER_EXCHANGE, {"a": added, "d": dropped})
 
 
-def _head(msg: Message, header: bytes) -> bytes:
+def frame_head(mtype: int, header: bytes, payload_len: int) -> bytes:
+    """The 9-byte prefix + packed header of one frame -- the single
+    definition of the wire layout. Shared by the stream send path here
+    and the shardpool workers' raw-socket paths (seed serves and the
+    leech plane's parent-authored control frames), so the framing can
+    never skew between the main loop and the forked halves."""
     return (
-        bytes([msg.type])
+        bytes([mtype])
         + len(header).to_bytes(4, "big")
-        + len(msg.payload).to_bytes(4, "big")
+        + payload_len.to_bytes(4, "big")
         + header
     )
+
+
+def frame_bytes(mtype: int, header: dict, payload: bytes = b"") -> bytes:
+    """One fully-encoded frame from its parts (control frames only --
+    payload rides inline, so callers keep it small)."""
+    packed = msgpack.packb(header)
+    return frame_head(mtype, packed, len(payload)) + payload
+
+
+def _head(msg: Message, header: bytes) -> bytes:
+    return frame_head(msg.type, header, len(msg.payload))
 
 
 async def send_messages(

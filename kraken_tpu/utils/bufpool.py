@@ -26,6 +26,7 @@ plain sync code.
 
 from __future__ import annotations
 
+import mmap
 import threading
 
 MIN_CLASS = 1 << 12  # 4 KiB: below this, pooling costs more than malloc
@@ -168,3 +169,68 @@ class BufferPool:
         self._g_leased.set(leased, pool=self.name)
         self._g_hit.set(ratio, pool=self.name)
         self._g_retained.set(retained, pool=self.name)
+
+
+class SlabRing:
+    """Fixed-slot shared-memory slab for the leech-shard plane.
+
+    One anonymous ``MAP_SHARED`` mapping, created in the scheduler
+    BEFORE a leech worker forks, so both processes address the same
+    pages: the worker's recv pump lands PIECE_PAYLOAD bytes straight
+    into a leased slot, and the parent verifies through a zero-copy
+    ``view()`` of the very same memory -- the payload never crosses the
+    SEQPACKET control channel, only its slot index does.
+
+    Slot sizing follows the bufpool's power-of-two classes (``slot
+    class`` = :func:`_class_for` of the largest piece the plane
+    accepts); handoff gating in the scheduler keeps any torrent with a
+    longer piece length on the main loop. Lease accounting is single-
+    owner by design: the WORKER leases and releases (its post-fork copy
+    of the free list is authoritative); the parent only reads views and
+    mirrors the in-flight count for its leak audit. The lock still
+    guards the free list because worker-side releases arrive from the
+    control-channel reader while leases happen in conn pumps.
+    """
+
+    __slots__ = ("_mm", "slots", "slot_bytes", "_free", "_lock", "leased")
+
+    def __init__(self, slots: int, slot_bytes: int):
+        self.slots = max(1, slots)
+        self.slot_bytes = _class_for(slot_bytes)
+        self._mm = mmap.mmap(-1, self.slots * self.slot_bytes)
+        self._free: list[int] = list(range(self.slots))
+        self._lock = threading.Lock()
+        self.leased = 0
+
+    def lease(self) -> int | None:
+        """Claim a free slot index, or None when the ring is full (the
+        caller backpressures the conn -- TCP does the rest)."""
+        with self._lock:
+            if not self._free:
+                return None
+            self.leased += 1
+            return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if 0 <= slot < self.slots and slot not in self._free:
+                self._free.append(slot)
+                self.leased = max(0, self.leased - 1)
+
+    def view(self, slot: int, n: int) -> memoryview:
+        """Writable view of ``slot``'s first ``n`` bytes. Valid in both
+        processes; the mapping outlives a dead worker, so in-flight
+        parent-side views stay readable after a crash."""
+        if not 0 <= slot < self.slots or n > self.slot_bytes:
+            raise ValueError(f"slot {slot} ({n}B) outside ring")
+        off = slot * self.slot_bytes
+        return memoryview(self._mm)[off : off + n]
+
+    def close(self) -> None:
+        """Best-effort unmap: exported views (a verify batch still
+        holding one) keep the mapping alive until they die -- dropping
+        the object is always safe."""
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass

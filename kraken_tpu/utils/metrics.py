@@ -257,14 +257,18 @@ def record_hash_pool_metrics(
 
 def record_data_plane_shard(
     shard: str, *, conns: int, bytes_delta: float, serves_delta: float,
-    cpu_seconds: float, registry: Registry = REGISTRY,
+    cpu_seconds: float, bytes_down_delta: float = 0.0,
+    pieces_delta: float = 0.0, registry: Registry = REGISTRY,
 ) -> None:
-    """Aggregate one seed-serve worker's counters onto the main metrics
+    """Aggregate one data-plane worker's counters onto the main metrics
     mux (p2p/shardpool.py publishes them over the control pipe; workers
     have no HTTP listener of their own). Labeled ``shard=
-    "data_plane_shard{n}"`` so a hot shard, an idle shard, and a
-    crash-looping shard are distinguishable on one dashboard; deltas
-    keep counter semantics across worker restarts."""
+    "data_plane_shard{n}"`` (seed-serve plane) or ``"leech_shard{n}"``
+    (download plane) so a hot shard, an idle shard, and a crash-looping
+    shard are distinguishable on one dashboard; deltas keep counter
+    semantics across worker restarts. ``bytes_down_delta`` /
+    ``pieces_delta`` are the leech plane's receive-side counters and
+    stay zero for seed shards."""
     registry.gauge(
         "data_plane_worker_conns",
         "Live seed conns served by each worker shard",
@@ -283,6 +287,16 @@ def record_data_plane_shard(
             "data_plane_worker_serves_total",
             "Piece serves completed by worker shards",
         ).inc(serves_delta, shard=shard)
+    if bytes_down_delta:
+        registry.counter(
+            "data_plane_worker_bytes_received_total",
+            "Piece payload bytes received by leech worker shards",
+        ).inc(bytes_down_delta, shard=shard)
+    if pieces_delta:
+        registry.counter(
+            "data_plane_worker_pieces_total",
+            "Piece payloads landed in the shared ring by leech shards",
+        ).inc(pieces_delta, shard=shard)
 
 
 # Wire-plane buffer pool gauges -- bufpool_leased / bufpool_hit_ratio /

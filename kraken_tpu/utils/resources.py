@@ -414,6 +414,11 @@ class ResourceSentinel:
         shardpool = getattr(self.scheduler, "_shardpool", None)
         if shardpool is not None:
             workers = shardpool.worker_info()
+        # Leech worker shards are the same supervision story on the
+        # download plane: fold them into the identical budgets.
+        leechpool = getattr(self.scheduler, "_leech_pool", None)
+        if leechpool is not None:
+            workers = workers + leechpool.worker_info()
         worker_fds = 0
         worker_rss = 0
         workers_alive = 0
@@ -428,6 +433,8 @@ class ResourceSentinel:
             worker_rss += wrss or 0
         workers_expected = (
             shardpool.expected_workers if shardpool is not None else 0
+        ) + (
+            leechpool.expected_workers if leechpool is not None else 0
         )
         if fds is not None:
             fds += worker_fds

@@ -116,9 +116,21 @@ def test_scheduler_sections_construct_scheduler_config():
                 f"{path}: shipped data_plane_workers must default to 0"
             )
             workers_shipped += 1
+        # Multi-core LEECH plane (round 19): same contract -- the knob
+        # constructs, ships 0 (forking download pumps is an explicit
+        # operator decision), and the ring budget stays sane.
+        assert cfg.leech_workers >= 0, path
+        assert cfg.leech_ring_mb >= 4, path  # must hold >= one 4 MiB slot
+        if "leech_workers" in sc:
+            assert cfg.leech_workers == 0, (
+                f"{path}: shipped leech_workers must default to 0"
+            )
         seen += 1
     assert seen >= 2  # origin + agent ship the wire-plane knobs
     assert workers_shipped >= 2  # origin + agent register the knob
+    # The agent yaml registers the leech knobs (origins drop them).
+    agent_sc = load_config("config/agent/base.yaml").get("scheduler") or {}
+    assert "leech_workers" in agent_sc and "leech_ring_mb" in agent_sc
 
 
 def test_rpc_sections_construct_rpc_config():

@@ -32,14 +32,15 @@ sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 
 
 def _median_wall(n: int, blob_mb: int, piece_kb: int,
-                 workers: int = 0) -> float:
+                 workers: int = 0, leech_workers: int = 0) -> float:
     from bench_pair import run_pair
 
     walls = []
     for _ in range(n):
         with tempfile.TemporaryDirectory() as root:
             r = asyncio.run(run_pair(blob_mb, piece_kb, root,
-                                     workers=workers))
+                                     workers=workers,
+                                     leech_workers=leech_workers))
             walls.append(r["wall_s"])
     return statistics.median(walls)
 
@@ -96,6 +97,43 @@ def test_pair_pump_knockout_band_with_workers(monkeypatch):
     assert 0.8 <= ratio <= 3.0, (
         f"workers-on pump-knockout ratio {ratio:.2f} outside [0.8, 3.0] "
         f"(full {full:.3f}s / knockout {knockout:.3f}s)"
+    )
+
+
+def test_pair_pump_knockout_band_with_leech_workers(monkeypatch):
+    """The ratio gate with the DOWNLOAD half sharded onto leech worker
+    processes (round 19, p2p/shardpool.py leech mode): recv + frame
+    parse + pwrite run in the forked pump, payloads cross via the
+    shared ring, and verify stays batched in the parent -- so the
+    verify knockout still strictly removes parent-side work and the
+    ratio must hold in the same band. Below 0.8 the leech plane broke
+    the knockout; past 3.0 the handoff re-introduced per-piece
+    machinery on the main loop (slot bookkeeping, verdict round-trips,
+    or ring copies that should not exist). Skipped on single-core
+    rigs, where forking a download pump measures scheduler contention,
+    not the plane."""
+    import os
+
+    import pytest
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("leech workers band needs >= 2 cores")
+
+    from kraken_tpu.p2p import storage as st
+
+    full = _median_wall(3, blob_mb=64, piece_kb=256, leech_workers=2)
+
+    async def _verified(self, data, expected):
+        return True
+
+    monkeypatch.setattr(st.BatchedVerifier, "verify", _verified)
+    monkeypatch.setattr(st.Torrent, "_write_at", lambda self, i, data: None)
+    knockout = _median_wall(3, blob_mb=64, piece_kb=256, leech_workers=2)
+
+    ratio = full / knockout
+    assert 0.8 <= ratio <= 3.0, (
+        f"leech-workers-on pump-knockout ratio {ratio:.2f} outside "
+        f"[0.8, 3.0] (full {full:.3f}s / knockout {knockout:.3f}s)"
     )
 
 

@@ -1,0 +1,335 @@
+"""The multi-core DOWNLOAD plane (p2p/shardpool.py leech mode): worker
+shards pumping active-download conns, the shared-memory piece ring, and
+the parent-side verify-then-write verdict loop.
+
+What must hold, per docs/OPERATIONS.md "Leech workers":
+
+- a pull pumped through a leech worker is BIT-IDENTICAL to the blob
+  (the bytes travel worker recv -> shared ring -> parent batched verify
+  -> worker pwrite, and only verdicts cross the fork boundary);
+- every ring slot leased for a piece payload is returned -- happy path,
+  corrupt-ban path, and worker-crash path all drain to zero;
+- a mid-recv disconnect (failpoint ``p2p.shard.leech.disconnect``) only
+  costs a requeue: the piece lands from a healthy peer, no ban;
+- a corrupt piece received BY A WORKER (failpoint
+  ``p2p.shard.leech.corrupt``) fails the PARENT's batched verify and
+  escalates to the parent blacklist exactly like main-loop corruption
+  -- and the corrupt bytes never land in the blob;
+- SIGKILL of a leech worker respawns the shard, requeues its conns'
+  outstanding requests WITHOUT blacklisting anyone (worker death is our
+  fault, not the peer's), and leaks no fds or worker processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.metrics import REGISTRY
+
+from tests.test_shardpool import (
+    NS,
+    FakeTracker,
+    _metainfo,
+    _poll,
+    make_sched,
+)
+
+
+def _leech_counter(name: str, shards: int = 8) -> float:
+    c = REGISTRY.counter(name)
+    return sum(c.value(shard=f"leech_shard{i}") for i in range(shards))
+
+
+def _make_swarm(tmp_path, tracker, blob, piece_len, *, origins=1,
+                leech_workers=1):
+    mi = _metainfo(blob, piece_len)
+    tracker.metainfos[mi.digest.hex] = mi
+    seeds = []
+    for i in range(origins):
+        o, _ = make_sched(
+            tmp_path, f"origin{i}", tracker, seed_blobs=[blob]
+        )
+        seeds.append(o)
+    agent, astore = make_sched(
+        tmp_path, "agent", tracker, leech_workers=leech_workers
+    )
+    return mi, seeds, agent, astore
+
+
+async def _assert_leases_drained(pool):
+    await _poll(
+        lambda: pool.slot_leases == 0,
+        msg=f"{pool.slot_leases} ring slot leases never returned",
+    )
+
+
+def test_leech_worker_pull_bit_identical_and_leases_returned(tmp_path):
+    async def run():
+        blob = np.random.default_rng(11).integers(
+            0, 256, size=4 << 20, dtype=np.uint8
+        ).tobytes()
+        tracker = FakeTracker()
+        mi, seeds, agent, astore = _make_swarm(
+            tmp_path, tracker, blob, 256 << 10
+        )
+        d = mi.digest
+        verify0 = REGISTRY.counter("verify_batches_total").value(path="host")
+        pieces0 = _leech_counter("data_plane_worker_pieces_total")
+        await seeds[0].start()
+        try:
+            seeds[0].seed(mi, NS)
+            await agent.start()
+            try:
+                pool = agent._leech_pool
+                assert pool is not None and pool.alive_workers == 1
+                await asyncio.wait_for(agent.download(NS, d), 60)
+                # The conn genuinely went through the worker shard.
+                assert pool.num_conns >= 1, "conn never handed to shard"
+                await _assert_leases_drained(pool)
+                info = pool.worker_info()
+                assert len(info) == 1 and info[0]["alive"]
+                pids = [w["pid"] for w in info]
+                # Verify ran through BatchedVerifier (batch observability
+                # rides the same flushes).
+                assert (
+                    REGISTRY.counter("verify_batches_total").value(path="host")
+                    > verify0
+                )
+                # Worker stats land on a 0.25 s cadence -- poll for the
+                # ring-landing counter.
+                await _poll(
+                    lambda: _leech_counter("data_plane_worker_pieces_total")
+                    > pieces0,
+                    msg="no pieces counted through the leech shard",
+                )
+            finally:
+                await agent.stop()
+            with await asyncio.to_thread(open, astore.cache_path(d), "rb") as f:
+                got = await asyncio.to_thread(f.read)
+            assert got == blob, "leech-worker pull not bit-identical"
+            assert agent._leech_pool is None
+        finally:
+            await seeds[0].stop()
+        # Every shard reaped at stop -- no orphaned pumps.
+        for pid in pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+
+    asyncio.run(run())
+
+
+def test_mid_recv_disconnect_requeues_to_healthy_peer(tmp_path):
+    """Chaos: the worker's recv pump loses the conn mid-piece. The
+    partial slot is freed, the request requeues, and the piece lands
+    from a healthy peer -- a connectivity blip, not a ban."""
+
+    async def run():
+        blob = np.random.default_rng(12).integers(
+            0, 256, size=2 << 20, dtype=np.uint8
+        ).tobytes()
+        tracker = FakeTracker()
+        # Armed BEFORE anything starts: the forked leech shard inherits
+        # the registry (the failpoint plane's worker story).
+        failpoints.FAILPOINTS.arm("p2p.shard.leech.disconnect", "once")
+        mi, seeds, agent, astore = _make_swarm(
+            tmp_path, tracker, blob, 128 << 10, origins=2
+        )
+        d = mi.digest
+        for o in seeds:
+            await o.start()
+            o.seed(mi, NS)
+        try:
+            await agent.start()
+            try:
+                await asyncio.wait_for(agent.download(NS, d), 60)
+                pool = agent._leech_pool
+                await _assert_leases_drained(pool)
+                # Connectivity, not misbehavior: neither seeder may
+                # carry a HARD offense over the drop (soft cool-off
+                # entries keep offense count 0).
+                for o in seeds:
+                    entry = agent.conn_state.blacklist._entries.get(
+                        (o.peer_id, mi.info_hash)
+                    )
+                    assert entry is None or entry[1] == 0, (
+                        "mid-recv disconnect hard-banned a healthy peer"
+                    )
+            finally:
+                await agent.stop()
+            with await asyncio.to_thread(open, astore.cache_path(d), "rb") as f:
+                assert await asyncio.to_thread(f.read) == blob
+        finally:
+            for o in seeds:
+                await o.stop()
+            failpoints.FAILPOINTS.disarm("p2p.shard.leech.disconnect")
+
+    asyncio.run(run())
+
+
+def test_corrupt_piece_in_worker_escalates_parent_blacklist(tmp_path):
+    """A piece that lands corrupt through a worker's ring slot fails
+    the PARENT's batched verify; the verdict must travel the same
+    misbehavior road as a main-loop corrupt piece: hard blacklist,
+    requeue, and -- the crash-resume invariant -- the corrupt bytes
+    never reach the blob (verify-then-write)."""
+
+    async def run():
+        blob = np.random.default_rng(13).integers(
+            0, 256, size=2 << 20, dtype=np.uint8
+        ).tobytes()
+        tracker = FakeTracker()
+        failpoints.FAILPOINTS.arm("p2p.shard.leech.corrupt", "once")
+        mi, seeds, agent, astore = _make_swarm(
+            tmp_path, tracker, blob, 128 << 10, origins=2
+        )
+        d = mi.digest
+        for o in seeds:
+            await o.start()
+            o.seed(mi, NS)
+        try:
+            await agent.start()
+            try:
+                await asyncio.wait_for(agent.download(NS, d), 60)
+                # Exactly the peer that fed us the flipped bit is banned.
+                banned = [
+                    o for o in seeds
+                    if agent.conn_state.blacklist.blocked(
+                        o.peer_id, mi.info_hash
+                    )
+                ]
+                assert len(banned) == 1, (
+                    f"corrupt verdict banned {len(banned)} peers, want 1"
+                )
+                await _assert_leases_drained(agent._leech_pool)
+            finally:
+                await agent.stop()
+            # Bit-identical = the corrupt payload was never pwritten.
+            with await asyncio.to_thread(open, astore.cache_path(d), "rb") as f:
+                assert await asyncio.to_thread(f.read) == blob
+        finally:
+            for o in seeds:
+                await o.stop()
+            failpoints.FAILPOINTS.disarm("p2p.shard.leech.corrupt")
+
+    asyncio.run(run())
+
+
+def test_leech_worker_sigkill_respawns_and_requeues(tmp_path):
+    """Crash-shape chaos: SIGKILL the pump mid-life. The supervisor
+    respawns the shard, the dead worker's conns close as OUR fault (no
+    blacklist -- the peer did nothing), in-flight requests requeue, and
+    a subsequent pull runs through the respawned worker. Zero leaked
+    slots, zero orphaned processes."""
+
+    async def run():
+        rng = np.random.default_rng(14)
+        blob1 = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+        blob2 = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+        tracker = FakeTracker()
+        mi1 = _metainfo(blob1, 128 << 10)
+        tracker.metainfos[mi1.digest.hex] = mi1
+        mi2 = _metainfo(blob2, 128 << 10)
+        tracker.metainfos[mi2.digest.hex] = mi2
+        # Both blobs seeded up front -- the second pull exercises the
+        # RESPAWNED shard.
+        origin, _ostore = make_sched(
+            tmp_path, "origin", tracker, seed_blobs=[blob1, blob2]
+        )
+        agent, astore = make_sched(
+            tmp_path, "agent", tracker, leech_workers=1
+        )
+        await origin.start()
+        try:
+            origin.seed(mi1, NS)
+            await agent.start()
+            try:
+                pool = agent._leech_pool
+                crashes0 = _leech_counter("data_plane_worker_crashes_total")
+                await asyncio.wait_for(agent.download(NS, mi1.digest), 60)
+                # The handed-off conn idles in the shard (churn not yet
+                # due) -- kill the pump under it.
+                assert pool.num_conns >= 1
+                pid0 = pool.worker_info()[0]["pid"]
+                os.kill(pid0, signal.SIGKILL)
+                await _poll(
+                    lambda: pool.alive_workers == 1
+                    and pool.worker_info()[0]["pid"] != pid0,
+                    msg="killed leech shard never respawned",
+                )
+                assert (
+                    _leech_counter("data_plane_worker_crashes_total")
+                    > crashes0
+                )
+                # Worker death is our fault: nobody got blacklisted.
+                assert not agent.conn_state.blacklist.blocked(
+                    origin.peer_id, mi1.info_hash
+                ), "worker crash blamed on an innocent peer"
+                await _assert_leases_drained(pool)
+                # The fleet keeps pulling: the second blob runs through
+                # the RESPAWNED shard end to end.
+                origin.seed(mi2, NS)
+                await asyncio.wait_for(agent.download(NS, mi2.digest), 60)
+                await _assert_leases_drained(pool)
+                pids = [w["pid"] for w in pool.worker_info()]
+            finally:
+                await agent.stop()
+            with await asyncio.to_thread(
+                open, astore.cache_path(mi2.digest), "rb"
+            ) as f:
+                assert await asyncio.to_thread(f.read) == blob2, (
+                    "post-respawn pull differs"
+                )
+        finally:
+            await origin.stop()
+        for pid in pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+
+    asyncio.run(run())
+
+
+def test_leech_pool_skips_shaped_and_oversize(tmp_path):
+    """The handoff classifier's negative gates: ingress-shaped nodes
+    and pieces larger than a ring slot stay on the main loop (and the
+    pull still completes there)."""
+
+    async def run():
+        from kraken_tpu.utils.bandwidth import BandwidthLimiter
+
+        blob = np.random.default_rng(15).integers(
+            0, 256, size=1 << 20, dtype=np.uint8
+        ).tobytes()
+        tracker = FakeTracker()
+        mi, seeds, agent, astore = _make_swarm(
+            tmp_path, tracker, blob, 128 << 10
+        )
+        d = mi.digest
+        # Shaped agent: leech pool configured AND running, but the token
+        # bucket is in-process state -- conns must stay on the loop.
+        shaped, sstore = make_sched(
+            tmp_path, "shaped", tracker, leech_workers=1,
+            bandwidth=BandwidthLimiter(ingress_bps=1 << 30),
+        )
+        await seeds[0].start()
+        try:
+            seeds[0].seed(mi, NS)
+            await shaped.start()
+            try:
+                await asyncio.wait_for(shaped.download(NS, d), 60)
+                assert shaped._leech_pool.num_conns == 0, (
+                    "shaped node handed a conn to the leech plane"
+                )
+            finally:
+                await shaped.stop()
+            with await asyncio.to_thread(open, sstore.cache_path(d), "rb") as f:
+                assert await asyncio.to_thread(f.read) == blob
+        finally:
+            await seeds[0].stop()
+
+    asyncio.run(run())

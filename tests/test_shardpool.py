@@ -84,7 +84,7 @@ def _metainfo(blob: bytes, piece_len: int) -> MetaInfo:
 
 
 def make_sched(root, name, tracker, *, seed_blobs=None, workers=0,
-               bandwidth=None, churn_idle=4.0):
+               leech_workers=0, bandwidth=None, churn_idle=4.0):
     store = CAStore(os.path.join(str(root), name))
     ref: dict = {}
     is_origin = seed_blobs is not None
@@ -110,6 +110,7 @@ def make_sched(root, name, tracker, *, seed_blobs=None, workers=0,
             retry_tick_seconds=0.2,
             max_announce_rate=2000.0,
             data_plane_workers=workers,
+            leech_workers=leech_workers,
             conn_churn_idle_seconds=churn_idle,
             conn_state=ConnStateConfig(
                 max_open_conns_per_torrent=64 if is_origin else 10

@@ -435,6 +435,137 @@ async def _drive_workers(tmp_path):
     )
 
 
+# -- tier 1: mini-soak with the multi-core LEECH plane ---------------------
+
+def test_mini_soak_with_leech_workers(tmp_path):
+    """The fleet-survival contract extended to the DOWNLOAD plane's
+    forked shards: pulls pumped through leech workers (shared-ring recv
+    + worker pwrite), delete -> re-pull torrent cycles (evict fan-out
+    closes the workers' writable fds), full teardown. fd delta exactly
+    0 in the parent, every ring slot lease returned, bufpool clean,
+    zero store debris, ZERO orphaned worker processes."""
+    asyncio.run(_drive_leech_workers(tmp_path))
+
+
+async def _drive_leech_workers(tmp_path):
+    from kraken_tpu.p2p.scheduler import SchedulerConfig
+
+    gc.collect()
+    fd_baseline = open_fd_count()
+
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    tracker = TrackerNode(
+        announce_interval_seconds=0.1,
+        peer_ttl_seconds=5.0,
+        ring_refresh_seconds=0.2,
+    )
+    await tracker.start()
+    # Both planes forked at once: origin serves through seed shards,
+    # the agent pumps downloads through leech shards.
+    origin = _origin(
+        tmp_path, "o0", [addr], port,
+        scheduler_config_doc={"data_plane_workers": 1},
+    )
+    origin.tracker_addr = tracker.addr
+    await origin.start()
+    cluster = ClusterClient(
+        Ring(HostList(static=[addr]), max_replica=1),
+        client_factory=lambda a: BlobClient(a, HTTPClient(retries=0)),
+    )
+    tracker.server.origin_cluster = cluster
+    agent = AgentNode(
+        store_root=str(tmp_path / "a0"),
+        tracker_addr=tracker.addr,
+        scheduler_config=SchedulerConfig.from_dict(
+            {"leech_workers": 2, "leech_ring_mb": 8}
+        ),
+    )
+    await agent.start()
+    http = HTTPClient(timeout_seconds=30)
+    worker_pids: list[int] = []
+    try:
+        pool = agent.scheduler._leech_pool
+        assert pool is not None and pool.alive_workers == 2
+        worker_pids = [w["pid"] for w in pool.worker_info()]
+        worker_pids += [
+            w["pid"] for w in origin.scheduler._shardpool.worker_info()
+        ]
+
+        from kraken_tpu.utils.metrics import REGISTRY
+
+        def ring_pieces() -> float:
+            c = REGISTRY.counter("data_plane_worker_pieces_total")
+            return sum(c.value(shard=f"leech_shard{i}") for i in range(2))
+        pieces0 = ring_pieces()
+
+        blobs: dict[str, bytes] = {}
+        for i in range(4):
+            blob = os.urandom(BLOB_BYTES) + i.to_bytes(4, "big")
+            d = Digest.from_bytes(blob)
+            await cluster.upload("ns", d, blob)
+            blobs[d.hex] = blob
+        for hexd, blob in blobs.items():
+            got = await http.get(
+                f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+            )
+            assert got == blob, f"leech-pumped pull differs: {hexd[:8]}"
+        # Torrent churn THROUGH the leech plane: delete + re-pull runs
+        # the evict fan-out (workers drop their writable .part fds) and
+        # fresh handoffs.
+        for hexd, blob in list(blobs.items())[:2]:
+            await http.delete(f"http://{agent.addr}/blobs/{hexd}")
+            got = await http.get(
+                f"http://{agent.addr}/namespace/ns/blobs/{hexd}"
+            )
+            assert got == blob, f"re-pull after delete differs: {hexd[:8]}"
+
+        # Pieces genuinely landed through the shared ring (stats pipe
+        # lands on a 0.25 s cadence -- poll briefly).
+        deadline = time.monotonic() + 5.0
+        while ring_pieces() <= pieces0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        assert ring_pieces() > pieces0, "no pieces via leech shards"
+
+        # Every ring slot lease returned, both bufpools clean.
+        for _ in range(100):
+            if pool.slot_leases == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert pool.slot_leases == 0, (
+            f"{pool.slot_leases} ring slot leases never returned"
+        )
+        for sched in (origin.scheduler, agent.scheduler):
+            for _ in range(100):
+                if sched._bufpool.leased == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert sched._bufpool.leased == 0
+        for store in (origin.store, agent.store):
+            debris = _strict_debris(store)
+            assert not any(debris.values()), f"debris: {debris}"
+    finally:
+        await http.close()
+        await agent.stop()
+        await cluster.close()
+        await origin.stop()
+        await tracker.stop()
+
+    # Zero orphaned worker processes on EITHER plane.
+    assert worker_pids, "no worker shards observed"
+    for pid in worker_pids:
+        try:
+            os.kill(pid, 0)
+            raise AssertionError(f"orphaned worker pid {pid}")
+        except ProcessLookupError:
+            pass
+
+    fd_after = await _settle_fds(fd_baseline)
+    assert fd_after == fd_baseline, (
+        f"fd leak with leech workers: {fd_baseline} before, {fd_after} after"
+    )
+
+
 # -- tier 2: gated origin soak (KT_SOAK=1, -m slow) ------------------------
 
 @pytest.mark.slow
