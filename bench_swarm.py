@@ -27,15 +27,21 @@ import argparse
 import asyncio
 import json
 import os
+import socket
 import time
 
 import numpy as np
 
+from kraken_tpu.assembly import OriginNode
 from kraken_tpu.core.digest import Digest
 from kraken_tpu.core.hasher import get_hasher
 from kraken_tpu.core.metainfo import MetaInfo
 from kraken_tpu.core.peer import PeerID, PeerInfo
+from kraken_tpu.origin.client import BlobClient
+from kraken_tpu.origin.server import QuorumConfig
+from kraken_tpu.placement import HostList, Ring
 from kraken_tpu.p2p.scheduler import Scheduler, SchedulerConfig
+from kraken_tpu.utils.deadline import Deadline
 from kraken_tpu.p2p.storage import (
     AgentTorrentArchive,
     BatchedVerifier,
@@ -235,6 +241,91 @@ async def run_image_bench(
     }
 
 
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def run_push_availability(
+    n_blobs: int, blob_kb: int, write_quorum: int, root: str
+):
+    """Push-availability wave (ISSUE 20 row): 3 origins over a static
+    full-mesh ring, ``n_blobs`` pushed round-robin across them, origin #2
+    killed mid-wave. Measures the availability contract of the quorum
+    write plane: with ``write_quorum: 2`` an ack means a second origin
+    already holds the blob (a dead ring replica gets a hint instead of
+    failing the push -- sloppy quorum), so the success rate and commit
+    p99 quantify what durability costs while a third of the fleet is
+    down. Pushes aimed straight at the dead origin fail under a short
+    deadline either way; that shared loss is the client-side routing
+    story, not the quorum plane's."""
+    rng = np.random.default_rng(2)
+    ports = [_free_port() for _ in range(3)]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    quorum = (
+        QuorumConfig(write_quorum=write_quorum, push_timeout_seconds=5.0)
+        if write_quorum > 1 else None
+    )
+    nodes = []
+    for i in range(3):
+        node = OriginNode(
+            store_root=os.path.join(root, f"q{write_quorum}-origin{i}"),
+            http_port=ports[i],
+            ring=Ring(HostList(static=addrs), max_replica=3),
+            self_addr=addrs[i],
+            dedup=False,
+            quorum=quorum,
+            health_interval_seconds=30.0,
+        )
+        await node.start()
+        nodes.append(node)
+    clients = [BlobClient(a) for a in addrs]
+    victim = 2
+    kill_at = n_blobs // 2
+    killed = False
+    ok = failed = 0
+    commit_s: list[float] = []
+    try:
+        for i in range(n_blobs):
+            if i == kill_at and not killed:
+                await nodes[victim].stop()
+                killed = True
+            blob = rng.integers(
+                0, 256, size=blob_kb << 10, dtype=np.uint8
+            ).tobytes()
+            d = Digest.from_bytes(blob)
+            t0 = time.perf_counter()
+            try:
+                await clients[i % 3].upload(
+                    NS, d, blob,
+                    deadline=Deadline(8.0, component="bench-push"),
+                )
+            except Exception:
+                failed += 1
+            else:
+                ok += 1
+                commit_s.append(time.perf_counter() - t0)
+    finally:
+        for c in clients:
+            await c.close()
+        for i, node in enumerate(nodes):
+            if i != victim or not killed:
+                await node.stop()
+    lat = np.sort(np.asarray(commit_s)) if commit_s else np.asarray([0.0])
+    return {
+        "write_quorum": write_quorum,
+        "blobs": n_blobs,
+        "blob_kb": blob_kb,
+        "killed_origin_at_blob": kill_at,
+        "ok": ok,
+        "failed": failed,
+        "success_rate": ok / n_blobs if n_blobs else 0.0,
+        "commit_p50_s": float(lat[int(0.50 * (len(lat) - 1))]),
+        "commit_p99_s": float(lat[int(0.99 * (len(lat) - 1))]),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--agents", type=int, default=None,
@@ -244,11 +335,48 @@ def main():
     ap.add_argument("--image", action="store_true",
                     help="BASELINE row 2: multi-layer alpine+ubuntu-shaped"
                          " image pull (defaults --agents to 10)")
+    ap.add_argument("--push-availability", action="store_true",
+                    help="ISSUE 20 row: push success rate + commit p99"
+                         " with 1-of-3 origins killed mid-wave, quorum"
+                         " on (write_quorum=2) vs off")
+    ap.add_argument("--push-blobs", type=int, default=24,
+                    help="wave size for --push-availability")
+    ap.add_argument("--push-blob-kb", type=int, default=512,
+                    help="blob size for --push-availability")
     args = ap.parse_args()
 
     import tempfile
 
     with tempfile.TemporaryDirectory(prefix="kt-bench-swarm-") as root:
+        if args.push_availability:
+            off = asyncio.run(run_push_availability(
+                args.push_blobs, args.push_blob_kb, 1, root
+            ))
+            on = asyncio.run(run_push_availability(
+                args.push_blobs, args.push_blob_kb, 2, root
+            ))
+            for tag, out, base in (
+                ("quorum_off", off, None), ("quorum_on", on, off)
+            ):
+                print(json.dumps({
+                    "metric": f"push_success_rate_{tag}",
+                    "value": round(out["success_rate"], 4),
+                    "unit": "ratio",
+                    "vs_baseline": (
+                        round(base["success_rate"], 4) if base else None
+                    ),
+                    "detail": out,
+                }))
+                print(json.dumps({
+                    "metric": f"push_commit_p99_{tag}",
+                    "value": round(out["commit_p99_s"], 4),
+                    "unit": "s",
+                    "vs_baseline": (
+                        round(base["commit_p99_s"], 4) if base else None
+                    ),
+                    "detail": out,
+                }))
+            return
         if args.image:
             n = args.agents if args.agents is not None else 10
             out = asyncio.run(run_image_bench(

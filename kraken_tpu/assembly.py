@@ -30,7 +30,7 @@ from kraken_tpu.core.peer import PeerIDFactory
 from kraken_tpu.origin.blobrefresh import Refresher
 from kraken_tpu.origin.client import ClusterClient
 from kraken_tpu.origin.metainfogen import Generator, PieceLengthConfig
-from kraken_tpu.origin.server import OriginServer
+from kraken_tpu.origin.server import OriginServer, QuorumConfig
 from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, TaskStore
 from kraken_tpu.placement import Ring
@@ -213,6 +213,13 @@ def _ingest_config(ingest) -> IngestConfig:
     if isinstance(ingest, IngestConfig):
         return ingest
     return IngestConfig.from_dict(ingest)
+
+
+def _quorum_config(quorum) -> QuorumConfig:
+    """Same normalization for the YAML ``quorum:`` section."""
+    if isinstance(quorum, QuorumConfig):
+        return quorum
+    return QuorumConfig.from_dict(quorum)
 
 
 def _sync_ingest(node) -> None:
@@ -398,6 +405,14 @@ def _start_sentinel(node, component: str) -> ResourceSentinel:
         # `loop_lag` budget kind (resources: loop_lag_p99_seconds) --
         # a wedged event loop drains like any other resource breach.
         loop_lag_probe=monitor.p99 if monitor is not None else None,
+        # The persistedretry Manager's per-kind pending counts feed the
+        # `retry_queue_depth` gauge and the `retry_queue` budget kind --
+        # a wedged replication/hint queue pages before it silently grows
+        # unbounded.
+        retry_probe=(
+            node.retry.queue_depths
+            if getattr(node, "retry", None) is not None else None
+        ),
     )
     sentinel.start()
     return sentinel
@@ -658,6 +673,7 @@ class OriginNode:
         chunkstore: dict | ChunkStoreConfig | None = None,
         slo: dict | SLOConfig | None = None,
         ingest: dict | IngestConfig | None = None,
+        quorum: dict | QuorumConfig | None = None,
     ):
         from kraken_tpu.origin.dedup import DedupIndex
 
@@ -779,6 +795,11 @@ class OriginNode:
         # the burn-rate evaluators; /debug/slo on the mux. YAML `slo:`;
         # SIGHUP live-reloads.
         self.slo_config = _slo_config(slo)
+        # Quorum write plane (origin/server.py QuorumConfig): commit
+        # acks wait for write_quorum replicas, unreachable replicas get
+        # hinted handoff. YAML `quorum:`; shipped write_quorum: 1 (the
+        # compatible single-copy ack); SIGHUP live-reloads.
+        self.quorum_config = _quorum_config(quorum)
         self.loop_monitor: Optional[LoopLagMonitor] = None
         self.sentinel: Optional[ResourceSentinel] = None
         self.scrubber: Optional[Scrubber] = None
@@ -927,6 +948,7 @@ class OriginNode:
                 if self.ingest_config is not None
                 else False
             ),
+            quorum=self.quorum_config,
         )
         self._runner, self.http_port = await _serve(
             self.server.make_app(), self.host, self.http_port, "origin",
@@ -1068,6 +1090,12 @@ class OriginNode:
             # step; docs/OPERATIONS.md runbook). Disable needs a restart.
             self.ingest_config = _ingest_config(cfg["ingest"])
             _sync_ingest(self)
+        if cfg.get("quorum") is not None:
+            # Durability posture is a SIGHUP, not a restart: raising
+            # write_quorum starts gating acks from the NEXT commit.
+            self.quorum_config = _quorum_config(cfg["quorum"])
+            if self.server is not None:
+                self.server.quorum = self.quorum_config
 
     def apply_rpc(self, rpc: RPCConfig) -> None:
         """Swap the degradation knobs live: the announce budget, the

@@ -125,7 +125,7 @@ class _AuthSession:
             method, url, headers=hdrs, ok_statuses=tuple(ok) + redirects,
             retry_5xx=retry_5xx, allow_redirects=False,
         )
-        for _hop in range(5):
+        for _hop in range(5):  # kt-lint: disable=retry-without-deadline  # bounded 5-hop redirect follow, not a retry sweep; each hop is one HTTPClient request with its own timeout+retry budget
             if status not in redirects:
                 return status, h, b
             location = h.get("Location", "")

@@ -28,6 +28,7 @@ from kraken_tpu.buildindex.tagstore import TagStore
 from kraken_tpu.buildindex.tagtype import DependencyResolver
 from kraken_tpu.core.digest import Digest, DigestError
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
+from kraken_tpu.utils.deadline import Deadline
 from kraken_tpu.utils.httputil import HTTPClient, base_url
 
 REPLICATE_KIND = "tag_replicate"
@@ -160,9 +161,13 @@ class TagServer:
         # path pulls them from the remote cluster's backend on miss).
         if self.origin_cluster is not None:
             ns = tag.rpartition(":")[0] or tag
+            # One budget for the whole preheat sweep: a dead origin
+            # cluster must cost this replication handler one deadline,
+            # not len(deps) full client timeouts.
+            deadline = Deadline(60.0, component="buildindex-preheat")
             for dep in deps:
                 try:
-                    await self.origin_cluster.stat(ns, dep)
+                    await self.origin_cluster.stat(ns, dep, deadline=deadline)
                 except Exception:
                     # Best-effort preheat: the repair path covers a cold
                     # dep, but a persistently failing cluster should be

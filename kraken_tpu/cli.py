@@ -1080,6 +1080,12 @@ def main(argv: list[str] | None = None) -> None:
             # plane (docs/OPERATIONS.md "Pipelined ingest"). SIGHUP
             # live-reloads (and live-enables).
             ingest=cfg.get("ingest"),
+            # YAML: quorum: {write_quorum, hint_ttl_seconds,
+            # push_timeout_seconds} -- the quorum write plane
+            # (docs/OPERATIONS.md "Write durability"). Shipped
+            # write_quorum: 1 (single-copy ack, the compatible
+            # default); SIGHUP live-reloads.
+            quorum=cfg.get("quorum"),
         )
         asyncio.run(
             _run_until_signal(node, {"component": "origin"}, args.config)

@@ -31,6 +31,7 @@ RULE_IDS = frozenset({
     "bare-except",
     "local-import-shadowing",
     "wall-clock-in-sim",
+    "retry-without-deadline",
     "metric-catalog",
     "failpoint-registry",
     "pragma",
@@ -334,6 +335,87 @@ def check_wall_clock_in_sim(ctx: FileContext) -> None:
             )
 
 
+# -- rule: retry-without-deadline ------------------------------------------
+
+# Client-side RPC method names (origin BlobClient / ClusterClient,
+# tracker clients, httputil) -- an await of one of these inside a loop
+# is a retry/walk sweep. The heuristic is name-based (no type
+# inference): a false positive on a same-named local helper takes a
+# reasoned pragma, same as every other rule here.
+_RPC_METHODS = frozenset({
+    "stat", "download", "download_to_file",
+    "upload", "upload_from_file", "upload_from_store",
+    "get_metainfo", "get_recipe", "get_to_file",
+    "request", "request_full", "announce", "adopt",
+})
+
+
+def _is_test_file(path: str) -> bool:
+    parts = path.split("/")
+    base = parts[-1]
+    return (
+        "tests" in parts[:-1]
+        or base.startswith("test_")
+        or base == "conftest.py"
+    )
+
+
+def _mentions_deadline(fn: ast.AST) -> bool:
+    """Does ANY name/arg/attribute/keyword in the function smell like a
+    deadline budget? Deliberately generous: the rule exists to catch
+    loops with NO budget in sight, not to audit how the budget is
+    threaded."""
+    for node in ast.walk(fn):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.arg):
+            ident = node.arg
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        elif isinstance(node, ast.keyword):
+            ident = node.arg
+        if ident is not None and "deadline" in ident.lower():
+            return True
+    return False
+
+
+def check_retry_without_deadline(ctx: FileContext) -> None:
+    """A retry/walk loop issuing RPCs without a ``Deadline`` budget in
+    scope retries forever at the caller's expense: N replicas x a full
+    client timeout each, with the caller's own budget nowhere in the
+    frame. The fix is one ``Deadline(...)`` created before the loop and
+    threaded into every attempt (utils/deadline.py); loops that are
+    LEGITIMATELY unbounded (a supervisor's forever-poll) take a
+    reasoned pragma."""
+    if _is_test_file(ctx.path):
+        return  # tests drive retries deliberately; production only
+    for fn in _async_functions(ctx.tree):
+        if _mentions_deadline(fn):
+            continue
+        for loop in _walk_frame(fn.body):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for inner in _walk_frame(loop.body):
+                if not (
+                    isinstance(inner, ast.Await)
+                    and isinstance(inner.value, ast.Call)
+                    and isinstance(inner.value.func, ast.Attribute)
+                    and inner.value.func.attr in _RPC_METHODS
+                ):
+                    continue
+                ctx.add(
+                    "retry-without-deadline", loop,
+                    f"loop in `async def {fn.name}` awaits"
+                    f" `.{inner.value.func.attr}(...)` with no Deadline"
+                    " budget anywhere in the function: the sweep costs N"
+                    " replicas x a full client timeout each -- create a"
+                    " Deadline before the loop and pass it to every"
+                    " attempt (utils/deadline.py)",
+                )
+                break  # one finding per loop, not per call site
+
+
 FILE_RULES = (
     check_blocking_io_in_async,
     check_fire_and_forget_task,
@@ -341,4 +423,5 @@ FILE_RULES = (
     check_bare_except,
     check_local_import_shadowing,
     check_wall_clock_in_sim,
+    check_retry_without_deadline,
 )

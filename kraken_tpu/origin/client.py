@@ -132,7 +132,8 @@ class BlobClient:
         )
         return json.loads(body)["similar"]
 
-    async def adopt(self, namespace: str, d: Digest, source: str) -> None:
+    async def adopt(self, namespace: str, d: Digest, source: str,
+                    deadline: Deadline | None = None) -> None:
         """Cross-repo mount support: associate an existing blob with
         ``namespace`` (reads through from ``source`` if evicted)."""
         await self._http.post(
@@ -142,6 +143,7 @@ class BlobClient:
             ),
             ok_statuses=(201,),
             retry_5xx=False,
+            deadline=deadline,
         )
 
     async def upload(self, namespace: str, d: Digest, data: bytes,
@@ -201,6 +203,22 @@ class BlobClient:
                 raise
             return f
 
+        await self._upload_resumable(
+            namespace, d, open_at, chunk_size, deadline
+        )
+
+    async def upload_from_opener(
+        self, namespace: str, d: Digest, open_at,
+        chunk_size: int = 16 * 1024 * 1024,
+        deadline: Deadline | None = None,
+    ) -> None:
+        """Chunked upload from a caller-supplied ``open_at(offset) ->
+        reader`` -- the source must be re-readable at any offset (resume
+        rounds reopen). This is the primitive under upload/from_file/
+        from_store; callers with source files that MOVE mid-stream (the
+        origin's quorum push streams a blob whose spool file the
+        concurrent local commit renames into the cache) supply an opener
+        that falls back across both locations."""
         await self._upload_resumable(
             namespace, d, open_at, chunk_size, deadline
         )
@@ -483,9 +501,14 @@ class ClusterClient:
         registry then falls back to a normal upload session)."""
         clients = self.clients_for(d)
         ok = False
+        # One budget across the whole adopt sweep: a ring of hung
+        # sockets costs the caller one deadline, not N client timeouts.
+        deadline = None
+        if self.deadline_seconds:
+            deadline = Deadline(self.deadline_seconds, component=self.component)
         for c in clients:
             try:
-                await c.adopt(namespace, d, source)
+                await c.adopt(namespace, d, source, deadline=deadline)
                 self._report(c, True)
                 ok = True
             except HTTPError as e:

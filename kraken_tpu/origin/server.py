@@ -29,6 +29,7 @@ The origin seeds every cached blob over the P2P plane via its scheduler.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import urllib.parse
 
@@ -43,10 +44,12 @@ from kraken_tpu.origin.metainfogen import Generator
 from kraken_tpu.origin.writeback import WritebackExecutor
 from kraken_tpu.persistedretry import Manager as RetryManager, Task
 from kraken_tpu.placement.hashring import Ring
+from kraken_tpu.placement.replicawalk import fan_out_quorum
 from kraken_tpu.store import CAStore, FileExistsInCacheError
 from kraken_tpu.store.castore import DigestMismatchError, UploadNotFoundError
 from kraken_tpu.store.metadata import NamespaceMetadata, pin, unpin
 from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.deadline import Deadline
 from kraken_tpu.utils.lameduck import LameduckMixin
 from kraken_tpu.utils.metrics import REGISTRY, FailureMeter
 
@@ -363,6 +366,48 @@ class _UploadDigest:
 
 REPLICATE_KIND = "replicate"
 HEAL_KIND = "heal"
+HINT_KIND = "hint"
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumConfig:
+    """The YAML ``quorum:`` section (origin only; SIGHUP live-reloads
+    via assembly.OriginNode.reload). Knob table in docs/OPERATIONS.md
+    "Write durability".
+
+    ``write_quorum`` is the number of ring replicas -- the committing
+    origin counts as one -- that must durably hold a blob before the
+    upload commit acks. 1 ships as the compatible default (ack on local
+    commit, replication stays async); 2-of-3 is the Dynamo-style sweet
+    spot: any single origin loss after the ack leaves a pullable copy.
+    This is a SLOPPY quorum: replicas the synchronous push cannot reach
+    inside ``push_timeout_seconds`` get a durable HINT (persistedretry
+    ``hint`` task) instead of blocking the ack, and the hint replays
+    when the partition heals -- or escalates to the heal plane after
+    ``hint_ttl_seconds`` away."""
+
+    write_quorum: int = 1
+    # How long a hinted handoff waits for its target to return before
+    # handing the blob to the heal plane (which re-fetches / re-places
+    # against the CURRENT ring membership).
+    hint_ttl_seconds: float = 6 * 3600.0
+    # Total budget of the synchronous quorum push at commit time: the
+    # worst case a partition can add to one upload ack.
+    push_timeout_seconds: float = 30.0
+
+    @classmethod
+    def from_dict(cls, doc: dict | None) -> "QuorumConfig":
+        doc = dict(doc or {})
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"unknown quorum config keys: {sorted(unknown)}")
+        cfg = cls(**doc)
+        if cfg.write_quorum < 1:
+            raise ValueError("quorum.write_quorum must be >= 1")
+        if cfg.hint_ttl_seconds <= 0 or cfg.push_timeout_seconds <= 0:
+            raise ValueError("quorum TTL/timeout knobs must be > 0")
+        return cfg
 
 
 def _replication_task(addr: str, ns: str, d: Digest) -> Task:
@@ -374,6 +419,21 @@ def _replication_task(addr: str, ns: str, d: Digest) -> Task:
         kind=REPLICATE_KIND,
         key=f"{d.hex}:{ns}:{addr}",
         payload={"addr": addr, "namespace": ns, "digest": d.hex},
+    )
+
+
+def _hint_task(addr: str, ns: str, d: Digest, expires_at: float) -> Task:
+    """Hinted handoff journal entry: (replica, ns, digest, expiry). Same
+    digest-first key shape as replication so the unpin logic's prefix
+    scan covers hints too; dedups against a pending hint for the same
+    (blob, target) from an earlier commit."""
+    return Task(
+        kind=HINT_KIND,
+        key=f"{d.hex}:{ns}:{addr}",
+        payload={
+            "addr": addr, "namespace": ns, "digest": d.hex,
+            "expires_at": expires_at,
+        },
     )
 
 
@@ -413,6 +473,7 @@ class OriginServer(LameduckMixin):
         ingest_pipeline=None,  # core.ingest.IngestPipeline (optional)
         ingest_resume: bool = True,  # journal + re-adopt upload sessions
         serve_while_ingest: bool = False,  # seed from the spool pre-commit
+        quorum: QuorumConfig | None = None,  # write-durability contract
     ):
         self.store = store
         self.generator = generator
@@ -427,6 +488,12 @@ class OriginServer(LameduckMixin):
         # rpc: utils.deadline.RPCConfig (hedge/deadline knobs for the
         # heal-plane cluster client; None = defaults).
         self.rpc = rpc
+        # quorum: QuorumConfig (write-durability contract -- sync quorum
+        # push at commit, hinted handoff, read-repair). write_quorum=1
+        # (the default) keeps the legacy ack-on-local-commit behavior.
+        # SIGHUP live-swaps (assembly.OriginNode.reload replaces this
+        # object; the next commit reads the new knobs).
+        self.quorum = quorum if quorum is not None else QuorumConfig()
         # Delta-transfer plane (p2p/delta.py DeltaConfig): when enabled,
         # GET .../recipe serves the blob's ordered CDC chunk table so
         # agents can plan delta pulls. Shipped OFF; SIGHUP live-swaps
@@ -443,6 +510,12 @@ class OriginServer(LameduckMixin):
         self._inflight_writes = 0
         self._dedup_tasks: set[asyncio.Task] = set()
         self._heal_cluster = None  # lazy ClusterClient (heal plane)
+        # Pooled replica clients for the quorum push: one warm BlobClient
+        # (keep-alive aiohttp session) per replica addr, reused across
+        # commits. Dialing fresh per commit costs TCP setup + teardown on
+        # EVERY quorum-gated ack -- the healthy-path overhead band
+        # (test_data_plane_band) is measured against this pool.
+        self._push_clients: dict[str, BlobClient] = {}
         self._upload_digests: dict[str, _UploadDigest] = {}
         # Resumable sessions (ingest.resume) + spool seeding
         # (ingest.serve_while_ingest) -- YAML knobs, SIGHUP live-reloaded
@@ -490,6 +563,12 @@ class OriginServer(LameduckMixin):
             )
             retry.register(
                 HEAL_KIND, self._with_slo("heal", self._execute_heal)
+            )
+            # Hint replays are replication by another trigger: same SLI
+            # (durability lag burning while reads still work).
+            retry.register(
+                HINT_KIND,
+                self._with_slo("replication", self._execute_hint),
             )
             # Earlier builds keyed tasks '{addr}:{ns}:{hex}'; rewrite any
             # such persisted rows so the digest-first prefix scan in
@@ -1038,18 +1117,25 @@ class OriginServer(LameduckMixin):
             hit = failpoints.fire("origin.commit.slow")
             if hit is not None and hit.delay_s:
                 await asyncio.sleep(hit.delay_s)
+            # Quorum write plane: launch the replica pushes NOW, against
+            # the spool bytes, so they overlap the verify+rename below.
+            # No-op (None) at the shipped write_quorum: 1.
+            quorum_push = self._begin_quorum_push(ns, d, uid)
             t_commit = time.perf_counter()
             try:
                 await asyncio.to_thread(
                     self.store.commit_upload, uid, d, precomputed=precomputed
                 )
             except UploadNotFoundError:
+                await self._abort_quorum_push(quorum_push)
                 await self._retract_early_publish(d, early_metainfo)
                 raise web.HTTPNotFound(text="unknown upload")
             except DigestMismatchError as e:
+                await self._abort_quorum_push(quorum_push)
                 await self._retract_early_publish(d, early_metainfo)
                 raise web.HTTPBadRequest(text=str(e))
             except FileExistsInCacheError:
+                await self._abort_quorum_push(quorum_push)
                 if early_metainfo is not None and self.scheduler is not None:
                     # The bytes ARE committed (by a racing uploader): the
                     # early torrent stays valid at the cache path.
@@ -1090,6 +1176,12 @@ class OriginServer(LameduckMixin):
                         piece_hashes,
                     )
             await self._post_commit(ns, d, metainfo=metainfo)
+            if quorum_push is not None:
+                # With write_quorum > 1 the 201 below is a DURABILITY
+                # ack, not a local-commit ack -- it waits until enough
+                # ring replicas hold the bytes (or their hints are
+                # journaled).
+                await quorum_push
         return web.Response(status=201)
 
     async def _retract_early_publish(self, d: Digest, early_metainfo) -> None:
@@ -1222,6 +1314,231 @@ class OriginServer(LameduckMixin):
                    "dup_bytes": res["dup_bytes"]},
         )
 
+    # -- quorum write plane (sync push + hinted handoff) ---------------------
+
+    def _begin_quorum_push(self, ns: str, d: Digest, uid: str):
+        """Launch the quorum push CONCURRENT with the local commit (or
+        return None when the plane is off). The pushes stream from the
+        upload SPOOL file while commit_upload verifies + renames it in
+        a thread, so replica transfer and hashing overlap the local
+        work instead of serializing after it -- the healthy-path commit
+        overhead band (test_data_plane_band) depends on this. The
+        opener falls back to the cache path: a resume round reopening
+        after the rename finds the same inode's bytes there."""
+        q = self.quorum
+        if (
+            q.write_quorum <= 1 or self.ring is None or self.retry is None
+            or not self.self_addr
+        ):
+            return None
+        # Canary probes are ephemeral by contract (see _post_commit):
+        # quorum-pushing them would spray TTL-reaped probe blobs across
+        # the ring.
+        from kraken_tpu.utils.slo import CANARY_NAMESPACE
+
+        if ns == CANARY_NAMESPACE:
+            return None
+        spool = self.store.upload_path(uid)
+
+        def open_at(offset: int):
+            try:
+                f = open(spool, "rb")
+            except FileNotFoundError:
+                f = self.store.open_cache_file(d)
+            try:
+                f.seek(offset)
+            except OSError:
+                f.close()
+                raise
+            return f
+
+        return asyncio.create_task(self._quorum_push(ns, d, open_at))
+
+    async def _abort_quorum_push(self, push) -> None:
+        """Commit failed (unknown upload, digest mismatch, lost race):
+        the in-flight pushes are streaming bytes that will never be
+        THIS commit's durability promise -- cut them. Replicas verify
+        digests independently, so a partial push can never corrupt."""
+        if push is None:
+            return
+        push.cancel()
+        try:
+            await push
+        except asyncio.CancelledError:
+            return
+
+    async def _quorum_push(self, ns: str, d: Digest, opener) -> None:
+        """Synchronous replica push at commit time (sloppy quorum).
+
+        Fans out to every OTHER ring owner at once under one budget
+        (placement/replicawalk.fan_out_quorum) and returns once
+        ``write_quorum - 1`` of them confirmed -- the local commit is
+        copy #1. Replicas that errored get a durable hint; when the
+        quorum itself went unmet (partition wider than the budget), the
+        still-in-flight stragglers do too -- THEY are the partitioned
+        set the hint plane exists for. Either way the commit acks: a
+        partition must degrade durability to hinted, never block
+        writes (the Dynamo sloppy-quorum contract)."""
+        q = self.quorum
+        try:
+            replicas = [
+                a for a in self.ring.locations(d) if a != self.self_addr
+            ]
+        except RuntimeError:
+            return  # empty ring
+        if not replicas:
+            return
+        need = min(q.write_quorum - 1, len(replicas))
+        deadline = Deadline(
+            q.push_timeout_seconds, component="origin-quorum"
+        )
+        clients = [self._push_client(a) for a in replicas]
+        ok, failed, abandoned = await fan_out_quorum(
+            clients, self._push_replica_op(ns, d, opener),
+            need=need, deadline=deadline, op_name="quorum_push",
+            # Healthy path: exactly `need` pushes move bytes; the spare
+            # replicas join only on a failed primary or after the hedge
+            # tick (a browned-out primary must not eat the whole budget
+            # before the spares get their shot).
+            hedge_delay=min(2.0, q.push_timeout_seconds / 4.0),
+        )
+        met = len(ok) >= need
+        # Failed replicas get a durable hint. Abandoned (still in
+        # flight at quorum) replicas are only hinted when the quorum
+        # went UNMET -- under a met quorum the async replication task
+        # enqueued by _post_commit already owns their convergence.
+        for addr in list(failed) + (abandoned if not met else []):
+            self._journal_hint(addr, ns, d)
+        REGISTRY.counter(
+            "origin_quorum_writes_total",
+            "Upload commits through the quorum write plane, by outcome"
+            " (quorum = enough replicas confirmed before the ack;"
+            " hinted = quorum unmet, unreachable replicas journaled as"
+            " hints and the ack proceeded)",
+        ).inc(outcome="quorum" if met else "hinted")
+        if not met:
+            _log.warning(
+                "quorum unmet at commit: acked via hinted handoff",
+                extra={
+                    "digest": d.hex, "namespace": ns,
+                    "confirmed": len(ok), "needed": need,
+                    "hinted": sorted(set(list(failed) + abandoned)),
+                },
+            )
+
+    def _push_replica_op(self, ns: str, d: Digest, opener):
+        """One replica's push: a resumable streaming upload straight
+        from the opener (spool-or-cache). No stat probe first -- the
+        blob was committed microseconds ago, so the replica all but
+        never holds it, and a replica that DOES answers the commit with
+        409 = success without a wasted round trip. The partition
+        failpoint injects an unreachable replica (globally, or per
+        target via the @addr variant)."""
+
+        async def push(c, deadline) -> None:
+            hit = failpoints.fire("origin.quorum.replica.partition")
+            if hit is None:
+                hit = failpoints.fire(
+                    f"origin.quorum.replica.partition@{c.addr}"
+                )
+            if hit:
+                if hit.delay_s:
+                    await asyncio.sleep(hit.delay_s)
+                raise failpoints.FailpointError(
+                    f"origin.quorum.replica.partition: {c.addr}"
+                )
+            await c.upload_from_opener(ns, d, opener, deadline=deadline)
+
+        return push
+
+    def _journal_hint(self, addr: str, ns: str, d: Digest) -> None:
+        """Durably journal a hinted handoff for an unreachable replica.
+        Rides the persistedretry plane, so the hint survives origin
+        restart and replays with backoff until the target returns (or
+        the TTL hands it to heal)."""
+        assert self.retry is not None
+        import time
+
+        added = self.retry.add(
+            _hint_task(addr, ns, d, time.time() + self.quorum.hint_ttl_seconds)
+        )
+        if added:
+            self._count_hint("journaled")
+            # Pin against eviction until the hint lands -- same same-
+            # loop-iteration rule as _add_replication_task (no awaits
+            # between enqueue and pin, or a fast unpin races it).
+            pin(self.store, d, HINT_KIND)
+
+    async def _execute_hint(self, task: Task) -> None:
+        """Replay one hinted handoff.
+
+        Effectively-once: the push is stat-first, so a crash between
+        the push landing and the task retiring (the
+        ``origin.hint.replay.crash`` window) re-runs as a cheap stat
+        hit, never a second byte stream. An expired hint hands the blob
+        to the heal plane instead -- the target stayed away so long the
+        CURRENT ring owners (which may no longer include it) should be
+        made whole rather than one stale address chased forever."""
+        import time
+
+        d = Digest.from_hex(task.payload["digest"])
+        ns = task.payload["namespace"]
+        addr = task.payload["addr"]
+        if time.time() >= float(task.payload.get("expires_at", 0.0)):
+            self._count_hint("expired")
+            self.enqueue_heal(ns, d)
+            self._unpin_if_last_hint(d)
+            return
+        if not self.store.in_cache(d):
+            # Local copy gone (explicit DELETE, eviction despite the
+            # pin): nothing to push -- the replication plane's
+            # without-local handling owns this blob's convergence.
+            self._count_hint("lost")
+            self._unpin_if_last_hint(d)
+            return
+        deadline = Deadline(
+            self.rpc.request_deadline_seconds if self.rpc else 60.0,
+            component="origin-hint",
+        )
+        peer = BlobClient(addr)
+        try:
+            if await peer.stat(ns, d, local_only=True, deadline=deadline) is None:
+                await peer.upload_from_store(
+                    ns, d, self.store, deadline=deadline
+                )
+        finally:
+            await peer.close()
+        hit = failpoints.fire("origin.hint.replay.crash")
+        if hit:
+            # Injected crash AFTER the push, BEFORE the task retires:
+            # the replay above must be idempotent across this window.
+            raise failpoints.FailpointError("origin.hint.replay.crash")
+        self._count_hint("replayed")
+        _log.info(
+            "hint replayed: replica made whole",
+            extra={"digest": d.hex, "namespace": ns, "target": addr},
+        )
+        self._unpin_if_last_hint(d)
+
+    def _count_hint(self, state: str) -> None:
+        REGISTRY.counter(
+            "origin_hints_total",
+            "Hinted handoffs by state (journaled = partition observed at"
+            " commit; replayed = target made whole after recovery;"
+            " expired = TTL hit, escalated to heal; lost = local copy"
+            " gone before replay)",
+        ).inc(state=state)
+
+    def _unpin_if_last_hint(self, d: Digest) -> None:
+        """Drop the hint pin once no OTHER pending hint references this
+        blob (the current task counts until the manager marks it done)."""
+        if self.retry is None:
+            return
+        if self.retry.store.count_pending(
+            HINT_KIND, f"{d.hex}:"
+        ) <= 1 and self.store.in_cache(d):
+            unpin(self.store, d, HINT_KIND)
+
     # -- replication to ring peers -----------------------------------------
 
     def _enqueue_replication(self, ns: str, d: Digest) -> None:
@@ -1338,13 +1655,22 @@ class OriginServer(LameduckMixin):
         owners = [a for a in ([] if self.ring is None else self.ring.locations(d))
                   if a != self.self_addr]
         unreachable: Exception | None = None
+        # One budget across the whole owner probe sweep: a ring of hung
+        # sockets must cost one bounded task attempt, not len(owners)
+        # full client timeouts.
+        deadline = Deadline(
+            self.rpc.request_deadline_seconds if self.rpc else 60.0,
+            component="origin-replication",
+        )
         for owner in dict.fromkeys([addr, *owners]):
             peer = BlobClient(owner)
             try:
                 # local_only: "owner HOLDS the bytes and can replicate
                 # onward" -- a durable-backend answer would retire the
                 # repair while zero cached copies exist on the ring.
-                if await peer.stat(ns, d, local_only=True) is not None:
+                if await peer.stat(
+                    ns, d, local_only=True, deadline=deadline
+                ) is not None:
                     self._unpin_if_last_replication(d)
                     return
             except Exception as e:
@@ -1496,15 +1822,34 @@ class OriginServer(LameduckMixin):
         self._heal_cluster = c
         return c
 
+    def _push_client(self, addr: str) -> BlobClient:
+        """The pooled, keep-alive replica client for ``addr`` (see
+        ``_push_clients`` in __init__). Stale addrs from ring churn just
+        idle in the pool -- same lifecycle as the heal cluster's."""
+        c = self._push_clients.get(addr)
+        if c is None:
+            c = self._push_clients[addr] = BlobClient(addr)
+        return c
+
     async def close_heal_cluster(self) -> None:
         if self._heal_cluster is not None:
             await self._heal_cluster.close()
             self._heal_cluster = None
+        for c in self._push_clients.values():
+            await c.close()
+        self._push_clients.clear()
 
     # -- reads -------------------------------------------------------------
 
     async def _ensure_local(self, ns: str, d: Digest) -> None:
         if self.store.in_cache(d):
+            return
+        # Read-repair FIRST: a miss on a ring owner is a durability hole
+        # (a partition ate the replication push), and a sibling replica
+        # is both the cheapest source and the one whose bytes keep the
+        # ring converged without a backend round-trip -- pure-p2p
+        # deployments have no backend to fall through to at all.
+        if await self._read_repair(ns, d):
             return
         if self.refresher is None:
             raise web.HTTPNotFound(text="blob not found")
@@ -1513,6 +1858,82 @@ class OriginServer(LameduckMixin):
         except BlobNotFoundError:
             raise web.HTTPNotFound(text="blob not found (backend miss)")
         self._schedule_dedup(d)
+
+    async def _read_repair(self, ns: str, d: Digest) -> bool:
+        """GET-side miss on a ring owner: restore from a sibling replica,
+        then re-enqueue replication so the ring reconverges -- the read
+        path heals the write path's holes (Dynamo read-repair).
+
+        Siblings are probed with LOCAL-ONLY stats first: a plain GET
+        against a sibling that also misses would recurse the repair
+        around the ring (its miss handler read-repairs from us, whose
+        handler...). Only a sibling that positively holds the bytes is
+        streamed from; arrival commits through the verifying
+        ``commit_upload``, so a sibling serving rot can never be
+        adopted. False = no sibling holds the bytes (the caller falls
+        through to backend read-through / 404)."""
+        if self.ring is None or not self.self_addr:
+            return False
+        try:
+            if self.self_addr not in self.ring.locations(d):
+                return False  # not an owner: plain read-through semantics
+        except RuntimeError:
+            return False  # empty ring
+        cluster = await self._get_heal_cluster()
+        deadline = Deadline(
+            self.rpc.request_deadline_seconds if self.rpc else 60.0,
+            component="origin-read-repair",
+        )
+        source = None
+        for c in cluster.clients_for(d):
+            try:
+                if await c.stat(
+                    ns, d, local_only=True, deadline=deadline
+                ) is not None:
+                    source = c
+                    break
+            except Exception:
+                # Unreachable sibling: keep walking (the loop IS the
+                # failover; a dead replica must not veto the repair).
+                _log.debug(
+                    "read-repair stat probe failed",
+                    extra={"digest": d.hex, "peer": c.addr}, exc_info=True,
+                )
+                continue
+        if source is None:
+            return False
+        uid = self.store.create_upload()
+        try:
+            await source.download_to_file(
+                ns, d, self.store.upload_path(uid), deadline=deadline
+            )
+            await asyncio.to_thread(self.store.commit_upload, uid, d)
+        except FileExistsInCacheError:
+            pass  # a racing restore path won: the bytes are local now
+        except Exception:
+            _log.warning(
+                "read-repair fetch failed; falling through",
+                extra={"digest": d.hex, "namespace": ns,
+                       "source": source.addr},
+                exc_info=True,
+            )
+            return False
+        finally:
+            self.store.abort_upload(uid)  # no-op once committed
+        REGISTRY.counter(
+            "origin_read_repairs_total",
+            "Owner GET misses restored from a sibling replica (the ring"
+            " then reconverges via re-enqueued replication)",
+        ).inc()
+        _log.info(
+            "read-repair: blob restored from sibling",
+            extra={"digest": d.hex, "namespace": ns, "source": source.addr},
+        )
+        # Full commit pipeline, like heal: namespace sidecar, metainfo +
+        # seed, writeback, replication re-enqueue, dedup -- the repaired
+        # copy must be as durable (and as advertised) as an uploaded one.
+        await self._post_commit(ns, d)
+        return True
 
     async def _stat(self, req: web.Request) -> web.Response:
         await self._brownout_gate()

@@ -388,7 +388,7 @@ class DeltaPlanner:
             )
             return []
         candidates: list[tuple[Digest, ChunkRecipe]] = []
-        for s in sims:
+        for s in sims:  # kt-lint: disable=retry-without-deadline  # bounded to 2*max_bases local candidates; each recipe fetch is ONE budgeted HTTPClient request and a failure drops the candidate, never retries
             try:
                 score = float(s.get("score", 0.0))
                 base_d = Digest.from_hex(s["digest"])

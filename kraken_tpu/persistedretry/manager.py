@@ -116,6 +116,15 @@ class TaskStore:
         ).fetchone()
         return int(row[0])
 
+    def count_by_kind(self) -> dict[str, int]:
+        """Pending tasks per kind, one aggregate scan -- the sentinel's
+        queue-depth sample (a wedged executor shows up here as one kind
+        growing without bound while the others drain)."""
+        rows = self._db.execute(
+            "SELECT kind, COUNT(*) FROM tasks GROUP BY kind"
+        ).fetchall()
+        return {kind: int(n) for kind, n in rows}
+
     def canonicalize_keys(self, kind: str, canonical: Callable[[dict], str]) -> int:
         """Rewrite pending keys of ``kind`` to ``canonical(payload)``.
 
@@ -201,6 +210,14 @@ class Manager:
 
     def add_many(self, tasks: list[Task]) -> int:
         return self.store.add_many(tasks)
+
+    def queue_depths(self) -> dict[str, int]:
+        """Pending depth per kind, REGISTERED kinds always present (a
+        healthy empty queue reports 0, not absence -- the sentinel's
+        gauge must not drop a label the moment a queue drains)."""
+        depths = {kind: 0 for kind in self._executors}
+        depths.update(self.store.count_by_kind())
+        return depths
 
     async def run_once(self, now: float | None = None) -> int:
         """One poll cycle; returns number of tasks that succeeded."""

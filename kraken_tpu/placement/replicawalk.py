@@ -154,6 +154,102 @@ async def _serial(clients, op, key, missing_key, health, deadline,
     raise KeyError(missing_key if missing_key is not None else key)
 
 
+async def fan_out_quorum(
+    clients, op, *, need: int, deadline: Deadline | None = None,
+    health=None, op_name: str = "rpc", hedge_delay: float | None = None,
+):
+    """Counting write fan-out (the quorum push's shape, distinct from
+    :func:`walk_replicas`' first-success-wins): launch ``op`` and
+    resolve as soon as ``need`` successes have landed, every attempt
+    has finished, or the budget ran out -- whichever comes first. No
+    breaker admission gate: a write must try every replica regardless
+    (outcomes still feed the breaker via ``_observe``).
+
+    With ``hedge_delay`` unset, every client launches at once. With it
+    set, only the first ``need`` clients launch immediately; the rest
+    are RESERVES that join when a primary fails (in-flight attempts can
+    no longer cover ``need``) or the delay elapses with the quorum
+    still open. On the healthy path that means exactly ``need`` ops run
+    -- for a byte-moving op like the quorum push, half the work of a
+    full fan-out -- while a failed or browned-out primary still gets
+    covered well inside the budget.
+
+    Returns ``(ok_addrs, failed, abandoned)``: addrs that confirmed,
+    addr -> exception for attempts that errored (a spent per-attempt
+    budget lands here as ``DeadlineExceeded``), and addrs whose attempt
+    was still in flight when the fan-out resolved (cancelled AND reaped
+    -- the caller decides whether a slow replica needs a hint or the
+    async replication plane covers it). Reserves never launched because
+    the quorum resolved first count as abandoned only on an UNMET
+    quorum (they were never reached, the hint plane owns them); on a
+    met quorum they are simply not reported."""
+    ok: list[str] = []
+    failed: dict[str, Exception] = {}
+    if need <= 0 or not clients:
+        return ok, failed, []
+    primaries = list(clients)
+    reserves: list = []
+    if hedge_delay is not None and len(primaries) > need:
+        primaries, reserves = primaries[:need], primaries[need:]
+    tasks: dict[asyncio.Task, object] = {}
+
+    def _launch(c) -> None:
+        t = asyncio.create_task(
+            _attempt(health, c, op, deadline, as_hedge=False,
+                     op_name=op_name)
+        )
+        tasks[t] = c
+
+    for c in primaries:
+        _launch(c)
+    loop = asyncio.get_running_loop()
+    hedge_at = loop.time() + hedge_delay if reserves else None
+    try:
+        while len(ok) < need and (tasks or reserves):
+            if reserves and (
+                loop.time() >= hedge_at or len(ok) + len(tasks) < need
+            ):
+                for c in reserves:
+                    _launch(c)
+                reserves = []
+                hedge_at = None
+            timeout = None
+            if deadline is not None:
+                timeout = deadline.remaining()
+                if timeout <= 0:
+                    break  # budget spent with pushes still in flight
+            if hedge_at is not None:
+                tick = max(hedge_at - loop.time(), 0.0)
+                timeout = tick if timeout is None else min(timeout, tick)
+            done, _pending = await asyncio.wait(
+                tasks, timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if not done:
+                if deadline is not None and deadline.remaining() <= 0:
+                    break  # deadline tick with nothing finished
+                continue  # hedge tick: launch the reserves above
+            for t in done:
+                c = tasks.pop(t)
+                err = t.exception()
+                if err is None:
+                    ok.append(c.addr)
+                else:
+                    failed[c.addr] = err
+    finally:
+        # Quorum met (or budget gone): stragglers are cancelled AND
+        # reaped -- a leaked push task would keep streaming bytes for
+        # an ack already returned.
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+    abandoned = [c.addr for c in tasks.values()]
+    if len(ok) < need:
+        abandoned.extend(c.addr for c in reserves)
+    return ok, failed, abandoned
+
+
 async def _hedged(clients, op, key, missing_key, health, hedge_delay,
                   deadline, op_name, default):
     """Staggered race: the primary attempt starts now; every

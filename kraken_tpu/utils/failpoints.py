@@ -76,9 +76,11 @@ KNOWN_FAILPOINTS = frozenset({
     "ingest.window.read",
     "ingest.window.transfer",
     "origin.commit.slow",
+    "origin.hint.replay.crash",
     "origin.ingest.device_fail",
     "origin.patch.close",
     "origin.patch.write",
+    "origin.quorum.replica.partition",
     "origin.recipe.miss",
     "origin.upload.resume",
     "p2p.conn.disconnect",
@@ -92,6 +94,8 @@ KNOWN_FAILPOINTS = frozenset({
     "p2p.shard.serve.disconnect",
     "rpc.brownout.slow",
     "rpc.hedge.lose",
+    "rpc.link.delay",
+    "rpc.link.drop",
     "store.fsck.orphan",
     "store.scrub.bitflip",
     "tracker.announce.empty",
@@ -332,6 +336,14 @@ FAILPOINTS = FailpointRegistry()
 def fire(name: str) -> Optional[Hit]:
     """Module-level evaluation shorthand for injection sites."""
     return FAILPOINTS.fire(name)
+
+
+def any_armed() -> bool:
+    """Is ANYTHING armed? One lock-free bool read -- hot-path sites with
+    per-evaluation setup cost (e.g. httputil's link-fault matrix parsing
+    the destination host out of the URL) gate the setup on this before
+    paying for per-variant ``fire()`` lookups."""
+    return FAILPOINTS._any
 
 
 def allow(flag: bool = True) -> None:

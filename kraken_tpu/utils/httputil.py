@@ -16,6 +16,8 @@ from typing import Any
 
 import aiohttp
 
+from urllib.parse import urlsplit
+
 # get_to_file temp-name disambiguator (hedged reads: two concurrent
 # transfers of one dest path in one process must not share a tmp file).
 _tmp_seq = itertools.count()
@@ -71,6 +73,29 @@ async def _failpoint_gate(method: str, url: str) -> "HTTPError | None":
         )
     if failpoints.fire("httputil.request.error"):
         return HTTPError(method, url, 503, b"failpoint httputil.request.error")
+    # Link-fault matrix (the partition chaos tier): per-DESTINATION drop
+    # and delay. ``rpc.link.drop`` kills every link; the per-host variant
+    # ``rpc.link.drop@host:port`` kills only the links INTO that host --
+    # in a single-process herd each node is a distinct destination, so
+    # arming some directions and not others builds asymmetric / one-way
+    # partitions out of destination-keyed variants alone. The urlsplit
+    # is gated on any_armed(): zero parsing on the disarmed hot path.
+    if failpoints.any_armed():
+        dst = urlsplit(url).netloc
+        hit = failpoints.fire("rpc.link.drop") or failpoints.fire(
+            f"rpc.link.drop@{dst}"
+        )
+        if hit:
+            if hit.delay_s:
+                await asyncio.sleep(hit.delay_s)  # black-hole, then RST
+            raise aiohttp.ClientConnectionError(
+                f"failpoint rpc.link.drop: {method} {url}"
+            )
+        hit = failpoints.fire("rpc.link.delay") or failpoints.fire(
+            f"rpc.link.delay@{dst}"
+        )
+        if hit:
+            await asyncio.sleep(hit.delay_s)
     return None
 
 

@@ -249,6 +249,13 @@ class ResourcesConfig:
     # serve and announce rots in the queue; with ``drain_on_breach``
     # the node sheds itself before the swarm blacklists it.
     loop_lag_p99_seconds: float = 0.0
+    # Persistedretry backlog budget: breach when the node's durable task
+    # queue (replication + writeback + heal + hint, summed across kinds)
+    # exceeds this many pending rows. A wedged executor -- replication
+    # pushing into a dead ring, hints piling up behind a partition --
+    # grows this without bound while the node otherwise looks healthy;
+    # the per-kind ``retry_queue_depth`` gauge names the culprit.
+    max_retry_queue: int = 0
     breach_streak: int = 3
     drain_on_breach: bool = False
     top_tasks: int = 8
@@ -278,6 +285,7 @@ _BUDGETS = (
     ("conns", "max_conns", "conns"),
     ("orphans", "max_orphans", "orphans_total"),
     ("loop_lag", "loop_lag_p99_seconds", "loop_lag_p99"),
+    ("retry_queue", "max_retry_queue", "retry_queue_total"),
 )
 
 
@@ -297,6 +305,7 @@ class ResourceSentinel:
         upload_ttl_seconds: float = 6 * 3600,
         on_sustained_breach=None,
         loop_lag_probe=None,
+        retry_probe=None,
     ):
         self.component = component
         self.config = (
@@ -310,6 +319,10 @@ class ResourceSentinel:
         # () -> recent loop-lag p99 seconds or None (assembly wires the
         # node's LoopLagMonitor.p99 in); gates the "loop_lag" budget.
         self.loop_lag_probe = loop_lag_probe
+        # () -> {kind: pending count} from the node's persistedretry
+        # Manager (assembly wires Manager.queue_depths); gates the
+        # "retry_queue" budget and feeds the per-kind depth gauge.
+        self.retry_probe = retry_probe
         self.last_sample: dict | None = None
         # (monotonic_ts, open_fds, rss_bytes) history -- the soak
         # harness's least-squares input. Bounded: a week at 30 s/sample.
@@ -338,6 +351,10 @@ class ResourceSentinel:
         self._g_orphans = REGISTRY.gauge(
             "resource_orphan_files",
             "Store debris counted by the sentinel, per component and kind",
+        )
+        self._g_retry = REGISTRY.gauge(
+            "retry_queue_depth",
+            "Pending persistedretry tasks, per component and task kind",
         )
         with _instances_lock:
             _instances.add(self)
@@ -446,6 +463,14 @@ class ResourceSentinel:
                 loop_lag_p99 = self.loop_lag_probe()
             except Exception:  # the probe must never fail the sample
                 loop_lag_p99 = None
+        retry_depths: dict[str, int] = {}
+        retry_total = None
+        if self.retry_probe is not None:
+            try:
+                retry_depths = dict(self.retry_probe())
+                retry_total = sum(retry_depths.values())
+            except Exception:  # the probe must never fail the sample
+                retry_depths, retry_total = {}, None
         sample = {
             "component": self.component,
             "ts": time.time(),
@@ -467,6 +492,8 @@ class ResourceSentinel:
             "conns": conns,
             "orphans": orphans,
             "orphans_total": sum(orphans.values()),
+            "retry_queue": retry_depths,
+            "retry_queue_total": retry_total,
         }
         if fds is not None:
             self._g_fds.set(fds)
@@ -476,6 +503,8 @@ class ResourceSentinel:
         self._g_conns.set(conns, component=self.component)
         for kind, n in orphans.items():
             self._g_orphans.set(n, component=self.component, kind=kind)
+        for kind, n in retry_depths.items():
+            self._g_retry.set(n, component=self.component, kind=kind)
         breached = self._check_budgets(sample)
         sample["breached"] = breached
         self.last_sample = sample

@@ -1152,3 +1152,154 @@ def test_pull_of_still_ingesting_blob_serves_before_commit(tmp_path):
             await tracker.stop()
 
     asyncio.run(main())
+
+
+# -- scenario: link-fault matrix at the HTTP transport -----------------------
+
+
+def test_link_fault_matrix_partitions_by_destination():
+    """`rpc.link.drop@{dst}` severs every HTTP request INTO one host
+    while other destinations stay reachable -- the primitive partition
+    tests are built from. Global `rpc.link.drop` kills all destinations;
+    `rpc.link.delay@{dst}` injects latency without severing."""
+
+    async def main():
+        import time
+
+        from aiohttp import web
+
+        async def ok(request):
+            return web.Response(body=b"ok")
+
+        runners, bases, dsts = [], [], []
+        for _ in range(2):
+            app = web.Application()
+            app.router.add_get("/x", ok)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = runner.addresses[0][1]
+            runners.append(runner)
+            bases.append(f"http://127.0.0.1:{port}")
+            dsts.append(f"127.0.0.1:{port}")
+
+        http = HTTPClient(retries=0)
+        try:
+            # Destination-selective: only dsts[0] is partitioned.
+            failpoints.FAILPOINTS.arm(f"rpc.link.drop@{dsts[0]}", "always")
+            import aiohttp
+
+            with pytest.raises(aiohttp.ClientConnectionError):
+                await http.get(f"{bases[0]}/x")
+            assert await http.get(f"{bases[1]}/x") == b"ok"
+            assert _fired(f"rpc.link.drop@{dsts[0]}") >= 1
+            failpoints.FAILPOINTS.disarm_all()
+
+            # Global variant: EVERY destination is dark.
+            failpoints.FAILPOINTS.arm("rpc.link.drop", "always")
+            for base in bases:
+                with pytest.raises(aiohttp.ClientConnectionError):
+                    await http.get(f"{base}/x")
+            assert _fired("rpc.link.drop") >= 2
+            failpoints.FAILPOINTS.disarm_all()
+
+            # Delay variant: slow link, not a severed one.
+            failpoints.FAILPOINTS.arm(
+                f"rpc.link.delay@{dsts[1]}", "always+delay:80"
+            )
+            t0 = time.monotonic()
+            assert await http.get(f"{bases[1]}/x") == b"ok"
+            assert time.monotonic() - t0 >= 0.08
+        finally:
+            await http.close()
+            for runner in runners:
+                await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# -- scenario: crash between hint replay and task retirement -----------------
+
+
+def test_hint_replay_crash_window_is_effectively_once(tmp_path):
+    """`origin.hint.replay.crash` fires AFTER the replay push lands but
+    BEFORE the task retires: the hint must stay journaled, and the re-run
+    must converge as a cheap stat hit (effectively-once), retiring the
+    task and counting exactly one replay."""
+
+    async def main():
+        import socket
+        import time
+
+        from kraken_tpu.origin.server import HINT_KIND, _hint_task
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        ports = [free_port() for _ in range(2)]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i in range(2):
+            node = OriginNode(
+                store_root=str(tmp_path / f"origin{i}"),
+                http_port=ports[i],
+                ring=Ring(HostList(static=addrs), max_replica=2),
+                self_addr=addrs[i],
+                dedup=False,
+            )
+            await node.start()
+            node.retry.stop()  # tests drive run_once by hand
+            nodes.append(node)
+        try:
+            blob = os.urandom(100_000)
+            d = Digest.from_bytes(blob)
+            oc = BlobClient(addrs[0])
+            await oc.upload(NS, d, blob)
+            await oc.close()
+            assert not nodes[1].store.in_cache(d)
+
+            # Journal a hint for the replica by hand (as a partition at
+            # commit would) and crash the first replay attempt.
+            nodes[0].retry.add(
+                _hint_task(addrs[1], NS, d, time.time() + 3600.0)
+            )
+            replayed0 = REGISTRY.counter("origin_hints_total").value(
+                state="replayed"
+            )
+            failpoints.FAILPOINTS.arm("origin.hint.replay.crash", "once")
+            await nodes[0].retry.run_once()
+            assert _fired("origin.hint.replay.crash") >= 1
+            # The push landed, but the crash kept the task journaled
+            # and the replay uncounted.
+            assert nodes[1].store.in_cache(d)
+            assert (
+                nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:")
+                == 1
+            )
+            assert (
+                REGISTRY.counter("origin_hints_total").value(state="replayed")
+                == replayed0
+            )
+
+            # Re-run past the failure backoff: stat-first replay retires
+            # the task; exactly ONE replay is counted for the pair.
+            await nodes[0].retry.run_once(now=time.time() + 3600.0)
+            assert (
+                nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:")
+                == 0
+            )
+            assert (
+                REGISTRY.counter("origin_hints_total").value(state="replayed")
+                == replayed0 + 1
+            )
+            c = BlobClient(addrs[1])
+            assert await c.download(NS, d) == blob
+            await c.close()
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(main())

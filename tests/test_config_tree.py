@@ -28,7 +28,7 @@ CLI_KEYS = {
     "registry_strict_accept", "failpoints", "scrub", "fsck",
     "task_timeout_seconds", "rpc", "resources", "trace", "delta",
     "profiling", "fleet", "chunkstore", "slo", "canary", "ingest",
-    "pex",
+    "pex", "quorum",
 }
 
 
@@ -423,6 +423,32 @@ def test_ingest_sections_construct_ingest_config():
         )
         seen += 1
     assert seen >= 2  # origin AND agent register the ingest knobs
+
+
+def test_quorum_sections_construct_quorum_config():
+    """Every shipped `quorum:` section must map onto QuorumConfig
+    through the same from_dict the CLI/assembly use -- a typo'd knob
+    must fail here, not at production boot. The shipped default must
+    stay `write_quorum: 1` (classic async replication): gating acks on
+    replica round-trips is a per-cluster durability/latency trade the
+    operator makes deliberately (docs/OPERATIONS.md 'Write
+    durability'), never a config-refresh surprise."""
+    from kraken_tpu.origin.server import QuorumConfig
+
+    seen = 0
+    for comp, path in _component_files():
+        qc = load_config(path).get("quorum")
+        if qc is None:
+            continue
+        cfg = QuorumConfig.from_dict(qc)  # raises on unknown keys
+        assert cfg.write_quorum == 1, (
+            f"{path}: shipped write_quorum must stay 1 (quorum acks are"
+            " an explicit operator opt-in)"
+        )
+        assert cfg.hint_ttl_seconds > 0, path
+        assert cfg.push_timeout_seconds > 0, path
+        seen += 1
+    assert seen >= 1  # the origin registers the quorum knobs
 
 
 def test_cli_keys_match_cli_source():

@@ -303,3 +303,89 @@ def test_pipelined_ingest_band():
         ratios.append(s / p)
     ratios.sort()
     assert ratios[len(ratios) // 2] >= 1.3, ratios
+
+
+def test_quorum_commit_overhead_band(tmp_path):
+    """Quorum-gated commits (write_quorum=2) on the HEALTHY path must
+    cost <= 1.5x the classic async-replication commit. The plane earns
+    that band by overlapping, not by skipping work: the replica push
+    launches against the upload spool BEFORE the local verify+rename
+    (origin/server._begin_quorum_push), streams through a pooled warm
+    client, and the hedged fan-out moves the bytes exactly once (the
+    spare replica joins only on a failed primary). Estimator: MIN OF
+    PAIRWISE off/on ratios over interleaved rounds, same as the trace
+    and profiler bands -- both legs of a round share a rig phase, so
+    shared-core drift cancels. Skipped below 2 cores, where the push's
+    replica-side hashing has no core to overlap the local commit on and
+    the wall ratio degenerates to total-CPU ratio (~2x by construction:
+    a durability ack IS a second hash+fsync of every byte)."""
+    import os
+    import socket
+
+    import pytest
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("quorum overlap band needs >= 2 cores")
+
+    from kraken_tpu.assembly import OriginNode
+    from kraken_tpu.core.digest import Digest
+    from kraken_tpu.origin.client import BlobClient
+    from kraken_tpu.origin.server import QuorumConfig
+    from kraken_tpu.placement import HostList, Ring
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    async def drive() -> list[float]:
+        import time
+
+        ports = [free_port() for _ in range(3)]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i in range(3):
+            n = OriginNode(
+                store_root=str(tmp_path / f"origin{i}"),
+                http_port=ports[i],
+                ring=Ring(HostList(static=addrs), max_replica=3),
+                self_addr=addrs[i],
+                dedup=False,
+                health_interval_seconds=30.0,
+            )
+            await n.start()
+            n.retry.stop()
+            nodes.append(n)
+        q_off = QuorumConfig(write_quorum=1)
+        q_on = QuorumConfig(write_quorum=2, push_timeout_seconds=30.0)
+        client = BlobClient(addrs[0])
+
+        async def commit_wall(q: QuorumConfig) -> float:
+            nodes[0].server.quorum = q  # live-swap, as SIGHUP reload does
+            blob = os.urandom(2_000_000)
+            d = Digest.from_bytes(blob)
+            t0 = time.perf_counter()
+            await client.upload("band", d, blob)
+            return time.perf_counter() - t0
+
+        ratios: list[float] = []
+        try:
+            await commit_wall(q_off)  # warmup: sessions, page cache
+            await commit_wall(q_on)
+            for _ in range(5):
+                off = await commit_wall(q_off)
+                on = await commit_wall(q_on)
+                ratios.append(on / off)
+        finally:
+            await client.close()
+            for n in nodes:
+                await n.stop()
+        return ratios
+
+    ratios = asyncio.run(drive())
+    assert min(ratios) <= 1.5, (
+        "quorum-on/off pairwise commit-wall ratios "
+        f"{[f'{r:.2f}' for r in ratios]} all > 1.5: the healthy-path "
+        "push stopped overlapping the local commit (or started moving "
+        "bytes twice) -- see origin/server._begin_quorum_push"
+    )

@@ -463,3 +463,50 @@ def test_tree_gate_zero_findings():
         + "\n".join(f.render() for f in findings)
     )
     assert stats["files"] > 100  # the scan really covered the tree
+
+
+def test_retry_without_deadline_bad_and_good(tmp_path):
+    """An async frame that loops over RPC awaits with no deadline in
+    sight is an unbounded retry sweep -- the exact shape that turns one
+    dead peer into a wedged control plane."""
+    bad = _lint_src(tmp_path / "bad", """
+        async def sweep(clients, ns, d):
+            for c in clients:
+                if await c.stat(ns, d):
+                    return True
+            while True:
+                await clients[0].download(ns, d)
+    """)
+    assert _rules(bad) == ["retry-without-deadline"] * 2
+    assert {f.line for f in bad} == {3, 6}
+
+    good = _lint_src(tmp_path / "good", """
+        from kraken_tpu.utils.deadline import Deadline
+
+        async def sweep(clients, ns, d):
+            deadline = Deadline(30.0, component="sweep")
+            for c in clients:
+                if await c.stat(ns, d, deadline=deadline):
+                    return True
+
+        async def local_only(items):
+            for x in items:  # no RPC awaits in the body: not a sweep
+                await x.refresh_cache()
+    """)
+    assert good == []
+
+    # Test files are exempt (tests hand-drive tight RPC loops on purpose).
+    exempt = _lint_src(tmp_path / "tests", """
+        async def hammer(c, ns, d):
+            while True:
+                await c.stat(ns, d)
+    """, name="test_hammer.py")
+    assert exempt == []
+
+    # A reasoned pragma on the loop line suppresses (bounded sweeps).
+    suppressed = _lint_src(tmp_path / "pragma", """
+        async def hops(c, url):
+            for _hop in range(5):  # kt-lint: disable=retry-without-deadline  # bounded redirect follow
+                await c.request("GET", url)
+    """)
+    assert suppressed == []

@@ -1,0 +1,359 @@
+"""Quorum write plane kill-tests: replication-acked commits, hinted
+handoff under partition, read-repair on owner miss, and hint durability
+across origin restart.
+
+The contract under test (Dynamo sloppy quorum, ISSUE 20): with
+``write_quorum: N`` an upload commit acks only once N ring replicas
+durably hold the blob (local commit is copy #1); replicas unreachable at
+commit time get a durable hint that replays when they return; a GET
+landing on an owner that misses locally repairs from a sibling before
+serving. Every scenario asserts ZERO lost blobs and bit-identical pulls
+-- and none of these herds has a backend at all, so every recovery here
+is peer-to-peer by construction (zero backend reads).
+"""
+
+import asyncio
+import logging
+import os
+import socket
+
+import pytest
+
+from kraken_tpu.assembly import OriginNode
+from kraken_tpu.core.digest import Digest
+from kraken_tpu.origin.client import BlobClient
+from kraken_tpu.origin.server import HINT_KIND, QuorumConfig
+from kraken_tpu.placement import HostList, Ring
+from kraken_tpu.utils import failpoints
+from kraken_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.chaos
+
+NS = "quorum"
+
+
+@pytest.fixture(autouse=True)
+def chaos_plane():
+    """Every test starts disarmed and ACKNOWLEDGED (nodes may assemble
+    with failpoints armed), and leaves the process-global plane clean --
+    a leaked armed failpoint would inject into unrelated tests."""
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow()
+    yield failpoints.FAILPOINTS
+    failpoints.FAILPOINTS.disarm_all()
+    failpoints.allow(False)
+
+
+async def _wait_for(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        out = cond()
+        if asyncio.iscoroutine(out):
+            out = await out
+        if out:
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        await asyncio.sleep(interval)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _counter(name: str, **labels) -> float:
+    return REGISTRY.counter(name).value(**labels)
+
+
+def _node(tmp_path, i, addrs, ports, quorum) -> OriginNode:
+    """One origin over a STATIC full-mesh ring (max_replica=3: every
+    origin owns every digest, so quorum placement is deterministic and
+    read-repair applies on any node). Slow health keeps ring membership
+    static through the short partition windows these tests arm."""
+    return OriginNode(
+        store_root=str(tmp_path / f"origin{i}"),
+        http_port=ports[i],
+        ring=Ring(HostList(static=addrs), max_replica=3),
+        self_addr=addrs[i],
+        dedup=False,
+        quorum=quorum,
+        health_interval_seconds=30.0,
+    )
+
+
+async def _herd(tmp_path, quorum, n=3):
+    """n origins on fixed ports, retry POLL stopped on each: the tests
+    below drive ``retry.run_once()`` by hand so async replication and
+    hint replay happen exactly when the scenario says, never racing the
+    assertions in between.
+
+    Only node 0 -- the origin every scenario uploads through -- carries
+    the quorum config; the replicas keep the shipped ``write_quorum: 1``.
+    A replica receiving a quorum push commits through the same path and
+    would otherwise cascade its OWN quorum write (harmless in production,
+    its push resolves on a stat hit, but it doubles every counter delta
+    these tests pin)."""
+    ports = [_free_port() for _ in range(n)]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = []
+    for i in range(n):
+        node = _node(tmp_path, i, addrs, ports, quorum if i == 0 else None)
+        await node.start()
+        node.retry.stop()
+        nodes.append(node)
+    return nodes, addrs, ports
+
+
+async def _stop_all(nodes):
+    for n in nodes:
+        try:
+            await n.stop()
+        except Exception:
+            # Scenario already stopped this node mid-test; teardown
+            # must still reach the remaining live ones.
+            logging.getLogger("test_quorum").debug(
+                "duplicate stop in teardown", exc_info=True
+            )
+
+
+def test_owner_kill_after_quorum_ack_no_lost_blobs(tmp_path):
+    asyncio.run(_drive_owner_kill(tmp_path))
+
+
+async def _drive_owner_kill(tmp_path):
+    """THE kill-test from the issue: quorum=2 push, kill the owner right
+    after the ack, and the blob must survive -- one replica holds it
+    synchronously (the ack waited for it), the other read-repairs from
+    that sibling at first GET. Bit-identical both ways, no backend."""
+    q = QuorumConfig(write_quorum=2, push_timeout_seconds=10.0)
+    nodes, addrs, _ports = await _herd(tmp_path, q)
+    try:
+        blob = os.urandom(300_000)
+        d = Digest.from_bytes(blob)
+        # Deterministically partition replica 2 at the push layer, so
+        # exactly one replica (node 1) is the synchronous quorum copy.
+        failpoints.FAILPOINTS.arm(
+            f"origin.quorum.replica.partition@{addrs[2]}", "always"
+        )
+        before_q = _counter("origin_quorum_writes_total", outcome="quorum")
+        before_rr = _counter("origin_read_repairs_total")
+
+        oc = BlobClient(addrs[0])
+        await oc.upload(NS, d, blob)
+        await oc.close()
+
+        # The ack was replication-gated: the quorum copy is already
+        # durable on node 1 at this instant, no background wait.
+        assert nodes[1].store.in_cache(d)
+        assert not nodes[2].store.in_cache(d)
+        assert (
+            _counter("origin_quorum_writes_total", outcome="quorum")
+            == before_q + 1
+        )
+
+        # Kill the owner right after the ack (its pending async
+        # replication tasks die with it -- the poll was never running).
+        await nodes[0].stop()
+        failpoints.FAILPOINTS.disarm_all()
+
+        # Survivor that HAS it serves bit-identical.
+        c1 = BlobClient(addrs[1])
+        assert await c1.download(NS, d) == blob
+        await c1.close()
+
+        # Survivor that MISSES read-repairs from its sibling, then
+        # serves bit-identical. No backend exists to fall back to.
+        c2 = BlobClient(addrs[2])
+        assert await c2.download(NS, d) == blob
+        await c2.close()
+        assert nodes[2].store.in_cache(d)
+        assert _counter("origin_read_repairs_total") == before_rr + 1
+    finally:
+        await _stop_all(nodes)
+
+
+def test_total_partition_acks_via_hints_then_replays(tmp_path):
+    asyncio.run(_drive_total_partition(tmp_path))
+
+
+async def _drive_total_partition(tmp_path):
+    """Partition wider than the quorum: EVERY replica unreachable at
+    commit. The write must still ack (sloppy-quorum availability), the
+    unreachable replicas must be durably hinted, and healing the
+    partition must converge all copies through hint replay."""
+    q = QuorumConfig(write_quorum=2, push_timeout_seconds=10.0)
+    nodes, addrs, _ports = await _herd(tmp_path, q)
+    try:
+        blob = os.urandom(200_000)
+        d = Digest.from_bytes(blob)
+        failpoints.FAILPOINTS.arm("origin.quorum.replica.partition", "always")
+        before_h = _counter("origin_quorum_writes_total", outcome="hinted")
+        before_j = _counter("origin_hints_total", state="journaled")
+        before_r = _counter("origin_hints_total", state="replayed")
+
+        oc = BlobClient(addrs[0])
+        await oc.upload(NS, d, blob)  # must NOT raise: partition != outage
+        await oc.close()
+
+        assert (
+            _counter("origin_quorum_writes_total", outcome="hinted")
+            == before_h + 1
+        )
+        assert (
+            _counter("origin_hints_total", state="journaled") == before_j + 2
+        )
+        # Both hints are durably journaled, keyed by digest.
+        assert nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 2
+        assert not nodes[1].store.in_cache(d)
+        assert not nodes[2].store.in_cache(d)
+
+        # Heal the partition; replay the hints by hand.
+        failpoints.FAILPOINTS.disarm_all()
+        await nodes[0].retry.run_once()
+        assert nodes[1].store.in_cache(d)
+        assert nodes[2].store.in_cache(d)
+        assert (
+            _counter("origin_hints_total", state="replayed") == before_r + 2
+        )
+        assert nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 0
+
+        for a in addrs[1:]:
+            c = BlobClient(a)
+            assert await c.download(NS, d) == blob
+            await c.close()
+    finally:
+        await _stop_all(nodes)
+
+
+def test_symmetric_link_partition_at_http_layer(tmp_path):
+    asyncio.run(_drive_symmetric_partition(tmp_path))
+
+
+async def _drive_symmetric_partition(tmp_path):
+    """Same contract, but the partition is injected where real ones
+    live: the HTTP transport (rpc.link.drop@dst blocks every connection
+    INTO a host, including the quorum pushes). The fan-out burns its
+    deadline budget against dead links, acks hinted, and convergence
+    comes from replay once the links return."""
+    q = QuorumConfig(write_quorum=2, push_timeout_seconds=1.5)
+    nodes, addrs, _ports = await _herd(tmp_path, q)
+    try:
+        blob = os.urandom(150_000)
+        d = Digest.from_bytes(blob)
+        failpoints.FAILPOINTS.arm(f"rpc.link.drop@{addrs[1]}", "always")
+        failpoints.FAILPOINTS.arm(f"rpc.link.drop@{addrs[2]}", "always")
+        before_h = _counter("origin_quorum_writes_total", outcome="hinted")
+
+        oc = BlobClient(addrs[0])
+        await oc.upload(NS, d, blob)
+        await oc.close()
+
+        assert (
+            _counter("origin_quorum_writes_total", outcome="hinted")
+            == before_h + 1
+        )
+        # Both isolated replicas hinted -- whether the fan-out saw them
+        # FAIL (connection refused by the fault matrix) or ABANDONED
+        # them at the budget, an unmet quorum hints the whole set.
+        assert nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 2
+
+        failpoints.FAILPOINTS.disarm_all()
+        await nodes[0].retry.run_once()
+        assert nodes[1].store.in_cache(d)
+        assert nodes[2].store.in_cache(d)
+        for a in addrs[1:]:
+            c = BlobClient(a)
+            assert await c.download(NS, d) == blob
+            await c.close()
+    finally:
+        await _stop_all(nodes)
+
+
+def test_asymmetric_partition_still_meets_quorum(tmp_path):
+    asyncio.run(_drive_asymmetric_partition(tmp_path))
+
+
+async def _drive_asymmetric_partition(tmp_path):
+    """One-way fault: only the link INTO replica 1 is down. Replica 2
+    confirms, so the quorum is met and the commit acks as a full quorum
+    write -- the degraded replica converges afterwards (via its hint or
+    the async replication task; which one wins the race is deliberately
+    unasserted, both are correct)."""
+    q = QuorumConfig(write_quorum=2, push_timeout_seconds=1.5)
+    nodes, addrs, _ports = await _herd(tmp_path, q)
+    try:
+        blob = os.urandom(150_000)
+        d = Digest.from_bytes(blob)
+        failpoints.FAILPOINTS.arm(f"rpc.link.drop@{addrs[1]}", "always")
+        before_q = _counter("origin_quorum_writes_total", outcome="quorum")
+
+        oc = BlobClient(addrs[0])
+        await oc.upload(NS, d, blob)
+        await oc.close()
+
+        assert (
+            _counter("origin_quorum_writes_total", outcome="quorum")
+            == before_q + 1
+        )
+        assert nodes[2].store.in_cache(d)
+
+        failpoints.FAILPOINTS.disarm_all()
+
+        async def _converged():
+            await nodes[0].retry.run_once()
+            return nodes[1].store.in_cache(d)
+
+        await _wait_for(_converged, msg="degraded replica to converge")
+        for a in addrs[1:]:
+            c = BlobClient(a)
+            assert await c.download(NS, d) == blob
+            await c.close()
+    finally:
+        await _stop_all(nodes)
+
+
+def test_hints_replay_across_origin_restart(tmp_path):
+    asyncio.run(_drive_hint_restart(tmp_path))
+
+
+async def _drive_hint_restart(tmp_path):
+    """Hints are DURABLE: journal them under a partition, hard-stop the
+    owner, bring a fresh process image up over the same store -- the
+    hints must still be there and must replay to convergence. This is
+    the window a crash-between-ack-and-replay falls into."""
+    q = QuorumConfig(write_quorum=2, push_timeout_seconds=10.0)
+    nodes, addrs, ports = await _herd(tmp_path, q)
+    try:
+        blob = os.urandom(200_000)
+        d = Digest.from_bytes(blob)
+        failpoints.FAILPOINTS.arm("origin.quorum.replica.partition", "always")
+        oc = BlobClient(addrs[0])
+        await oc.upload(NS, d, blob)
+        await oc.close()
+        assert nodes[0].retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 2
+
+        # Owner dies with the hints unplayed; partition heals while
+        # it is down; a replacement comes up over the same volume.
+        await nodes[0].stop()
+        failpoints.FAILPOINTS.disarm_all()
+        before_r = _counter("origin_hints_total", state="replayed")
+        reborn = _node(tmp_path, 0, addrs, ports, q)
+        await reborn.start()
+        reborn.retry.stop()
+        nodes[0] = reborn
+
+        assert reborn.retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 2
+        await reborn.retry.run_once()
+        assert nodes[1].store.in_cache(d)
+        assert nodes[2].store.in_cache(d)
+        assert (
+            _counter("origin_hints_total", state="replayed") == before_r + 2
+        )
+        for a in addrs:
+            c = BlobClient(a)
+            assert await c.download(NS, d) == blob
+            await c.close()
+    finally:
+        await _stop_all(nodes)

@@ -795,3 +795,111 @@ def test_cleanup_sweeps_spool_and_journal_as_unit(tmp_path):
     assert s.upload_exists(live)
     assert s.read_upload_session(live) is not None
     assert s.read_upload_session("feedface" * 4) is None
+
+
+# -- hinted-handoff durability (quorum write plane) --------------------------
+
+
+def test_hint_task_survives_taskstore_restart(tmp_path):
+    """A journaled hint is a DURABILITY promise: it must ride sqlite
+    across process death bit-for-bit (addr, namespace, digest, expiry),
+    not live in an in-memory queue."""
+    from kraken_tpu.origin.server import HINT_KIND, _hint_task
+
+    d = Digest.from_bytes(b"hinted blob")
+    expires = time.time() + 3600.0
+    db = str(tmp_path / "retry.db")
+    store = TaskStore(db)
+    assert store.add(_hint_task("10.0.0.7:15003", "models", d, expires))
+    store.close()
+
+    reopened = TaskStore(db)
+    try:
+        assert reopened.count_pending(HINT_KIND, f"{d.hex}:") == 1
+        (task,) = reopened.all_pending()
+        assert task.kind == HINT_KIND
+        assert task.payload == {
+            "addr": "10.0.0.7:15003",
+            "namespace": "models",
+            "digest": d.hex,
+            "expires_at": expires,
+        }
+        # Re-journaling the same hint is idempotent (same kind+key).
+        assert not reopened.add(
+            _hint_task("10.0.0.7:15003", "models", d, expires + 99)
+        )
+        assert reopened.count_pending(HINT_KIND, f"{d.hex}:") == 1
+    finally:
+        reopened.close()
+
+
+def test_hint_executor_runs_exactly_once_per_journal_entry(tmp_path):
+    async def main():
+        from kraken_tpu.origin.server import HINT_KIND, _hint_task
+
+        d = Digest.from_bytes(b"one replay")
+        m = Manager(TaskStore(str(tmp_path / "retry.db")))
+        runs = []
+        m.register(HINT_KIND, lambda task: _record(runs, task))
+
+        async def _record(log, task):
+            log.append(task.key)
+
+        m.add(_hint_task("127.0.0.1:9", "ns", d, time.time() + 3600.0))
+        assert await m.run_once() == 1
+        # Retired: further polls never see it again.
+        assert await m.run_once() == 0
+        assert await m.run_once(now=time.time() + 9999.0) == 0
+        assert runs == [f"{d.hex}:ns:127.0.0.1:9"]
+        assert m.store.count_pending(HINT_KIND) == 0
+        m.close()
+
+    asyncio.run(main())
+
+
+def test_expired_hint_escalates_to_heal(tmp_path):
+    """A hint whose TTL lapsed stops chasing the stale address and hands
+    the blob to the heal plane, which repairs against CURRENT ring
+    owners. The hint retires (no replay), `expired` is counted, and a
+    heal task is journaled for the same blob."""
+
+    async def main():
+        from kraken_tpu.assembly import OriginNode
+        from kraken_tpu.origin.server import HEAL_KIND, HINT_KIND, _hint_task
+
+        node = OriginNode(store_root=str(tmp_path / "origin"), dedup=False)
+        await node.start()
+        node.retry.stop()
+        try:
+            blob = os.urandom(50_000)
+            d = Digest.from_bytes(blob)
+            from kraken_tpu.origin.client import BlobClient
+
+            oc = BlobClient(node.addr)
+            await oc.upload("ns", d, blob)
+            await oc.close()
+
+            node.retry.add(
+                _hint_task("127.0.0.1:9", "ns", d, time.time() - 1.0)
+            )
+            expired0 = REGISTRY.counter("origin_hints_total").value(
+                state="expired"
+            )
+            replayed0 = REGISTRY.counter("origin_hints_total").value(
+                state="replayed"
+            )
+            await node.retry.run_once()
+            assert (
+                REGISTRY.counter("origin_hints_total").value(state="expired")
+                == expired0 + 1
+            )
+            assert (
+                REGISTRY.counter("origin_hints_total").value(state="replayed")
+                == replayed0
+            )
+            assert node.retry.store.count_pending(HINT_KIND, f"{d.hex}:") == 0
+            assert node.retry.store.count_pending(HEAL_KIND, d.hex) == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
